@@ -1,0 +1,33 @@
+"""faabric_tpu — a TPU-native distributed-runtime framework.
+
+Provides scheduling, messaging and state for distributed accelerated
+runtimes: the capabilities of faasm/faabric (reference: /root/reference,
+v0.22.0) re-designed TPU-first.
+
+  - Device compute and collectives go through JAX/XLA (pjit / shard_map over
+    a ``jax.sharding.Mesh``), riding ICI; the reference's leader-tree
+    collectives over raw TCP (``src/mpi/MpiWorld.cpp``) become compiled XLA
+    collectives wherever the op matches.
+  - The host-side runtime (planner control plane, per-host scheduler,
+    executor pool, point-to-point broker, state KV, snapshots) mirrors the
+    reference's process topology (``src/runner/FaabricMain.cpp``) with a
+    framed-TCP transport in place of nng.
+
+Layer map (== SURVEY.md §1):
+
+    endpoint/        HTTP REST API (planner controller)
+    planner/         cluster-singleton control plane
+    batch_scheduler/ pluggable scheduling policies (bin-pack/compact/spot)
+    scheduler/       per-host scheduler + function-call RPC
+    executor/        pluggable executor w/ thread pool, snapshot restore
+    mpi/             MPI-semantics world: host PTP path + XLA device path
+    transport/       framed TCP endpoints, RPC servers/clients, PTP broker
+    snapshot/        memory snapshots, typed merge regions, diffs, deltas
+    state/           distributed KV (master-per-key, chunked pull/push)
+    parallel/        TPU mesh substrate: axes, collectives, ring attention
+    models/          flagship models exercising dp/tp/pp/sp/ep shardings
+    ops/             Pallas kernels for hot device ops
+    util/            config, gids, queues, latches, dirty tracking, graphs
+"""
+
+__version__ = "0.1.0"

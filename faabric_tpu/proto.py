@@ -1,0 +1,356 @@
+"""Message schema — the analog of the reference's protobuf definitions
+(src/proto/faabric.proto, 242 lines).
+
+Implemented as dataclasses with a compact wire form: dict/JSON for the
+control plane (small messages), with large binary payloads (input/output
+data, snapshot contents, MPI buffers) carried out-of-band in the transport
+frame's binary tail — the flatbuffers analog (src/flat/faabric.fbs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import time
+from typing import Any
+
+from faabric_tpu.util.gids import generate_gid
+
+
+class BatchExecuteType(enum.IntEnum):
+    # faabric.proto:26-31
+    FUNCTIONS = 0
+    THREADS = 1
+    PROCESSES = 2
+    MIGRATION = 3
+
+
+class MessageType(enum.IntEnum):
+    # faabric.proto:93-99
+    CALL = 0
+    KILL = 1
+    EMPTY = 2
+    FLUSH = 3
+
+
+class ReturnValue(enum.IntEnum):
+    SUCCESS = 0
+    FAILED = 1
+    MIGRATED = -99  # MIGRATED_FUNCTION_RETURN_VALUE
+    FROZEN = -98
+
+
+@dataclasses.dataclass
+class Message:
+    """A single function invocation (faabric.proto:91-151)."""
+
+    id: int = 0
+    app_id: int = 0
+    app_idx: int = 0
+    main_host: str = ""
+    type: int = int(MessageType.CALL)
+
+    user: str = ""
+    function: str = ""
+
+    input_data: bytes = b""
+    output_data: bytes = b""
+
+    timestamp: float = 0.0
+    executed_host: str = ""
+    finish_timestamp: float = 0.0
+
+    return_value: int = 0
+
+    # Snapshots
+    snapshot_key: str = ""
+
+    # Function groups (PTP)
+    group_id: int = 0
+    group_idx: int = 0
+    group_size: int = 0
+
+    # MPI
+    is_mpi: bool = False
+    mpi_world_id: int = 0
+    mpi_rank: int = 0
+    mpi_world_size: int = 0
+
+    # OpenMP-style shared-memory parallelism
+    is_omp: bool = False
+    omp_num_threads: int = 0
+
+    # Exec-graph
+    record_exec_graph: bool = False
+    exec_graph_details: dict[str, str] = dataclasses.field(default_factory=dict)
+    int_exec_graph_details: dict[str, int] = dataclasses.field(default_factory=dict)
+    chained_msg_ids: list[int] = dataclasses.field(default_factory=list)
+
+    # Migration
+    is_migration: bool = False
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["input_data"] = self.input_data.hex()
+        d["output_data"] = self.output_data.hex()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Message":
+        d = dict(d)
+        d["input_data"] = bytes.fromhex(d.get("input_data", ""))
+        d["output_data"] = bytes.fromhex(d.get("output_data", ""))
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in field_names})
+
+
+@dataclasses.dataclass
+class HostResources:
+    # faabric.proto:75-78
+    slots: int = 0
+    used_slots: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "HostResources":
+        return cls(slots=d.get("slots", 0), used_slots=d.get("used_slots", 0))
+
+
+@dataclasses.dataclass
+class BatchExecuteRequest:
+    """A batch of messages executed as one app (faabric.proto:21-60)."""
+
+    app_id: int = 0
+    group_id: int = 0
+    user: str = ""
+    function: str = ""
+    type: int = int(BatchExecuteType.FUNCTIONS)
+    messages: list[Message] = dataclasses.field(default_factory=list)
+
+    # Single-host optimisations
+    single_host_hint: bool = False
+    single_host: bool = False
+
+    # Elastic scaling hint (OpenMP fork grows to free slots on main host)
+    elastic_scale_hint: bool = False
+
+    # Main-thread snapshot for THREADS batches
+    snapshot_key: str = ""
+
+    # Migration / spot
+    evicted_host: str = ""
+
+    def n_messages(self) -> int:
+        return len(self.messages)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "app_id": self.app_id,
+            "group_id": self.group_id,
+            "user": self.user,
+            "function": self.function,
+            "type": self.type,
+            "messages": [m.to_dict() for m in self.messages],
+            "single_host_hint": self.single_host_hint,
+            "single_host": self.single_host,
+            "elastic_scale_hint": self.elastic_scale_hint,
+            "snapshot_key": self.snapshot_key,
+            "evicted_host": self.evicted_host,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "BatchExecuteRequest":
+        req = cls(
+            app_id=d.get("app_id", 0),
+            group_id=d.get("group_id", 0),
+            user=d.get("user", ""),
+            function=d.get("function", ""),
+            type=d.get("type", 0),
+            single_host_hint=d.get("single_host_hint", False),
+            single_host=d.get("single_host", False),
+            elastic_scale_hint=d.get("elastic_scale_hint", False),
+            snapshot_key=d.get("snapshot_key", ""),
+            evicted_host=d.get("evicted_host", ""),
+        )
+        req.messages = [Message.from_dict(m) for m in d.get("messages", [])]
+        return req
+
+
+@dataclasses.dataclass
+class BatchExecuteRequestStatus:
+    # faabric.proto:62-73
+    app_id: int = 0
+    finished: bool = False
+    message_results: list[Message] = dataclasses.field(default_factory=list)
+    expected_num_messages: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "app_id": self.app_id,
+            "finished": self.finished,
+            "message_results": [m.to_dict() for m in self.message_results],
+            "expected_num_messages": self.expected_num_messages,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "BatchExecuteRequestStatus":
+        s = cls(
+            app_id=d.get("app_id", 0),
+            finished=d.get("finished", False),
+            expected_num_messages=d.get("expected_num_messages", 0),
+        )
+        s.message_results = [Message.from_dict(m) for m in d.get("message_results", [])]
+        return s
+
+
+@dataclasses.dataclass
+class PointToPointMessage:
+    # faabric.proto:208-219 — payload travels in the transport binary tail
+    app_id: int = 0
+    group_id: int = 0
+    send_idx: int = 0
+    recv_idx: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PointToPointMessage":
+        return cls(
+            app_id=d.get("app_id", 0),
+            group_id=d.get("group_id", 0),
+            send_idx=d.get("send_idx", 0),
+            recv_idx=d.get("recv_idx", 0),
+        )
+
+
+@dataclasses.dataclass
+class PointToPointMapping:
+    # faabric.proto:221-230 (one entry of PointToPointMappings, + mpiPort)
+    host: str = ""
+    message_id: int = 0
+    app_idx: int = 0
+    group_idx: int = 0
+    mpi_port: int = 0
+    device_ids: list[int] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PointToPointMapping":
+        return cls(
+            host=d.get("host", ""),
+            message_id=d.get("message_id", 0),
+            app_idx=d.get("app_idx", 0),
+            group_idx=d.get("group_idx", 0),
+            mpi_port=d.get("mpi_port", 0),
+            device_ids=list(d.get("device_ids", [])),
+        )
+
+
+@dataclasses.dataclass
+class PointToPointMappings:
+    app_id: int = 0
+    group_id: int = 0
+    mappings: list[PointToPointMapping] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "app_id": self.app_id,
+            "group_id": self.group_id,
+            "mappings": [m.to_dict() for m in self.mappings],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PointToPointMappings":
+        out = cls(app_id=d.get("app_id", 0), group_id=d.get("group_id", 0))
+        out.mappings = [PointToPointMapping.from_dict(m) for m in d.get("mappings", [])]
+        return out
+
+
+@dataclasses.dataclass
+class PendingMigration:
+    # faabric.proto:236-242
+    app_id: int = 0
+    group_id: int = 0
+    group_idx: int = 0
+    src_host: str = ""
+    dst_host: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PendingMigration":
+        return cls(**{k: d.get(k, v) for k, v in
+                      (("app_id", 0), ("group_id", 0), ("group_idx", 0),
+                       ("src_host", ""), ("dst_host", ""))})
+
+
+# ---------------------------------------------------------------------------
+# Factories (reference: include/faabric/util/batch.h:11-39, func.h:29-57)
+# ---------------------------------------------------------------------------
+
+def message_factory(user: str, function: str) -> Message:
+    msg = Message(
+        id=generate_gid(),
+        app_id=generate_gid(),
+        user=user,
+        function=function,
+        timestamp=time.time(),
+    )
+    return msg
+
+
+def batch_exec_factory(user: str, function: str, count: int = 1) -> BatchExecuteRequest:
+    req = BatchExecuteRequest(app_id=generate_gid(), user=user, function=function)
+    for i in range(count):
+        msg = message_factory(user, function)
+        msg.app_id = req.app_id
+        msg.app_idx = i
+        req.messages.append(msg)
+    return req
+
+
+def func_to_string(msg: Message, include_id: bool = False) -> str:
+    base = f"{msg.user}/{msg.function}"
+    if include_id:
+        base += f":{msg.id}"
+    return base
+
+
+def get_main_thread_snapshot_key(msg: Message) -> str:
+    # reference func.h:57
+    return f"main_{msg.user}_{msg.function}"
+
+
+def is_batch_exec_request_valid(req: BatchExecuteRequest | None) -> bool:
+    if req is None:
+        return False
+    if not req.user or not req.function:
+        return False
+    return req.n_messages() > 0
+
+
+def update_batch_exec_app_id(req: BatchExecuteRequest, app_id: int) -> None:
+    req.app_id = app_id
+    for m in req.messages:
+        m.app_id = app_id
+
+
+def update_batch_exec_group_id(req: BatchExecuteRequest, group_id: int) -> None:
+    req.group_id = group_id
+    for m in req.messages:
+        m.group_id = group_id
+
+
+def message_to_json(msg: Message) -> str:
+    return json.dumps(msg.to_dict())
+
+
+def message_from_json(s: str) -> Message:
+    return Message.from_dict(json.loads(s))

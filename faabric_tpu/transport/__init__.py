@@ -1,0 +1,45 @@
+from faabric_tpu.transport.message import (
+    TransportMessage,
+    MessageResponseCode,
+    SHUTDOWN_CODE,
+)
+from faabric_tpu.transport.common import (
+    STATE_ASYNC_PORT,
+    STATE_SYNC_PORT,
+    FUNCTION_CALL_ASYNC_PORT,
+    FUNCTION_CALL_SYNC_PORT,
+    SNAPSHOT_ASYNC_PORT,
+    SNAPSHOT_SYNC_PORT,
+    POINT_TO_POINT_ASYNC_PORT,
+    POINT_TO_POINT_SYNC_PORT,
+    PLANNER_ASYNC_PORT,
+    PLANNER_SYNC_PORT,
+    MPI_BASE_PORT,
+    register_host_alias,
+    resolve_host,
+    clear_host_aliases,
+)
+from faabric_tpu.transport.server import MessageEndpointServer
+from faabric_tpu.transport.client import MessageEndpointClient
+
+__all__ = [
+    "TransportMessage",
+    "MessageResponseCode",
+    "SHUTDOWN_CODE",
+    "MessageEndpointServer",
+    "MessageEndpointClient",
+    "register_host_alias",
+    "resolve_host",
+    "clear_host_aliases",
+    "STATE_ASYNC_PORT",
+    "STATE_SYNC_PORT",
+    "FUNCTION_CALL_ASYNC_PORT",
+    "FUNCTION_CALL_SYNC_PORT",
+    "SNAPSHOT_ASYNC_PORT",
+    "SNAPSHOT_SYNC_PORT",
+    "POINT_TO_POINT_ASYNC_PORT",
+    "POINT_TO_POINT_SYNC_PORT",
+    "PLANNER_ASYNC_PORT",
+    "PLANNER_SYNC_PORT",
+    "MPI_BASE_PORT",
+]

@@ -1,0 +1,106 @@
+"""RPC client base — the MessageEndpointClient analog
+(include/faabric/transport/MessageEndpointClient.h:95-133).
+
+Holds one persistent connection per plane (async push / sync req-rep) with
+lazy dial, retry-once on failure, and per-plane send locks. Resolves logical
+hosts through the alias table so in-process multi-host tests work
+(transport/common.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any
+
+from faabric_tpu.transport.common import DEFAULT_SOCKET_TIMEOUT, resolve_host
+from faabric_tpu.transport.message import (
+    MessageResponseCode,
+    TransportMessage,
+    recv_frame,
+    send_frame,
+)
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class RpcError(Exception):
+    pass
+
+
+class MessageEndpointClient:
+    def __init__(self, host: str, async_port: int, sync_port: int,
+                 timeout: float = DEFAULT_SOCKET_TIMEOUT) -> None:
+        self.host = host
+        self.async_port = async_port
+        self.sync_port = sync_port
+        self.timeout = timeout
+        self._socks: dict[str, socket.socket | None] = {"async": None, "sync": None}
+        self._locks = {"async": threading.Lock(), "sync": threading.Lock()}
+
+    def _dial(self, plane: str) -> socket.socket:
+        port = self.async_port if plane == "async" else self.sync_port
+        ip, real_port = resolve_host(self.host, port)
+        s = socket.create_connection((ip, real_port), timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _get_sock(self, plane: str) -> socket.socket:
+        if self._socks[plane] is None:
+            self._socks[plane] = self._dial(plane)
+        return self._socks[plane]  # type: ignore[return-value]
+
+    def _reset_sock(self, plane: str) -> None:
+        s = self._socks[plane]
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks[plane] = None
+
+    def async_send(self, code: int, header: dict[str, Any] | None = None,
+                   payload: bytes = b"", seqnum: int = -1) -> None:
+        msg = TransportMessage(code=code, header=header or {}, payload=payload,
+                               seqnum=seqnum)
+        with self._locks["async"]:
+            for attempt in (0, 1):
+                try:
+                    send_frame(self._get_sock("async"), msg)
+                    return
+                except OSError as e:
+                    self._reset_sock("async")
+                    if attempt == 1:
+                        raise RpcError(
+                            f"async send to {self.host}:{self.async_port} failed: {e}"
+                        ) from e
+
+    def sync_send(self, code: int, header: dict[str, Any] | None = None,
+                  payload: bytes = b"") -> TransportMessage:
+        msg = TransportMessage(code=code, header=header or {}, payload=payload)
+        with self._locks["sync"]:
+            for attempt in (0, 1):
+                try:
+                    sock = self._get_sock("sync")
+                    send_frame(sock, msg)
+                    resp = recv_frame(sock)
+                    break
+                except OSError as e:
+                    self._reset_sock("sync")
+                    if attempt == 1:
+                        raise RpcError(
+                            f"sync send to {self.host}:{self.sync_port} failed: {e}"
+                        ) from e
+            else:  # pragma: no cover
+                raise RpcError("unreachable")
+        if resp.response_code != int(MessageResponseCode.SUCCESS):
+            raise RpcError(
+                f"RPC {code} to {self.host}:{self.sync_port} failed: "
+                f"{resp.header.get('error', resp.response_code)}"
+            )
+        return resp
+
+    def close(self) -> None:
+        self._reset_sock("async")
+        self._reset_sock("sync")

@@ -1,0 +1,60 @@
+"""Transport constants and host resolution.
+
+Port map mirrors the reference (include/faabric/transport/common.h:283-309):
+state 8003/8004, function 8005/8006, snapshot 8007/8008, PTP 8009/8010,
+planner 8011/8012, MPI data-plane base 8020.
+
+Host aliasing supports the reference's "fake second host by IP aliasing"
+test trick (SURVEY.md §4.2): in-process tests register an alias mapping a
+fake host name to (127.0.0.1, port_offset) so two full per-host runtimes can
+coexist in one process on distinct port ranges.
+"""
+
+from __future__ import annotations
+
+import threading
+
+STATE_ASYNC_PORT = 8003
+STATE_SYNC_PORT = 8004
+FUNCTION_CALL_ASYNC_PORT = 8005
+FUNCTION_CALL_SYNC_PORT = 8006
+SNAPSHOT_ASYNC_PORT = 8007
+SNAPSHOT_SYNC_PORT = 8008
+POINT_TO_POINT_ASYNC_PORT = 8009
+POINT_TO_POINT_SYNC_PORT = 8010
+PLANNER_ASYNC_PORT = 8011
+PLANNER_SYNC_PORT = 8012
+
+MPI_BASE_PORT = 8020
+MPI_PORTS_PER_HOST = 512
+
+DEFAULT_SOCKET_TIMEOUT = 60.0
+
+_aliases: dict[str, tuple[str, int]] = {}
+_alias_lock = threading.Lock()
+
+
+def register_host_alias(host: str, ip: str = "127.0.0.1", port_offset: int = 0) -> None:
+    with _alias_lock:
+        _aliases[host] = (ip, port_offset)
+
+
+def resolve_host(host: str, port: int) -> tuple[str, int]:
+    """Map a logical host + canonical port to a dialable (ip, port)."""
+    with _alias_lock:
+        if host in _aliases:
+            ip, offset = _aliases[host]
+            return ip, port + offset
+    return host, port
+
+
+def get_host_alias_offset(host: str) -> int:
+    with _alias_lock:
+        if host in _aliases:
+            return _aliases[host][1]
+    return 0
+
+
+def clear_host_aliases() -> None:
+    with _alias_lock:
+        _aliases.clear()

@@ -1,0 +1,122 @@
+"""Wire framing.
+
+Reference analog: include/faabric/transport/Message.h:11-23 — there a
+16-byte header over nng_msg {u8 code, u64 size, i32 seqnum}; here a 24-byte
+header over a TCP stream carrying a JSON control section and a raw binary
+tail (so big payloads — snapshot contents, MPI buffers — never pass through
+JSON):
+
+    magic u16 | code u8 | resp u8 | seqnum i64 | json_len u32 | bin_len u64
+
+SHUTDOWN uses header code 220 with a magic payload, as the reference does
+(Message.h:22-23).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import socket
+import struct
+from typing import Any
+
+HEADER_FMT = "<HBBqIQ"
+HEADER_LEN = struct.calcsize(HEADER_FMT)
+MAGIC = 0xFAAB
+
+SHUTDOWN_CODE = 220
+SHUTDOWN_PAYLOAD = b"\x00\x00\x42\x99"
+
+NO_SEQUENCE_NUM = -1
+
+
+class MessageResponseCode(enum.IntEnum):
+    SUCCESS = 0
+    TERM = 1
+    TIMEOUT = 2
+    ERROR = 3
+
+
+class TransportError(Exception):
+    pass
+
+
+class ConnectionClosed(TransportError):
+    pass
+
+
+@dataclasses.dataclass
+class TransportMessage:
+    code: int
+    header: dict[str, Any] = dataclasses.field(default_factory=dict)
+    payload: bytes = b""
+    seqnum: int = NO_SEQUENCE_NUM
+    response_code: int = int(MessageResponseCode.SUCCESS)
+
+    def is_shutdown(self) -> bool:
+        return self.code == SHUTDOWN_CODE and self.payload == SHUTDOWN_PAYLOAD
+
+    @classmethod
+    def shutdown(cls) -> "TransportMessage":
+        return cls(code=SHUTDOWN_CODE, payload=SHUTDOWN_PAYLOAD)
+
+
+def send_frame(sock: socket.socket, msg: TransportMessage) -> None:
+    header_json = json.dumps(msg.header).encode() if msg.header else b""
+    payload = msg.payload or b""
+    head = struct.pack(
+        HEADER_FMT,
+        MAGIC,
+        msg.code & 0xFF,
+        msg.response_code & 0xFF,
+        msg.seqnum,
+        len(header_json),
+        len(payload),
+    )
+    # One syscall for small messages; for large payloads sendall the tail
+    # separately to avoid a copy of the payload bytes.
+    if len(payload) <= 65536:
+        sock.sendall(head + header_json + payload)
+    else:
+        sock.sendall(head + header_json)
+        sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    if n == 0:
+        return b""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionClosed("Socket closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> TransportMessage:
+    head = _recv_exact(sock, HEADER_LEN)
+    magic, code, resp, seqnum, json_len, bin_len = struct.unpack(HEADER_FMT, head)
+    if magic != MAGIC:
+        raise TransportError(f"Bad frame magic: {magic:#x}")
+    header_json = _recv_exact(sock, json_len)
+    payload = _recv_exact(sock, bin_len)
+    header = json.loads(header_json) if header_json else {}
+    return TransportMessage(
+        code=code, header=header, payload=payload, seqnum=seqnum, response_code=resp
+    )
+
+
+def tune_socket(sock: socket.socket) -> None:
+    """Data-plane socket tuning — the analog of the reference's OpenMPI-
+    recommended options (transport/tcp/Socket.h:75-78): TCP_NODELAY + large
+    send/recv buffers."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 16 * 1024 * 1024)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16 * 1024 * 1024)
+    except OSError:
+        pass

@@ -1,0 +1,225 @@
+"""RPC server base — the MessageEndpointServer analog
+(include/faabric/transport/MessageEndpointServer.h:43-83,
+src/transport/MessageEndpointServer.cpp:29-202).
+
+Two listening ports per server: an async plane (fire-and-forget push) and a
+sync plane (request/response). The reference fans one nng socket out to N
+worker threads via contexts; here each accepted connection gets a reader
+thread which dispatches frames onto a shared work queue consumed by N
+workers — same effect (serialised accept, parallel handling), idiomatic for
+Python sockets.
+
+Graceful stop: a shutdown frame (code 220 + magic payload) per worker, as in
+the reference. ``set_request_latch``/``await_request_latch`` synchronise
+tests with server-side processing (MessageEndpointServer.h:57-59).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any
+
+from faabric_tpu.transport.message import (
+    ConnectionClosed,
+    MessageResponseCode,
+    TransportMessage,
+    recv_frame,
+    send_frame,
+)
+from faabric_tpu.util.latch import Latch
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.queues import Queue
+
+logger = get_logger(__name__)
+
+
+class MessageEndpointServer:
+    def __init__(
+        self,
+        async_port: int,
+        sync_port: int,
+        label: str = "",
+        n_threads: int = 2,
+        bind_host: str = "0.0.0.0",
+    ) -> None:
+        self.async_port = async_port
+        self.sync_port = sync_port
+        self.label = label or self.__class__.__name__
+        self.n_threads = max(1, n_threads)
+        self.bind_host = bind_host
+
+        self._async_listener: socket.socket | None = None
+        self._sync_listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._conn_threads: list[threading.Thread] = []
+        self._running = False
+        self._work: Queue[tuple[TransportMessage, socket.socket | None]] = Queue()
+        self._request_latch: Latch | None = None
+        self._latch_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Virtual handlers
+    # ------------------------------------------------------------------
+    def do_async_recv(self, msg: TransportMessage) -> None:
+        raise NotImplementedError
+
+    def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._async_listener = self._listen(self.async_port)
+        self._sync_listener = self._listen(self.sync_port)
+        for listener, plane in ((self._async_listener, "async"), (self._sync_listener, "sync")):
+            t = threading.Thread(
+                target=self._accept_loop, args=(listener, plane),
+                name=f"{self.label}-{plane}-accept", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        for i in range(self.n_threads):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"{self.label}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        logger.debug(
+            "%s started (async=%d sync=%d threads=%d)",
+            self.label, self.async_port, self.sync_port, self.n_threads,
+        )
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for _ in range(self.n_threads):
+            self._work.enqueue((TransportMessage.shutdown(), None))
+        for listener in (self._async_listener, self._sync_listener):
+            if listener is not None:
+                # shutdown() is required to wake threads blocked in accept();
+                # close() alone leaves the file description (and the bound
+                # port) alive until the accept returns.
+                try:
+                    listener.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    listener.close()
+                except OSError:
+                    pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+        self._conn_threads.clear()
+        logger.debug("%s stopped", self.label)
+
+    # ------------------------------------------------------------------
+    # Test synchronisation
+    # ------------------------------------------------------------------
+    def set_request_latch(self) -> None:
+        with self._latch_lock:
+            self._request_latch = Latch.create(2)
+
+    def await_request_latch(self) -> None:
+        with self._latch_lock:
+            latch = self._request_latch
+        if latch is not None:
+            latch.wait()
+            with self._latch_lock:
+                self._request_latch = None
+
+    def _fire_request_latch(self) -> None:
+        with self._latch_lock:
+            latch = self._request_latch
+        if latch is not None:
+            try:
+                latch.wait()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # Internals
+    # ------------------------------------------------------------------
+    def _listen(self, port: int) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.bind_host, port))
+        s.listen(128)
+        return s
+
+    def _accept_loop(self, listener: socket.socket, plane: str) -> None:
+        while self._running:
+            try:
+                conn, _addr = listener.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._conn_loop, args=(conn, plane),
+                name=f"{self.label}-{plane}-conn", daemon=True,
+            )
+            t.start()
+            self._conn_threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket, plane: str) -> None:
+        try:
+            while self._running:
+                try:
+                    msg = recv_frame(conn)
+                except (ConnectionClosed, OSError):
+                    break
+                if msg.is_shutdown():
+                    break
+                if plane == "async":
+                    self._work.enqueue((msg, None))
+                else:
+                    # Sync requests are handled inline on the connection
+                    # thread so responses pair with their requests even with
+                    # pipelining from one client connection.
+                    self._handle_sync(msg, conn)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_sync(self, msg: TransportMessage, conn: socket.socket) -> None:
+        try:
+            resp = self.do_sync_recv(msg)
+            if resp is None:
+                resp = TransportMessage(code=msg.code)
+            resp.response_code = int(MessageResponseCode.SUCCESS)
+        except Exception as e:  # noqa: BLE001 — errors must cross the wire
+            logger.exception("%s sync handler error", self.label)
+            resp = TransportMessage(
+                code=msg.code,
+                header={"error": str(e)},
+                response_code=int(MessageResponseCode.ERROR),
+            )
+        try:
+            send_frame(conn, resp)
+        except OSError:
+            pass
+        self._fire_request_latch()
+
+    def _worker_loop(self) -> None:
+        while True:
+            msg, _ = self._work.dequeue()
+            if msg.is_shutdown():
+                return
+            try:
+                self.do_async_recv(msg)
+            except Exception:  # noqa: BLE001
+                logger.exception("%s async handler error", self.label)
+            self._fire_request_latch()
+
+
+def handler_response(header: dict[str, Any] | None = None, payload: bytes = b"",
+                     code: int = 0) -> TransportMessage:
+    return TransportMessage(code=code, header=header or {}, payload=payload)

@@ -1,0 +1,18 @@
+from faabric_tpu.util.config import get_system_config, SystemConfig
+from faabric_tpu.util.gids import generate_gid
+from faabric_tpu.util.testing import (
+    set_mock_mode,
+    is_mock_mode,
+    set_test_mode,
+    is_test_mode,
+)
+
+__all__ = [
+    "get_system_config",
+    "SystemConfig",
+    "generate_gid",
+    "set_mock_mode",
+    "is_mock_mode",
+    "set_test_mode",
+    "is_test_mode",
+]

@@ -1,0 +1,77 @@
+"""Sharded concurrent map (reference: include/faabric/util/concurrent_map.h).
+
+Provides atomic get-or-create (``try_emplace_then_mutate``) used throughout
+the runtime for registries (worlds, groups, endpoints).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, Iterator, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class ConcurrentMap(Generic[K, V]):
+    def __init__(self) -> None:
+        self._map: dict[K, V] = {}
+        self._lock = threading.RLock()
+
+    def get(self, key: K) -> V | None:
+        with self._lock:
+            return self._map.get(key)
+
+    def __contains__(self, key: K) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def insert(self, key: K, value: V) -> None:
+        with self._lock:
+            self._map[key] = value
+
+    def try_emplace(self, key: K, factory: Callable[[], V]) -> tuple[V, bool]:
+        """Returns (value, inserted). Factory only runs if the key is absent."""
+        with self._lock:
+            if key in self._map:
+                return self._map[key], False
+            value = factory()
+            self._map[key] = value
+            return value, True
+
+    def try_emplace_then_mutate(
+        self, key: K, factory: Callable[[], V], mutate: Callable[[V], None]
+    ) -> V:
+        with self._lock:
+            if key not in self._map:
+                self._map[key] = factory()
+            value = self._map[key]
+            mutate(value)
+            return value
+
+    def erase(self, key: K) -> None:
+        with self._lock:
+            self._map.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+    def keys(self) -> list[K]:
+        with self._lock:
+            return list(self._map.keys())
+
+    def values(self) -> list[V]:
+        with self._lock:
+            return list(self._map.values())
+
+    def items(self) -> list[tuple[K, V]]:
+        with self._lock:
+            return list(self._map.items())
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self.keys())

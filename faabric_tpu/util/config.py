@@ -1,0 +1,148 @@
+"""Env-var driven system configuration.
+
+TPU-native analog of the reference SystemConfig singleton
+(include/faabric/util/config.h:12-70, src/util/config.cpp:19-97): a
+re-readable (``reset()``) process-wide config sourced from environment
+variables, printable for debugging, with test overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class SystemConfig:
+    # Logging
+    log_level: str = "info"
+    log_file: str = "off"
+
+    # State
+    state_mode: str = "inmemory"  # inmemory | redis
+    redis_state_host: str = "redis"
+    redis_queue_host: str = "redis"
+    redis_port: int = 6379
+
+    # Scheduling
+    batch_scheduler_mode: str = "bin-pack"  # bin-pack | compact | spot
+    override_cpu_count: int = 0
+    override_free_cpu_start: int = 0
+    default_mpi_world_size: int = 5
+
+    # Timeouts (seconds)
+    global_message_timeout: float = 60.0
+    bound_timeout: float = 30.0
+    reaper_interval_secs: float = 30.0
+
+    # Endpoint
+    endpoint_interface: str = ""
+    endpoint_host: str = ""
+    endpoint_port: int = 8080
+    endpoint_num_threads: int = 4
+
+    # RPC server worker threads per plane
+    function_server_threads: int = 2
+    state_server_threads: int = 2
+    snapshot_server_threads: int = 2
+    point_to_point_server_threads: int = 8
+
+    # Dirty tracking: the reference uses mprotect/SIGSEGV, soft-dirty PTEs or
+    # userfaultfd on guest memory (src/util/dirty.cpp). Executor memory here
+    # is host numpy / device HBM, so tracking is hash-page compare ("hash"),
+    # full compare ("compare"), native C++ page compare ("native"), or "none"
+    # (everything dirty).
+    dirty_tracking_mode: str = "hash"
+    diffing_mode: str = "xor"
+    delta_snapshot_encoding: str = "pages=4096;xor;zlib=1"
+
+    # Planner
+    planner_host: str = "localhost"
+    planner_port: int = 8011
+
+    # Transport
+    serialisation: str = "json"
+
+    # Device / mesh
+    mesh_device_kind: str = "auto"  # auto | tpu | cpu
+
+    def __post_init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        """Re-read every knob from the environment."""
+        self.log_level = _env("LOG_LEVEL", "info")
+        self.log_file = _env("LOG_FILE", "off")
+
+        self.state_mode = _env("STATE_MODE", "inmemory")
+        self.redis_state_host = _env("REDIS_STATE_HOST", "redis")
+        self.redis_queue_host = _env("REDIS_QUEUE_HOST", "redis")
+        self.redis_port = _env_int("REDIS_PORT", 6379)
+
+        self.batch_scheduler_mode = _env("BATCH_SCHEDULER_MODE", "bin-pack")
+        self.override_cpu_count = _env_int("OVERRIDE_CPU_COUNT", 0)
+        self.override_free_cpu_start = _env_int("OVERRIDE_FREE_CPU_START", 0)
+        self.default_mpi_world_size = _env_int("DEFAULT_MPI_WORLD_SIZE", 5)
+
+        self.global_message_timeout = _env_int("GLOBAL_MESSAGE_TIMEOUT", 60000) / 1000.0
+        self.bound_timeout = _env_int("BOUND_TIMEOUT", 30000) / 1000.0
+        self.reaper_interval_secs = _env_int("REAPER_INTERVAL_SECS", 30)
+
+        self.endpoint_interface = _env("ENDPOINT_INTERFACE", "")
+        self.endpoint_host = _env("ENDPOINT_HOST", "")
+        self.endpoint_port = _env_int("ENDPOINT_PORT", 8080)
+        self.endpoint_num_threads = _env_int("ENDPOINT_NUM_THREADS", 4)
+
+        self.function_server_threads = _env_int("FUNCTION_SERVER_THREADS", 2)
+        self.state_server_threads = _env_int("STATE_SERVER_THREADS", 2)
+        self.snapshot_server_threads = _env_int("SNAPSHOT_SERVER_THREADS", 2)
+        self.point_to_point_server_threads = _env_int("POINT_TO_POINT_SERVER_THREADS", 8)
+
+        self.dirty_tracking_mode = _env("DIRTY_TRACKING_MODE", "hash")
+        self.diffing_mode = _env("DIFFING_MODE", "xor")
+        self.delta_snapshot_encoding = _env(
+            "DELTA_SNAPSHOT_ENCODING", "pages=4096;xor;zlib=1"
+        )
+
+        self.planner_host = _env("PLANNER_HOST", "localhost")
+        self.planner_port = _env_int("PLANNER_PORT", 8011)
+
+        self.serialisation = _env("SERIALISATION", "json")
+        self.mesh_device_kind = _env("MESH_DEVICE_KIND", "auto")
+
+    def print(self) -> str:
+        lines = ["--- System config ---"]
+        for f in dataclasses.fields(self):
+            lines.append(f"{f.name:<32}{getattr(self, f.name)}")
+        out = "\n".join(lines)
+        return out
+
+    def get_usable_cores(self) -> int:
+        if self.override_cpu_count > 0:
+            return self.override_cpu_count
+        return os.cpu_count() or 1
+
+
+_conf: SystemConfig | None = None
+_conf_lock = threading.Lock()
+
+
+def get_system_config() -> SystemConfig:
+    global _conf
+    if _conf is None:
+        with _conf_lock:
+            if _conf is None:
+                _conf = SystemConfig()
+    return _conf

@@ -1,0 +1,64 @@
+"""Execution graphs (reference: include/faabric/util/ExecGraph.h:19-48,
+src/util/ExecGraph.cpp).
+
+Call trees are reconstructed from chained message ids recorded in planner
+results, exported as JSON via the planner REST API (GET_EXEC_GRAPH).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from faabric_tpu.proto import Message
+
+
+@dataclasses.dataclass
+class ExecGraphNode:
+    msg: Message
+    children: list["ExecGraphNode"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ExecGraph:
+    root: ExecGraphNode
+
+    def count_nodes(self) -> int:
+        def _count(node: ExecGraphNode) -> int:
+            return 1 + sum(_count(c) for c in node.children)
+
+        return _count(self.root)
+
+    def to_dict(self) -> dict[str, Any]:
+        def _node(n: ExecGraphNode) -> dict[str, Any]:
+            return {"msg": n.msg.to_dict(), "chained": [_node(c) for c in n.children]}
+
+        return {"root": _node(self.root)}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+def log_chained_function(parent: Message, chained_msg_id: int) -> None:
+    """Record a chained call on the parent message (reference ExecGraph.h:46)."""
+    if chained_msg_id not in parent.chained_msg_ids:
+        parent.chained_msg_ids.append(chained_msg_id)
+
+
+def get_chained_functions(msg: Message) -> list[int]:
+    return list(msg.chained_msg_ids)
+
+
+def build_exec_graph(get_result, root_msg_id: int, app_id: int) -> ExecGraph:
+    """Build the graph by following chained ids. ``get_result(app_id, msg_id)``
+    must return the result ``Message`` (the planner provides this)."""
+
+    def _build(msg_id: int) -> ExecGraphNode:
+        msg = get_result(app_id, msg_id)
+        node = ExecGraphNode(msg=msg)
+        for child_id in msg.chained_msg_ids:
+            node.children.append(_build(child_id))
+        return node
+
+    return ExecGraph(root=_build(root_msg_id))

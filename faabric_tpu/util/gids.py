@@ -1,0 +1,39 @@
+"""Process-unique id generation (reference: include/faabric/util/gids.h:6).
+
+Ids are unique within a cluster with high probability: a per-process random
+48-bit base plus a monotonically increasing counter, so they are also
+monotonic within a process (useful for seqnums and result ordering).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+
+_lock = threading.Lock()
+_base: int | None = None
+_counter = itertools.count(1)
+
+
+def _ensure_base() -> int:
+    global _base
+    if _base is None:
+        with _lock:
+            if _base is None:
+                _base = random.getrandbits(48) << 20
+    return _base
+
+
+def generate_gid() -> int:
+    """Return a process-unique positive integer id."""
+    base = _ensure_base()
+    return base + next(_counter)
+
+
+def reset_gids() -> None:
+    """Testing hook: re-randomise the base."""
+    global _base, _counter
+    with _lock:
+        _base = None
+        _counter = itertools.count(1)

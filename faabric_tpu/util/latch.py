@@ -1,0 +1,78 @@
+"""Latches, cyclic barriers and flag waiters.
+
+Reference: include/faabric/util/latch.h:11, barrier.h:11, locks.h:18.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+DEFAULT_LATCH_TIMEOUT = 10.0
+
+
+class LatchTimeoutException(Exception):
+    pass
+
+
+class Latch:
+    """Count-down latch: ``count`` parties call wait(); all are released when
+    the last arrives. Single-use."""
+
+    def __init__(self, count: int, timeout: float = DEFAULT_LATCH_TIMEOUT) -> None:
+        self.count = count
+        self.timeout = timeout
+        self._waiters = 0
+        self._cond = threading.Condition()
+
+    @classmethod
+    def create(cls, count: int, timeout: float = DEFAULT_LATCH_TIMEOUT) -> "Latch":
+        return cls(count, timeout)
+
+    def wait(self) -> None:
+        with self._cond:
+            self._waiters += 1
+            if self._waiters > self.count:
+                raise RuntimeError("Latch already used")
+            if self._waiters == self.count:
+                self._cond.notify_all()
+                return
+            if not self._cond.wait_for(lambda: self._waiters >= self.count, self.timeout):
+                raise LatchTimeoutException("Latch timed out")
+
+
+class Barrier:
+    """Cyclic barrier with optional completion function
+    (reference barrier.h: completion fn runs once per cycle)."""
+
+    def __init__(self, count: int, completion: Callable[[], None] | None = None,
+                 timeout: float = DEFAULT_LATCH_TIMEOUT) -> None:
+        self._barrier = threading.Barrier(count, action=completion, timeout=timeout)
+
+    def wait(self) -> None:
+        try:
+            self._barrier.wait()
+        except threading.BrokenBarrierError as e:
+            raise LatchTimeoutException("Barrier broken or timed out") from e
+
+
+class FlagWaiter:
+    """waitOnFlag/setFlag — used for PTP mapping readiness
+    (reference locks.h:18, PointToPointBroker.cpp:528-534)."""
+
+    def __init__(self, timeout: float = DEFAULT_LATCH_TIMEOUT) -> None:
+        self._event = threading.Event()
+        self.timeout = timeout
+
+    def wait_on_flag(self) -> None:
+        if not self._event.wait(self.timeout):
+            raise LatchTimeoutException("Timeout waiting on flag")
+
+    def set_flag(self, value: bool = True) -> None:
+        if value:
+            self._event.set()
+        else:
+            self._event.clear()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()

@@ -1,0 +1,28 @@
+"""Network helpers (reference: include/faabric/util/network.h)."""
+
+from __future__ import annotations
+
+import os
+import socket
+
+LOCALHOST = "127.0.0.1"
+
+
+def get_primary_ip_for_this_host() -> str:
+    override = os.environ.get("OVERRIDE_HOST_IP")
+    if override:
+        return override
+    try:
+        # UDP connect to a public address picks the primary interface without
+        # sending any packet.
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return LOCALHOST
+
+
+def get_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,167 @@
+"""Queue primitives (reference: include/faabric/util/queue.h:25-245).
+
+- Queue: mutex+condvar queue with timeout dequeue and drain.
+- FixedCapacityQueue: bounded SPSC-style circular buffer (the moodycamel
+  analog) — used for per-rank-pair MPI delivery.
+- SpinLockQueue: busy-wait dequeue for latency-critical paths (the
+  atomic_queue analog). In CPython a condvar wait has ~µs wakeup latency;
+  the spin variant polls a deque guarded by the GIL for lower latency at
+  the cost of a core.
+- TokenPool: bounded token claim/release.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class QueueTimeoutException(Exception):
+    pass
+
+
+class Queue(Generic[T]):
+    def __init__(self) -> None:
+        self._items: collections.deque[T] = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def enqueue(self, item: T) -> None:
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()
+
+    def dequeue(self, timeout: float | None = None) -> T:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._items:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise QueueTimeoutException("Timeout waiting for dequeue")
+                if not self._cond.wait(remaining):
+                    raise QueueTimeoutException("Timeout waiting for dequeue")
+            return self._items.popleft()
+
+    def try_dequeue(self) -> T | None:
+        with self._cond:
+            if self._items:
+                return self._items.popleft()
+            return None
+
+    def peek(self) -> T | None:
+        with self._cond:
+            return self._items[0] if self._items else None
+
+    def size(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def drain(self) -> list[T]:
+        with self._cond:
+            out = list(self._items)
+            self._items.clear()
+            return out
+
+
+class FixedCapacityQueue(Generic[T]):
+    """Bounded queue; enqueue blocks when full (backpressure)."""
+
+    def __init__(self, capacity: int) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._items: collections.deque[T] = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+
+    def enqueue(self, item: T, timeout: float | None = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while len(self._items) >= self.capacity:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise QueueTimeoutException("Timeout waiting to enqueue")
+                if not self._not_full.wait(remaining):
+                    raise QueueTimeoutException("Timeout waiting to enqueue")
+            self._items.append(item)
+            self._not_empty.notify()
+
+    def dequeue(self, timeout: float | None = None) -> T:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while not self._items:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise QueueTimeoutException("Timeout waiting for dequeue")
+                if not self._not_empty.wait(remaining):
+                    raise QueueTimeoutException("Timeout waiting for dequeue")
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class SpinLockQueue(Generic[T]):
+    """Low-latency queue: dequeue spins briefly before falling back to a
+    condvar wait (hybrid spin, so idle receivers don't burn a core forever).
+    """
+
+    SPIN_NS = 50_000  # 50us of spinning before sleeping
+
+    def __init__(self) -> None:
+        self._items: collections.deque[T] = collections.deque()
+        self._cond = threading.Condition()
+
+    def enqueue(self, item: T) -> None:
+        self._items.append(item)  # deque.append is atomic under the GIL
+        with self._cond:
+            self._cond.notify()
+
+    def dequeue(self, timeout: float | None = None) -> T:
+        end_spin = time.monotonic_ns() + self.SPIN_NS
+        while time.monotonic_ns() < end_spin:
+            try:
+                return self._items.popleft()
+            except IndexError:
+                pass
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                try:
+                    return self._items.popleft()
+                except IndexError:
+                    pass
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise QueueTimeoutException("Timeout waiting for dequeue")
+                self._cond.wait(remaining if remaining is None else min(remaining, 0.001))
+
+    def size(self) -> int:
+        return len(self._items)
+
+
+class TokenPool:
+    """Fixed pool of integer tokens (reference queue.h:245)."""
+
+    def __init__(self, n_tokens: int) -> None:
+        self._queue: Queue[int] = Queue()
+        self.size = n_tokens
+        for i in range(n_tokens):
+            self._queue.enqueue(i)
+
+    def get_token(self, timeout: float | None = None) -> int:
+        return self._queue.dequeue(timeout)
+
+    def release_token(self, token: int) -> None:
+        self._queue.enqueue(token)
+
+    def free_tokens(self) -> int:
+        return self._queue.size()

@@ -1,0 +1,29 @@
+"""Global test/mock switches (reference: include/faabric/util/testing.h:1-11).
+
+When mock mode is on, every RPC client records calls instead of sending over
+the network; tests assert on the recorded queues. This is the backbone of the
+reference's unit-test strategy (SURVEY.md §4.1) and is preserved here.
+"""
+
+from __future__ import annotations
+
+_test_mode = False
+_mock_mode = False
+
+
+def set_test_mode(value: bool) -> None:
+    global _test_mode
+    _test_mode = value
+
+
+def is_test_mode() -> bool:
+    return _test_mode
+
+
+def set_mock_mode(value: bool) -> None:
+    global _mock_mode
+    _mock_mode = value
+
+
+def is_mock_mode() -> bool:
+    return _mock_mode

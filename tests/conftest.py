@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+All tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path; bench.py runs on the real chip).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    """Reset global singletons between tests (the reference's fixture-reset
+    discipline, tests/utils/fixtures.h:55-250)."""
+    from faabric_tpu.util.config import get_system_config
+    from faabric_tpu.util.testing import set_mock_mode, set_test_mode
+    from faabric_tpu.transport.common import clear_host_aliases
+
+    set_test_mode(True)
+    yield
+    set_mock_mode(False)
+    set_test_mode(False)
+    clear_host_aliases()
+    get_system_config().reset()

@@ -1,0 +1,100 @@
+"""Message schema round-trip tests (reference: tests/test/proto/)."""
+
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    BatchExecuteRequestStatus,
+    BatchExecuteType,
+    Message,
+    PendingMigration,
+    PointToPointMapping,
+    PointToPointMappings,
+    batch_exec_factory,
+    func_to_string,
+    get_main_thread_snapshot_key,
+    is_batch_exec_request_valid,
+    message_factory,
+    message_from_json,
+    message_to_json,
+    update_batch_exec_app_id,
+    update_batch_exec_group_id,
+)
+
+
+def test_message_roundtrip():
+    msg = message_factory("demo", "echo")
+    msg.input_data = b"\x00\x01\xffhello"
+    msg.is_mpi = True
+    msg.mpi_world_size = 4
+    msg.exec_graph_details["k"] = "v"
+    msg.chained_msg_ids = [1, 2, 3]
+    restored = Message.from_dict(msg.to_dict())
+    assert restored == msg
+
+
+def test_message_json_roundtrip():
+    msg = message_factory("demo", "echo")
+    msg.output_data = bytes(range(256))
+    assert message_from_json(message_to_json(msg)) == msg
+
+
+def test_batch_factory():
+    req = batch_exec_factory("demo", "echo", 4)
+    assert req.n_messages() == 4
+    assert is_batch_exec_request_valid(req)
+    assert len({m.id for m in req.messages}) == 4
+    assert all(m.app_id == req.app_id for m in req.messages)
+    assert [m.app_idx for m in req.messages] == [0, 1, 2, 3]
+
+
+def test_batch_invalid():
+    assert not is_batch_exec_request_valid(None)
+    assert not is_batch_exec_request_valid(BatchExecuteRequest())
+    req = batch_exec_factory("demo", "echo", 0)
+    assert not is_batch_exec_request_valid(req)
+
+
+def test_batch_roundtrip():
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.THREADS)
+    req.snapshot_key = "snap"
+    restored = BatchExecuteRequest.from_dict(req.to_dict())
+    assert restored == req
+
+
+def test_update_ids():
+    req = batch_exec_factory("demo", "echo", 3)
+    update_batch_exec_app_id(req, 999)
+    update_batch_exec_group_id(req, 888)
+    assert req.app_id == 999
+    assert all(m.app_id == 999 and m.group_id == 888 for m in req.messages)
+
+
+def test_status_roundtrip():
+    s = BatchExecuteRequestStatus(app_id=1, finished=True, expected_num_messages=2)
+    s.message_results = [message_factory("a", "b")]
+    assert BatchExecuteRequestStatus.from_dict(s.to_dict()) == s
+
+
+def test_ptp_mappings_roundtrip():
+    m = PointToPointMappings(
+        app_id=1,
+        group_id=2,
+        mappings=[
+            PointToPointMapping(host="h1", message_id=10, app_idx=0, group_idx=0,
+                                mpi_port=8020, device_ids=[0, 1]),
+            PointToPointMapping(host="h2", message_id=11, app_idx=1, group_idx=1),
+        ],
+    )
+    assert PointToPointMappings.from_dict(m.to_dict()) == m
+
+
+def test_pending_migration_roundtrip():
+    pm = PendingMigration(app_id=1, group_id=2, group_idx=3, src_host="a", dst_host="b")
+    assert PendingMigration.from_dict(pm.to_dict()) == pm
+
+
+def test_func_helpers():
+    msg = message_factory("demo", "echo")
+    assert func_to_string(msg) == "demo/echo"
+    assert func_to_string(msg, include_id=True) == f"demo/echo:{msg.id}"
+    assert get_main_thread_snapshot_key(msg) == "main_demo_echo"

@@ -1,0 +1,184 @@
+"""Transport core tests (reference: tests/test/transport/)."""
+
+import socket
+import threading
+
+import pytest
+
+from faabric_tpu.transport.client import MessageEndpointClient, RpcError
+from faabric_tpu.transport.common import (
+    clear_host_aliases,
+    register_host_alias,
+    resolve_host,
+)
+from faabric_tpu.transport.message import (
+    MessageResponseCode,
+    TransportMessage,
+    recv_frame,
+    send_frame,
+)
+from faabric_tpu.transport.server import MessageEndpointServer
+from faabric_tpu.util.network import get_free_port
+from faabric_tpu.util.queues import Queue
+
+
+class EchoServer(MessageEndpointServer):
+    """Echoes sync requests; records async ones."""
+
+    def __init__(self, async_port, sync_port):
+        super().__init__(async_port, sync_port, label="echo", n_threads=2)
+        self.async_received: Queue[TransportMessage] = Queue()
+
+    def do_async_recv(self, msg):
+        self.async_received.enqueue(msg)
+
+    def do_sync_recv(self, msg):
+        return TransportMessage(
+            code=msg.code,
+            header={"echo": msg.header, "len": len(msg.payload)},
+            payload=msg.payload,
+        )
+
+
+@pytest.fixture()
+def echo_server():
+    async_port, sync_port = get_free_port(), get_free_port()
+    server = EchoServer(async_port, sync_port)
+    server.start()
+    client = MessageEndpointClient("127.0.0.1", async_port, sync_port, timeout=5.0)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    msg = TransportMessage(code=7, header={"x": 1}, payload=b"abc", seqnum=42)
+    send_frame(a, msg)
+    got = recv_frame(b)
+    assert got.code == 7
+    assert got.header == {"x": 1}
+    assert got.payload == b"abc"
+    assert got.seqnum == 42
+    a.close()
+    b.close()
+
+
+def test_frame_large_payload():
+    a, b = socket.socketpair()
+    payload = bytes(1024) * 1024  # 1 MiB
+    results = []
+    t = threading.Thread(target=lambda: results.append(recv_frame(b)))
+    t.start()
+    send_frame(a, TransportMessage(code=1, payload=payload))
+    t.join()
+    assert results[0].payload == payload
+    a.close()
+    b.close()
+
+
+def test_sync_send(echo_server):
+    _, client = echo_server
+    resp = client.sync_send(5, header={"hello": "world"}, payload=b"data")
+    assert resp.header["echo"] == {"hello": "world"}
+    assert resp.header["len"] == 4
+    assert resp.payload == b"data"
+    assert resp.response_code == int(MessageResponseCode.SUCCESS)
+
+
+def test_async_send(echo_server):
+    server, client = echo_server
+    client.async_send(9, header={"n": 1}, payload=b"x")
+    got = server.async_received.dequeue(timeout=2.0)
+    assert got.code == 9
+    assert got.header == {"n": 1}
+
+
+def test_many_sync_sends(echo_server):
+    _, client = echo_server
+    for i in range(50):
+        resp = client.sync_send(1, header={"i": i})
+        assert resp.header["echo"]["i"] == i
+
+
+def test_concurrent_clients(echo_server):
+    server, _ = echo_server
+    errors = []
+
+    def worker(n):
+        c = MessageEndpointClient("127.0.0.1", server.async_port, server.sync_port,
+                                  timeout=5.0)
+        try:
+            for i in range(20):
+                resp = c.sync_send(1, header={"w": n, "i": i})
+                assert resp.header["echo"] == {"w": n, "i": i}
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_error_propagation(echo_server):
+    server, client = echo_server
+
+    def boom(msg):
+        raise ValueError("deliberate")
+
+    server.do_sync_recv = boom
+    with pytest.raises(RpcError, match="deliberate"):
+        client.sync_send(1)
+
+
+def test_request_latch(echo_server):
+    server, client = echo_server
+    server.set_request_latch()
+    client.async_send(2, header={})
+    server.await_request_latch()
+    assert server.async_received.size() == 1
+
+
+def test_server_restart():
+    async_port, sync_port = get_free_port(), get_free_port()
+    server = EchoServer(async_port, sync_port)
+    server.start()
+    server.stop()
+    server2 = EchoServer(async_port, sync_port)
+    server2.start()
+    client = MessageEndpointClient("127.0.0.1", async_port, sync_port, timeout=5.0)
+    assert client.sync_send(1).response_code == 0
+    client.close()
+    server2.stop()
+
+
+def test_host_alias():
+    clear_host_aliases()
+    register_host_alias("fake-host", "127.0.0.1", 100)
+    assert resolve_host("fake-host", 8005) == ("127.0.0.1", 8105)
+    assert resolve_host("other", 8005) == ("other", 8005)
+    clear_host_aliases()
+    assert resolve_host("fake-host", 8005) == ("fake-host", 8005)
+
+
+def test_alias_dial():
+    """A client dialing a logical host reaches the aliased port."""
+    async_port, sync_port = get_free_port(), get_free_port()
+    server = EchoServer(async_port, sync_port)
+    server.start()
+    register_host_alias("worker-b", "127.0.0.1", 0)
+    # alias maps worker-b directly onto our ports via offset 0 then override
+    clear_host_aliases()
+    register_host_alias("worker-b", "127.0.0.1", async_port - 8005)
+    client = MessageEndpointClient("worker-b", 8005, 8005 + (sync_port - async_port))
+    # crude check: resolve works; full-path dial exercised in scheduler tests
+    ip, port = resolve_host("worker-b", 8005)
+    assert (ip, port) == ("127.0.0.1", async_port)
+    client.close()
+    server.stop()
+    clear_host_aliases()

@@ -1,0 +1,224 @@
+"""Util substrate tests (reference coverage: tests/test/util/*)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from faabric_tpu.util.concurrent_map import ConcurrentMap
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.gids import generate_gid, reset_gids
+from faabric_tpu.util.latch import Barrier, FlagWaiter, Latch, LatchTimeoutException
+from faabric_tpu.util.queues import (
+    FixedCapacityQueue,
+    Queue,
+    QueueTimeoutException,
+    SpinLockQueue,
+    TokenPool,
+)
+
+
+class TestConfig:
+    def test_defaults(self):
+        conf = get_system_config()
+        conf.reset()
+        assert conf.batch_scheduler_mode == "bin-pack"
+        assert conf.state_mode == "inmemory"
+        assert conf.global_message_timeout == 60.0
+        assert conf.planner_port == 8011
+
+    def test_env_override_and_reset(self):
+        conf = get_system_config()
+        os.environ["BATCH_SCHEDULER_MODE"] = "spot"
+        os.environ["OVERRIDE_CPU_COUNT"] = "3"
+        try:
+            conf.reset()
+            assert conf.batch_scheduler_mode == "spot"
+            assert conf.get_usable_cores() == 3
+        finally:
+            del os.environ["BATCH_SCHEDULER_MODE"]
+            del os.environ["OVERRIDE_CPU_COUNT"]
+            conf.reset()
+        assert conf.batch_scheduler_mode == "bin-pack"
+
+    def test_print(self):
+        out = get_system_config().print()
+        assert "batch_scheduler_mode" in out
+
+
+class TestGids:
+    def test_unique_and_monotonic(self):
+        ids = [generate_gid() for _ in range(1000)]
+        assert len(set(ids)) == 1000
+        assert ids == sorted(ids)
+        assert all(i > 0 for i in ids)
+
+    def test_threaded_unique(self):
+        out: list[int] = []
+        lock = threading.Lock()
+
+        def gen():
+            local = [generate_gid() for _ in range(200)]
+            with lock:
+                out.extend(local)
+
+        threads = [threading.Thread(target=gen) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(out)) == 1600
+
+    def test_reset(self):
+        a = generate_gid()
+        reset_gids()
+        b = generate_gid()
+        assert a != b
+
+
+class TestQueues:
+    def test_queue_fifo(self):
+        q: Queue[int] = Queue()
+        for i in range(10):
+            q.enqueue(i)
+        assert [q.dequeue() for _ in range(10)] == list(range(10))
+
+    def test_queue_timeout(self):
+        q: Queue[int] = Queue()
+        with pytest.raises(QueueTimeoutException):
+            q.dequeue(timeout=0.05)
+
+    def test_queue_cross_thread(self):
+        q: Queue[int] = Queue()
+
+        def producer():
+            time.sleep(0.02)
+            q.enqueue(42)
+
+        threading.Thread(target=producer).start()
+        assert q.dequeue(timeout=1.0) == 42
+
+    def test_queue_drain(self):
+        q: Queue[int] = Queue()
+        q.enqueue(1)
+        q.enqueue(2)
+        assert q.drain() == [1, 2]
+        assert q.size() == 0
+
+    def test_fixed_capacity_blocks(self):
+        q: FixedCapacityQueue[int] = FixedCapacityQueue(2)
+        q.enqueue(1)
+        q.enqueue(2)
+        with pytest.raises(QueueTimeoutException):
+            q.enqueue(3, timeout=0.05)
+        assert q.dequeue() == 1
+        q.enqueue(3)
+        assert q.dequeue() == 2
+        assert q.dequeue() == 3
+
+    def test_spinlock_queue(self):
+        q: SpinLockQueue[bytes] = SpinLockQueue()
+        q.enqueue(b"x")
+        assert q.dequeue() == b"x"
+        with pytest.raises(QueueTimeoutException):
+            q.dequeue(timeout=0.05)
+
+    def test_spinlock_queue_cross_thread(self):
+        q: SpinLockQueue[int] = SpinLockQueue()
+        results = []
+
+        def consumer():
+            results.append(q.dequeue(timeout=2.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        q.enqueue(7)
+        t.join()
+        assert results == [7]
+
+    def test_token_pool(self):
+        pool = TokenPool(3)
+        t1 = pool.get_token()
+        t2 = pool.get_token()
+        assert pool.free_tokens() == 1
+        pool.release_token(t1)
+        pool.release_token(t2)
+        assert pool.free_tokens() == 3
+
+
+class TestLatch:
+    def test_latch_pair(self):
+        latch = Latch.create(2)
+        t = threading.Thread(target=latch.wait)
+        t.start()
+        latch.wait()
+        t.join()
+
+    def test_latch_timeout(self):
+        latch = Latch.create(2, timeout=0.05)
+        with pytest.raises(LatchTimeoutException):
+            latch.wait()
+
+    def test_barrier_cyclic_with_completion(self):
+        hits = []
+        barrier = Barrier(3, completion=lambda: hits.append(1))
+
+        def work():
+            barrier.wait()
+            barrier.wait()
+
+        threads = [threading.Thread(target=work) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert hits == [1, 1]
+
+    def test_flag_waiter(self):
+        fw = FlagWaiter(timeout=1.0)
+
+        def setter():
+            time.sleep(0.02)
+            fw.set_flag()
+
+        threading.Thread(target=setter).start()
+        fw.wait_on_flag()
+        assert fw.is_set()
+
+    def test_flag_waiter_timeout(self):
+        fw = FlagWaiter(timeout=0.05)
+        with pytest.raises(LatchTimeoutException):
+            fw.wait_on_flag()
+
+
+class TestConcurrentMap:
+    def test_basic(self):
+        m: ConcurrentMap[str, int] = ConcurrentMap()
+        m.insert("a", 1)
+        assert m.get("a") == 1
+        assert "a" in m
+        assert m.get("b") is None
+        m.erase("a")
+        assert m.get("a") is None
+
+    def test_try_emplace(self):
+        m: ConcurrentMap[str, list] = ConcurrentMap()
+        v1, inserted1 = m.try_emplace("k", list)
+        v2, inserted2 = m.try_emplace("k", list)
+        assert inserted1 and not inserted2
+        assert v1 is v2
+
+    def test_emplace_then_mutate_atomic(self):
+        m: ConcurrentMap[str, list] = ConcurrentMap()
+
+        def add():
+            for _ in range(100):
+                m.try_emplace_then_mutate("k", list, lambda v: v.append(1))
+
+        threads = [threading.Thread(target=add) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(m.get("k")) == 400
