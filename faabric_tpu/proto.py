@@ -324,8 +324,11 @@ def func_to_string(msg: Message, include_id: bool = False) -> str:
 
 
 def get_main_thread_snapshot_key(msg: Message) -> str:
-    # reference func.h:57
-    return f"main_{msg.user}_{msg.function}"
+    # reference src/util/func.cpp:152 — key must include the app id so two
+    # concurrent apps of the same function never share a main-thread snapshot
+    if msg.app_id <= 0:
+        raise ValueError(f"Invalid app id for snapshot key: {msg.app_id}")
+    return f"{msg.user}/{msg.function}_{msg.app_id}"
 
 
 def is_batch_exec_request_valid(req: BatchExecuteRequest | None) -> bool:
@@ -354,3 +357,78 @@ def message_to_json(msg: Message) -> str:
 
 def message_from_json(s: str) -> Message:
     return Message.from_dict(json.loads(s))
+
+
+# ---------------------------------------------------------------------------
+# Wire form: binary-tail payload convention.
+#
+# Hex-in-JSON (to_dict/from_dict) is reserved for the human-facing REST
+# surface. RPC transport uses these helpers instead: message control fields
+# travel as JSON, while input/output payloads are concatenated into the
+# transport frame's binary tail (the flatbuffers analog, src/flat/faabric.fbs)
+# so bulk data never passes through JSON.
+# ---------------------------------------------------------------------------
+
+def messages_to_wire(msgs: list[Message]) -> tuple[list[dict[str, Any]], bytes]:
+    tail = bytearray()
+    dicts: list[dict[str, Any]] = []
+    for m in msgs:
+        d = dataclasses.asdict(m)
+        d["input_data"] = len(m.input_data)
+        d["output_data"] = len(m.output_data)
+        tail += m.input_data
+        tail += m.output_data
+        dicts.append(d)
+    return dicts, bytes(tail)
+
+
+def messages_from_wire(dicts: list[dict[str, Any]], tail: bytes) -> list[Message]:
+    field_names = {f.name for f in dataclasses.fields(Message)}
+    msgs: list[Message] = []
+    off = 0
+    for d in dicts:
+        d = dict(d)
+        in_len = int(d.get("input_data", 0))
+        out_len = int(d.get("output_data", 0))
+        if in_len < 0 or out_len < 0 or off + in_len + out_len > len(tail):
+            raise ValueError(
+                f"Wire message payload lengths ({in_len}, {out_len}) do not "
+                f"fit the binary tail (offset {off}, tail {len(tail)})"
+            )
+        d["input_data"] = tail[off:off + in_len]
+        off += in_len
+        d["output_data"] = tail[off:off + out_len]
+        off += out_len
+        msgs.append(Message(**{k: v for k, v in d.items() if k in field_names}))
+    if off != len(tail):
+        raise ValueError(f"Binary tail has {len(tail) - off} trailing bytes")
+    return msgs
+
+
+def ber_to_wire(req: BatchExecuteRequest) -> tuple[dict[str, Any], bytes]:
+    # Build the header directly — req.to_dict() would hex-encode every
+    # payload only for it to be discarded, which is exactly what the binary
+    # tail exists to avoid.
+    msg_dicts, tail = messages_to_wire(req.messages)
+    header = {
+        "app_id": req.app_id,
+        "group_id": req.group_id,
+        "user": req.user,
+        "function": req.function,
+        "type": req.type,
+        "messages": msg_dicts,
+        "single_host_hint": req.single_host_hint,
+        "single_host": req.single_host,
+        "elastic_scale_hint": req.elastic_scale_hint,
+        "snapshot_key": req.snapshot_key,
+        "evicted_host": req.evicted_host,
+    }
+    return header, tail
+
+
+def ber_from_wire(header: dict[str, Any], tail: bytes) -> BatchExecuteRequest:
+    d = dict(header)
+    msg_dicts = d.pop("messages", [])
+    req = BatchExecuteRequest.from_dict({**d, "messages": []})
+    req.messages = messages_from_wire(msg_dicts, tail)
+    return req

@@ -16,6 +16,7 @@ from typing import Any
 from faabric_tpu.transport.common import DEFAULT_SOCKET_TIMEOUT, resolve_host
 from faabric_tpu.transport.message import (
     MessageResponseCode,
+    TransportError,
     TransportMessage,
     recv_frame,
     send_frame,
@@ -69,7 +70,7 @@ class MessageEndpointClient:
                 try:
                     send_frame(self._get_sock("async"), msg)
                     return
-                except OSError as e:
+                except (OSError, TransportError) as e:
                     self._reset_sock("async")
                     if attempt == 1:
                         raise RpcError(
@@ -78,17 +79,28 @@ class MessageEndpointClient:
 
     def sync_send(self, code: int, header: dict[str, Any] | None = None,
                   payload: bytes = b"") -> TransportMessage:
+        """Send a request and await its response.
+
+        Retry discipline: a failure while dialing or while *sending* (the
+        classic stale keep-alive socket fails on the first write) is retried
+        once on a fresh connection — the request cannot have been executed.
+        Once the request has been fully sent, a failure (e.g. recv timeout)
+        is NOT retried: the server may already have run a non-idempotent
+        RPC, so the error surfaces to the caller.
+        """
         msg = TransportMessage(code=code, header=header or {}, payload=payload)
         with self._locks["sync"]:
             for attempt in (0, 1):
+                sent = False
                 try:
                     sock = self._get_sock("sync")
                     send_frame(sock, msg)
+                    sent = True
                     resp = recv_frame(sock)
                     break
-                except OSError as e:
+                except (OSError, TransportError) as e:
                     self._reset_sock("sync")
-                    if attempt == 1:
+                    if attempt == 1 or sent:
                         raise RpcError(
                             f"sync send to {self.host}:{self.sync_port} failed: {e}"
                         ) from e

@@ -30,6 +30,13 @@ SHUTDOWN_PAYLOAD = b"\x00\x00\x42\x99"
 
 NO_SEQUENCE_NUM = -1
 
+# Sanity bounds on incoming frames: a corrupt/hostile frame with valid magic
+# must not trigger a multi-GB allocation. The JSON control section is small
+# by design (bulk data rides the binary tail); the tail is bounded at 8 GiB
+# (largest legitimate payloads are snapshot contents / MPI buffers).
+MAX_JSON_LEN = 64 * 1024 * 1024
+MAX_BIN_LEN = 8 * 1024 * 1024 * 1024
+
 
 class MessageResponseCode(enum.IntEnum):
     SUCCESS = 0
@@ -102,6 +109,10 @@ def recv_frame(sock: socket.socket) -> TransportMessage:
     magic, code, resp, seqnum, json_len, bin_len = struct.unpack(HEADER_FMT, head)
     if magic != MAGIC:
         raise TransportError(f"Bad frame magic: {magic:#x}")
+    if json_len > MAX_JSON_LEN or bin_len > MAX_BIN_LEN:
+        raise TransportError(
+            f"Frame exceeds size bounds (json={json_len}, bin={bin_len})"
+        )
     header_json = _recv_exact(sock, json_len)
     payload = _recv_exact(sock, bin_len)
     header = json.loads(header_json) if header_json else {}

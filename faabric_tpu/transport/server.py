@@ -21,8 +21,8 @@ import threading
 from typing import Any
 
 from faabric_tpu.transport.message import (
-    ConnectionClosed,
     MessageResponseCode,
+    TransportError,
     TransportMessage,
     recv_frame,
     send_frame,
@@ -53,6 +53,8 @@ class MessageEndpointServer:
         self._sync_listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._conn_threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conn_lock = threading.Lock()
         self._running = False
         self._work: Queue[tuple[TransportMessage, socket.socket | None]] = Queue()
         self._request_latch: Latch | None = None
@@ -113,10 +115,24 @@ class MessageEndpointServer:
                     listener.close()
                 except OSError:
                     pass
+        # Wake connection readers blocked in recv_frame: shut their sockets
+        # down so they fail fast instead of holding the connection until the
+        # client's timeout.
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         for t in self._threads:
+            t.join(timeout=2.0)
+        for t in self._conn_threads:
             t.join(timeout=2.0)
         self._threads.clear()
         self._conn_threads.clear()
+        with self._conn_lock:
+            self._conns.clear()
         logger.debug("%s stopped", self.label)
 
     # ------------------------------------------------------------------
@@ -164,15 +180,20 @@ class MessageEndpointServer:
                 target=self._conn_loop, args=(conn, plane),
                 name=f"{self.label}-{plane}-conn", daemon=True,
             )
+            with self._conn_lock:
+                self._conns.add(conn)
+                # Prune finished reader threads so the list stays bounded on
+                # long-lived servers with connection churn.
+                self._conn_threads = [x for x in self._conn_threads if x.is_alive()]
+                self._conn_threads.append(t)
             t.start()
-            self._conn_threads.append(t)
 
     def _conn_loop(self, conn: socket.socket, plane: str) -> None:
         try:
             while self._running:
                 try:
                     msg = recv_frame(conn)
-                except (ConnectionClosed, OSError):
+                except (TransportError, OSError):
                     break
                 if msg.is_shutdown():
                     break
@@ -184,6 +205,8 @@ class MessageEndpointServer:
                     # pipelining from one client connection.
                     self._handle_sync(msg, conn)
         finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:

@@ -78,8 +78,15 @@ class SystemConfig:
     # Device / mesh
     mesh_device_kind: str = "auto"  # auto | tpu | cpu
 
-    def __post_init__(self) -> None:
-        self.reset()
+    @classmethod
+    def from_env(cls) -> "SystemConfig":
+        """Build a config populated from the environment. A plain
+        ``SystemConfig(...)`` keeps its constructor arguments / dataclass
+        defaults untouched (explicit kwargs are never silently overwritten
+        by the environment)."""
+        conf = cls()
+        conf.reset()
+        return conf
 
     def reset(self) -> None:
         """Re-read every knob from the environment."""
@@ -144,5 +151,5 @@ def get_system_config() -> SystemConfig:
     if _conf is None:
         with _conf_lock:
             if _conf is None:
-                _conf = SystemConfig()
+                _conf = SystemConfig.from_env()
     return _conf

@@ -1,0 +1,56 @@
+"""Periodic background work (reference:
+include/faabric/util/PeriodicBackgroundThread.h).
+
+Base for the scheduler's executor reaper and the planner keep-alive thread:
+``start(interval)`` runs ``do_work()`` every interval seconds until
+``stop()``; stop wakes the sleeper immediately via an event rather than
+waiting out the interval.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PeriodicBackgroundThread:
+    def __init__(self) -> None:
+        self._thread: threading.Thread | None = None
+        self._stop_event = threading.Event()
+        self.interval: float = 0.0
+
+    # Virtual — subclasses implement the periodic work.
+    def do_work(self) -> None:
+        raise NotImplementedError
+
+    # Optional hook run on stop (reference tidyUp()).
+    def tidy_up(self) -> None:
+        pass
+
+    def start(self, interval_seconds: float) -> None:
+        if self._thread is not None:
+            return
+        self.interval = interval_seconds
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{type(self).__name__}-periodic", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop_event.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self.tidy_up()
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.interval):
+            try:
+                self.do_work()
+            except Exception:  # noqa: BLE001 — periodic work must not die
+                logger.exception("%s periodic work failed", type(self).__name__)

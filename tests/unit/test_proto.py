@@ -1,5 +1,6 @@
 """Message schema round-trip tests (reference: tests/test/proto/)."""
 
+import pytest
 from faabric_tpu.proto import (
     BatchExecuteRequest,
     BatchExecuteRequestStatus,
@@ -97,4 +98,31 @@ def test_func_helpers():
     msg = message_factory("demo", "echo")
     assert func_to_string(msg) == "demo/echo"
     assert func_to_string(msg, include_id=True) == f"demo/echo:{msg.id}"
-    assert get_main_thread_snapshot_key(msg) == "main_demo_echo"
+    # Key includes the app id (reference src/util/func.cpp:152) so concurrent
+    # apps of the same function never collide.
+    assert get_main_thread_snapshot_key(msg) == f"demo/echo_{msg.app_id}"
+    msg.app_id = 0
+    with pytest.raises(ValueError):
+        get_main_thread_snapshot_key(msg)
+
+
+def test_ber_wire_roundtrip_binary_tail():
+    """Bulk payloads travel in the binary tail, not hex-in-JSON."""
+    import json as _json
+
+    from faabric_tpu.proto import batch_exec_factory, ber_from_wire, ber_to_wire
+
+    req = batch_exec_factory("demo", "echo", 3)
+    req.messages[0].input_data = b"\x00\x01\x02" * 100
+    req.messages[1].input_data = b"hello"
+    req.messages[2].output_data = b"\xff" * 64
+    header, tail = ber_to_wire(req)
+    # Header must be JSON-serialisable and carry only payload lengths.
+    _json.dumps(header)
+    assert header["messages"][0]["input_data"] == 300
+    assert header["messages"][2]["output_data"] == 64
+    assert len(tail) == 300 + 5 + 64
+    out = ber_from_wire(header, tail)
+    assert out.app_id == req.app_id
+    assert [m.input_data for m in out.messages] == [m.input_data for m in req.messages]
+    assert [m.output_data for m in out.messages] == [m.output_data for m in req.messages]

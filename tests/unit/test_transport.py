@@ -2,6 +2,7 @@
 
 import socket
 import threading
+import time
 
 import pytest
 
@@ -182,3 +183,51 @@ def test_alias_dial():
     client.close()
     server.stop()
     clear_host_aliases()
+
+
+def test_recv_frame_rejects_oversized_frames():
+    """A corrupt frame with valid magic must not trigger a huge allocation."""
+    import socket as _socket
+    import struct
+
+    from faabric_tpu.transport.message import (
+        HEADER_FMT,
+        MAGIC,
+        TransportError,
+        recv_frame,
+    )
+
+    a, b = _socket.socketpair()
+    try:
+        head = struct.pack(HEADER_FMT, MAGIC, 1, 0, -1, 10, 2**48)
+        a.sendall(head)
+        with pytest.raises(TransportError):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_periodic_background_thread():
+    import threading
+
+    from faabric_tpu.util.periodic import PeriodicBackgroundThread
+
+    class Counter(PeriodicBackgroundThread):
+        def __init__(self):
+            super().__init__()
+            self.count = 0
+            self.fired = threading.Event()
+
+        def do_work(self):
+            self.count += 1
+            if self.count >= 2:
+                self.fired.set()
+
+    c = Counter()
+    c.start(0.01)
+    assert c.fired.wait(2.0)
+    c.stop()
+    n = c.count
+    time.sleep(0.05)
+    assert c.count == n  # no work after stop
