@@ -1,0 +1,84 @@
+"""Bin-pack policy (reference src/batch-scheduler/BinPackScheduler.cpp).
+
+NEW: fill hosts in decreasing order of free capacity. SCALE_CHANGE: co-locate
+with the app's existing placement first. DIST_CHANGE: re-schedule from
+scratch (app's slots virtually freed) and migrate only if the placement
+spans fewer hosts or cuts cross-host links.
+"""
+
+from __future__ import annotations
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.batch_scheduler.scheduler import (
+    BatchScheduler,
+    DecisionType,
+    HostMap,
+    HostState,
+    InFlightReqs,
+)
+from faabric_tpu.proto import BatchExecuteRequest
+
+
+def sort_hosts_larger_first(hosts: list[HostState]) -> list[HostState]:
+    # Free slots desc, total slots desc, ip desc
+    # (reference BinPackScheduler.cpp isFirstHostLarger).
+    return sorted(hosts, key=lambda h: (h.available, h.slots, h.ip), reverse=True)
+
+
+def sort_hosts_by_app_freq(hosts: list[HostState],
+                           freq: dict[str, int]) -> list[HostState]:
+    # App placement count desc first, then the NEW criteria
+    # (reference isFirstHostLargerWithFreq).
+    return sorted(
+        hosts,
+        key=lambda h: (freq.get(h.ip, 0), h.available, h.slots, h.ip),
+        reverse=True,
+    )
+
+
+def locality_score(decision: SchedulingDecision) -> tuple[int, int]:
+    """(number of hosts, cross-host links in the fully-connected rank graph)
+    — reference BinPackScheduler.cpp:97-148. On TPU the cross-host links are
+    the collective hops that leave the ICI domain and ride DCN, which is why
+    fewer is strictly better."""
+    freq = decision.host_freq_count()
+    if len(freq) <= 1:
+        return (len(freq), 0)
+    total = sum(freq.values())
+    # Each message has an edge to every message on a different host; halve
+    # the double count.
+    cross = sum(n * (total - n) for n in freq.values()) // 2
+    return (len(freq), cross)
+
+
+class BinPackScheduler(BatchScheduler):
+    def get_sorted_hosts(self, host_map: HostMap, in_flight: InFlightReqs,
+                         req: BatchExecuteRequest,
+                         decision_type: DecisionType) -> list[HostState]:
+        hosts = list(host_map.values())
+        if decision_type == DecisionType.NEW:
+            return sort_hosts_larger_first(hosts)
+
+        old_decision = in_flight[req.app_id][1]
+        freq = old_decision.host_freq_count()
+
+        if decision_type == DecisionType.SCALE_CHANGE:
+            return sort_hosts_by_app_freq(hosts, freq)
+
+        # DIST_CHANGE: give the app a fresh shot — free its current slots,
+        # then sort by free capacity, breaking ties toward hosts already
+        # running the app (minimises migrations on a tie).
+        for h in hosts:
+            if h.ip in freq:
+                h.free(freq[h.ip])
+        return sorted(
+            hosts,
+            key=lambda h: (h.available, freq.get(h.ip, 0), h.slots, h.ip),
+            reverse=True,
+        )
+
+    def is_first_decision_better(self, host_map: HostMap,
+                                 decision_a: SchedulingDecision,
+                                 decision_b: SchedulingDecision) -> bool:
+        # Fewer hosts wins; tie broken by fewer cross-host links.
+        return locality_score(decision_a) < locality_score(decision_b)

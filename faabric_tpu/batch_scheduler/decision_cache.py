@@ -1,0 +1,68 @@
+"""Cache of scheduling decisions for repeated fork-join shapes.
+
+Reference analog: include/faabric/batch-scheduler/DecisionCache.h:14-33.
+Keyed by (user, function, message count): a runtime that forks the same
+N-wide THREADS batch repeatedly reuses the group id and host placement
+instead of re-planning every time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from faabric_tpu.proto import BatchExecuteRequest
+
+
+class CachedDecision:
+    def __init__(self, hosts: list[str], group_id: int) -> None:
+        self._hosts = hosts
+        self._group_id = group_id
+
+    @property
+    def hosts(self) -> list[str]:
+        return list(self._hosts)
+
+    @property
+    def group_id(self) -> int:
+        return self._group_id
+
+
+class DecisionCache:
+    def __init__(self) -> None:
+        self._cache: dict[str, CachedDecision] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(req: BatchExecuteRequest) -> str:
+        return f"{req.user}/{req.function}:{req.n_messages()}"
+
+    def get_cached_decision(self, req: BatchExecuteRequest) -> Optional[CachedDecision]:
+        with self._lock:
+            return self._cache.get(self._key(req))
+
+    def add_cached_decision(self, req: BatchExecuteRequest, hosts: list[str],
+                            group_id: int) -> None:
+        if len(hosts) != req.n_messages():
+            raise ValueError(
+                f"Cached hosts ({len(hosts)}) != messages ({req.n_messages()})"
+            )
+        with self._lock:
+            self._cache[self._key(req)] = CachedDecision(hosts, group_id)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+
+_cache: Optional[DecisionCache] = None
+_cache_lock = threading.Lock()
+
+
+def get_decision_cache() -> DecisionCache:
+    global _cache
+    if _cache is None:
+        with _cache_lock:
+            if _cache is None:
+                _cache = DecisionCache()
+    return _cache

@@ -128,6 +128,9 @@ class BatchExecuteRequest:
     user: str = ""
     function: str = ""
     type: int = int(BatchExecuteType.FUNCTIONS)
+    # Tenant/user tag for multi-tenant scheduling (reference wedges this into
+    # the protobuf subtype field; CompactScheduler.cpp filterHosts).
+    subtype: int = 0
     messages: list[Message] = dataclasses.field(default_factory=list)
 
     # Single-host optimisations
@@ -153,6 +156,7 @@ class BatchExecuteRequest:
             "user": self.user,
             "function": self.function,
             "type": self.type,
+            "subtype": self.subtype,
             "messages": [m.to_dict() for m in self.messages],
             "single_host_hint": self.single_host_hint,
             "single_host": self.single_host,
@@ -169,6 +173,7 @@ class BatchExecuteRequest:
             user=d.get("user", ""),
             function=d.get("function", ""),
             type=d.get("type", 0),
+            subtype=d.get("subtype", 0),
             single_host_hint=d.get("single_host_hint", False),
             single_host=d.get("single_host", False),
             elastic_scale_hint=d.get("elastic_scale_hint", False),
@@ -416,6 +421,7 @@ def ber_to_wire(req: BatchExecuteRequest) -> tuple[dict[str, Any], bytes]:
         "user": req.user,
         "function": req.function,
         "type": req.type,
+        "subtype": req.subtype,
         "messages": msg_dicts,
         "single_host_hint": req.single_host_hint,
         "single_host": req.single_host,

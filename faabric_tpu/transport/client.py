@@ -81,16 +81,21 @@ class MessageEndpointClient:
                   payload: bytes = b"") -> TransportMessage:
         """Send a request and await its response.
 
-        Retry discipline: a failure while dialing or while *sending* (the
-        classic stale keep-alive socket fails on the first write) is retried
-        once on a fresh connection — the request cannot have been executed.
-        Once the request has been fully sent, a failure (e.g. recv timeout)
-        is NOT retried: the server may already have run a non-idempotent
-        RPC, so the error surfaces to the caller.
+        Retry discipline (at-most-once for non-idempotent RPCs):
+        - Failure while dialing or sending → retry once on a fresh
+          connection; the request cannot have been executed.
+        - Failure after send on a REUSED keep-alive connection with zero
+          response bytes read → retry once. On TCP a stale socket usually
+          accepts the send into the kernel buffer and only fails at recv
+          with a reset, so this is the common server-restart signature.
+        - Failure after send on a FRESH connection, or after response bytes
+          arrived, or a recv timeout → surface the error; the server may
+          already have run the RPC.
         """
         msg = TransportMessage(code=code, header=header or {}, payload=payload)
         with self._locks["sync"]:
             for attempt in (0, 1):
+                fresh = self._socks["sync"] is None
                 sent = False
                 try:
                     sock = self._get_sock("sync")
@@ -100,7 +105,12 @@ class MessageEndpointClient:
                     break
                 except (OSError, TransportError) as e:
                     self._reset_sock("sync")
-                    if attempt == 1 or sent:
+                    likely_stale = (
+                        not fresh
+                        and not isinstance(e, socket.timeout)
+                        and getattr(e, "no_response_data", False)
+                    )
+                    if attempt == 1 or (sent and not likely_stale):
                         raise RpcError(
                             f"sync send to {self.host}:{self.sync_port} failed: {e}"
                         ) from e

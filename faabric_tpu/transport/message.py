@@ -96,16 +96,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            raise ConnectionClosed("Socket closed mid-frame")
-        got += r
+    try:
+        while got < n:
+            r = sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise ConnectionClosed("Socket closed mid-frame")
+            got += r
+    except (ConnectionClosed, OSError) as e:
+        e.bytes_read = got  # type: ignore[attr-defined]
+        raise
     return bytes(buf)
 
 
 def recv_frame(sock: socket.socket) -> TransportMessage:
-    head = _recv_exact(sock, HEADER_LEN)
+    try:
+        head = _recv_exact(sock, HEADER_LEN)
+    except (ConnectionClosed, OSError) as e:
+        # Nothing of the response arrived: lets callers distinguish a stale
+        # keep-alive connection (safe to retry the request on a fresh dial)
+        # from a connection dropped mid-response.
+        if getattr(e, "bytes_read", 1) == 0:
+            e.no_response_data = True  # type: ignore[attr-defined]
+        raise
     magic, code, resp, seqnum, json_len, bin_len = struct.unpack(HEADER_FMT, head)
     if magic != MAGIC:
         raise TransportError(f"Bad frame magic: {magic:#x}")

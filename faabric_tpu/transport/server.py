@@ -21,6 +21,7 @@ import threading
 from typing import Any
 
 from faabric_tpu.transport.message import (
+    ConnectionClosed,
     MessageResponseCode,
     TransportError,
     TransportMessage,
@@ -193,7 +194,16 @@ class MessageEndpointServer:
             while self._running:
                 try:
                     msg = recv_frame(conn)
-                except (TransportError, OSError):
+                except ConnectionClosed:
+                    break
+                except (TransportError, OSError) as e:
+                    # Protocol violations (bad magic, oversized frame) must
+                    # be diagnosable, not silently dropped.
+                    if isinstance(e, TransportError):
+                        logger.warning(
+                            "%s dropping %s connection on bad frame: %s",
+                            self.label, plane, e,
+                        )
                     break
                 if msg.is_shutdown():
                     break

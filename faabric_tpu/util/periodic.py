@@ -45,6 +45,16 @@ class PeriodicBackgroundThread:
             return
         self._stop_event.set()
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # do_work is stuck; keep the thread registered (a later start()
+            # is a no-op) and skip tidy_up, which could release resources
+            # the stuck work is still using. The stop event stays set, so
+            # the loop exits as soon as do_work returns.
+            logger.warning(
+                "%s did not stop within timeout; leaving thread to drain",
+                type(self).__name__,
+            )
+            return
         self._thread = None
         self.tidy_up()
 

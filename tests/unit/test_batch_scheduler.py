@@ -1,0 +1,320 @@
+"""Batch-scheduler policy tests (reference: tests/test/batch-scheduler/)."""
+
+import pytest
+
+from faabric_tpu.batch_scheduler import (
+    BinPackScheduler,
+    CompactScheduler,
+    DecisionType,
+    HostState,
+    SchedulingDecision,
+    SpotScheduler,
+    get_batch_scheduler,
+    get_decision_cache,
+    locality_score,
+    minimise_num_of_migrations,
+    reset_batch_scheduler,
+)
+from faabric_tpu.batch_scheduler.decision import (
+    DO_NOT_MIGRATE,
+    MUST_FREEZE,
+    NOT_ENOUGH_SLOTS,
+)
+from faabric_tpu.proto import BatchExecuteType, batch_exec_factory
+
+
+def hosts(*specs):
+    """specs: (ip, slots, used)"""
+    return {ip: HostState(ip=ip, slots=s, used_slots=u) for ip, s, u in specs}
+
+
+def decision_from(req, host_list):
+    d = SchedulingDecision(req.app_id, req.group_id)
+    for m, h in zip(req.messages, host_list):
+        d.add_message(h, m.id, m.app_idx, m.group_idx)
+    return d
+
+
+@pytest.fixture(autouse=True)
+def _reset_sched():
+    yield
+    reset_batch_scheduler()
+    get_decision_cache().clear()
+
+
+# ---------------------------------------------------------------------------
+# Decision data structure
+# ---------------------------------------------------------------------------
+
+def test_decision_vectors_and_helpers():
+    d = SchedulingDecision(app_id=1, group_id=2)
+    d.add_message("a", 10, 0, 0, mpi_port=8020, device_id=0)
+    d.add_message("b", 11, 1, 1, mpi_port=8021, device_id=1)
+    d.add_message("a", 12, 2, 2)
+    assert d.n_messages == 3
+    assert not d.is_single_host()
+    assert d.unique_hosts() == ["a", "b"]
+    assert d.host_for_idx(1) == "b"
+    assert d.host_freq_count() == {"a": 2, "b": 1}
+    d.remove_message(11)
+    assert d.n_messages == 2
+    assert d.is_single_host()
+    rt = SchedulingDecision.from_dict(d.to_dict())
+    assert rt == d
+
+
+def test_decision_in_position():
+    d = SchedulingDecision(app_id=1)
+    d.add_message_in_position(2, "c", 30, 2, 2)
+    d.add_message_in_position(0, "a", 10, 0, 0)
+    assert d.hosts == ["a", "", "c"]
+
+
+def test_locality_score():
+    d = SchedulingDecision(app_id=1)
+    for h in ("a", "a", "b", "b"):
+        d.add_message(h, 0, 0, 0)
+    # 2 hosts; 2x2 cross links
+    assert locality_score(d) == (2, 4)
+    single = SchedulingDecision(app_id=1)
+    single.add_message("a", 0, 0, 0)
+    assert locality_score(single) == (1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Decision types
+# ---------------------------------------------------------------------------
+
+def test_decision_types():
+    sched = BinPackScheduler()
+    req = batch_exec_factory("demo", "echo", 4)
+    in_flight = {}
+    assert sched.get_decision_type(in_flight, req) == DecisionType.NEW
+
+    old_decision = decision_from(req, ["a"] * 4)
+    in_flight[req.app_id] = (req, old_decision)
+
+    scale = batch_exec_factory("demo", "echo", 2)
+    scale.app_id = req.app_id
+    assert sched.get_decision_type(in_flight, scale) == DecisionType.SCALE_CHANGE
+
+    mig = batch_exec_factory("demo", "echo", 4)
+    mig.app_id = req.app_id
+    mig.type = int(BatchExecuteType.MIGRATION)
+    assert sched.get_decision_type(in_flight, mig) == DecisionType.DIST_CHANGE
+
+
+# ---------------------------------------------------------------------------
+# Bin-pack
+# ---------------------------------------------------------------------------
+
+def test_bin_pack_new_fills_largest_first():
+    sched = BinPackScheduler()
+    hm = hosts(("10.0.0.1", 4, 0), ("10.0.0.2", 2, 0), ("10.0.0.3", 6, 2))
+    req = batch_exec_factory("demo", "echo", 7)
+    d = sched.make_scheduling_decision(hm, {}, req)
+    # 10.0.0.3 has 4 free, 10.0.0.1 has 4 free (tie → larger total first:
+    # 10.0.0.3 wins; then ip desc), then 10.0.0.2
+    assert d.hosts == ["10.0.0.3"] * 4 + ["10.0.0.1"] * 3
+
+
+def test_bin_pack_not_enough_slots():
+    sched = BinPackScheduler()
+    hm = hosts(("a", 2, 1), ("b", 2, 2))
+    req = batch_exec_factory("demo", "echo", 3)
+    d = sched.make_scheduling_decision(hm, {}, req)
+    assert d.app_id == NOT_ENOUGH_SLOTS
+
+
+def test_bin_pack_scale_change_colocates():
+    sched = BinPackScheduler()
+    # "small" has fewer free slots but already runs the app
+    hm = hosts(("big", 8, 0), ("small", 4, 2))
+    req = batch_exec_factory("demo", "echo", 2)
+    old = decision_from(req, ["small", "small"])
+    in_flight = {req.app_id: (req, old)}
+
+    scale = batch_exec_factory("demo", "echo", 2)
+    scale.app_id = req.app_id
+    d = sched.make_scheduling_decision(hm, in_flight, scale)
+    assert d.hosts == ["small", "small"]
+
+
+def test_bin_pack_dist_change_improves_locality():
+    sched = BinPackScheduler()
+    # App spread 2+2 over a/b; c now has room for all 4
+    hm = hosts(("a", 2, 2), ("b", 2, 2), ("c", 4, 0))
+    req = batch_exec_factory("demo", "echo", 4)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "a", "b", "b"])
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    # a has 2 freed slots + 2 total; c has 4: all 4 go to c... but wait —
+    # after freeing, a=2 free, b=2 free, c=4 free → c first, all fit
+    assert d.hosts == ["c"] * 4
+    # Host map is not mutated by planning
+    assert hm["a"].used_slots == 2
+
+
+def test_bin_pack_dist_change_do_not_migrate_when_no_gain():
+    sched = BinPackScheduler()
+    hm = hosts(("a", 4, 4), ("b", 2, 0))
+    req = batch_exec_factory("demo", "echo", 4)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a"] * 4)
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.app_id == DO_NOT_MIGRATE
+
+
+def test_minimise_num_of_migrations_keeps_old_placements():
+    old = SchedulingDecision(app_id=7, group_id=3)
+    for i, h in enumerate(["a", "a", "b", "b"]):
+        old.add_message(h, 100 + i, i, i, mpi_port=8020 + i, device_id=i % 2)
+    # New histogram: a:3, b:1 — only one message should move
+    new = SchedulingDecision(app_id=7)
+    for h in ["a", "a", "a", "b"]:
+        new.add_message(h, 0, 0, 0)
+    out = minimise_num_of_migrations(new, old)
+    assert out.host_freq_count() == {"a": 3, "b": 1}
+    moved = [i for i in range(4) if out.hosts[i] != old.hosts[i]]
+    assert len(moved) == 1
+    # Unmoved messages keep their ports/devices
+    kept = [i for i in range(4) if out.hosts[i] == old.hosts[i]]
+    for i in kept:
+        assert out.mpi_ports[i] == old.mpi_ports[i]
+        assert out.device_ids[i] == old.device_ids[i]
+
+
+# ---------------------------------------------------------------------------
+# Compact
+# ---------------------------------------------------------------------------
+
+def test_compact_dist_change_consolidates_to_fewer_hosts():
+    sched = CompactScheduler()
+    # App runs 1 msg on each of a, b; b also runs another tenant-0 msg so
+    # packing onto b frees a entirely.
+    hm = hosts(("a", 4, 1), ("b", 4, 3))
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "b"])
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.hosts == ["b", "b"]
+
+
+def test_compact_do_not_migrate_when_no_host_freed():
+    sched = CompactScheduler()
+    hm = hosts(("a", 2, 2), ("b", 2, 2))
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "b"])
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    # a and b each keep one foreign message: no host can drain → no migration
+    assert d.app_id == DO_NOT_MIGRATE
+
+
+def test_compact_filters_other_tenants():
+    sched = CompactScheduler()
+    hm = hosts(("a", 4, 2), ("b", 4, 0))
+    other = batch_exec_factory("other", "fn", 2)
+    other.subtype = 99
+    other_decision = decision_from(other, ["a", "a"])
+    in_flight = {other.app_id: (other, other_decision)}
+    req = batch_exec_factory("demo", "echo", 2)  # subtype 0 != 99
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.hosts == ["b", "b"]
+
+
+# ---------------------------------------------------------------------------
+# Spot
+# ---------------------------------------------------------------------------
+
+def test_spot_never_schedules_on_evicted_host():
+    sched = SpotScheduler()
+    hm = hosts(("a", 8, 0), ("b", 4, 0))
+    hm["a"].for_eviction = True
+    req = batch_exec_factory("demo", "echo", 2)
+    d = sched.make_scheduling_decision(hm, {}, req)
+    assert d.hosts == ["b", "b"]
+
+
+def test_spot_dist_change_evacuates_evicted_host():
+    sched = SpotScheduler()
+    hm = hosts(("a", 2, 2), ("b", 4, 0))
+    hm["a"].for_eviction = True
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "a"])
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.hosts == ["b", "b"]
+
+
+def test_spot_dist_change_freezes_without_capacity():
+    sched = SpotScheduler()
+    hm = hosts(("a", 2, 2), ("b", 2, 2))
+    hm["a"].for_eviction = True
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "a"])
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.app_id == MUST_FREEZE
+
+
+def test_spot_dist_change_no_eviction_no_migration():
+    sched = SpotScheduler()
+    hm = hosts(("a", 2, 2), ("b", 4, 0))
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "a"])
+    in_flight = {req.app_id: (req, old)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.app_id == DO_NOT_MIGRATE
+
+
+# ---------------------------------------------------------------------------
+# Mode switch + cache
+# ---------------------------------------------------------------------------
+
+def test_get_batch_scheduler_mode_switch():
+    reset_batch_scheduler("compact")
+    assert isinstance(get_batch_scheduler(), CompactScheduler)
+    reset_batch_scheduler("spot")
+    assert isinstance(get_batch_scheduler(), SpotScheduler)
+    reset_batch_scheduler("bin-pack")
+    assert isinstance(get_batch_scheduler(), BinPackScheduler)
+
+
+def test_decision_cache():
+    cache = get_decision_cache()
+    req = batch_exec_factory("demo", "echo", 3)
+    assert cache.get_cached_decision(req) is None
+    cache.add_cached_decision(req, ["a", "b", "a"], group_id=42)
+    hit = cache.get_cached_decision(req)
+    assert hit is not None and hit.hosts == ["a", "b", "a"]
+    assert hit.group_id == 42
+    # Different size misses
+    req2 = batch_exec_factory("demo", "echo", 2)
+    assert cache.get_cached_decision(req2) is None
+    with pytest.raises(ValueError):
+        cache.add_cached_decision(req2, ["a"], group_id=1)
+
+
+def test_compact_full_cluster_migration_does_not_freeze():
+    """Filtered-but-healthy hosts (other tenants) must yield DO_NOT_MIGRATE /
+    NOT_ENOUGH_SLOTS on a full cluster, never MUST_FREEZE — freezing is a
+    spot-eviction concept only."""
+    sched = CompactScheduler()
+    hm = hosts(("a", 2, 2), ("b", 2, 2))
+    other = batch_exec_factory("other", "fn", 1)
+    other.subtype = 99
+    other_dec = decision_from(other, ["a"])
+    req = batch_exec_factory("demo", "echo", 2)
+    req.type = int(BatchExecuteType.MIGRATION)
+    old = decision_from(req, ["a", "b"])
+    in_flight = {req.app_id: (req, old), other.app_id: (other, other_dec)}
+    d = sched.make_scheduling_decision(hm, in_flight, req)
+    assert d.app_id != MUST_FREEZE

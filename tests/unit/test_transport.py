@@ -231,3 +231,64 @@ def test_periodic_background_thread():
     n = c.count
     time.sleep(0.05)
     assert c.count == n  # no work after stop
+
+
+def test_sync_send_recovers_stale_keepalive_but_not_fresh_failure():
+    """Server restart between RPCs: a reused connection that yields zero
+    response bytes is retried on a fresh dial; a fresh connection that dies
+    after send surfaces the error (at-most-once)."""
+    from faabric_tpu.transport.server import MessageEndpointServer, handler_response
+
+    class Srv(MessageEndpointServer):
+        def do_sync_recv(self, msg):
+            return handler_response(header={"pong": True})
+
+        def do_async_recv(self, msg):
+            pass
+
+    ap, sp = get_free_port(), get_free_port()
+    srv = Srv(ap, sp)
+    srv.start()
+    cli = MessageEndpointClient("127.0.0.1", ap, sp, timeout=3.0)
+    try:
+        assert cli.sync_send(1).header["pong"]
+        # Restart the server: the client's keep-alive socket is now stale
+        srv.stop()
+        srv = Srv(ap, sp)
+        srv.start()
+        # Must transparently retry on a fresh connection
+        assert cli.sync_send(1).header["pong"]
+    finally:
+        cli.close()
+        srv.stop()
+
+    # Fresh-connection failure after send: no retry (see also the request
+    # single-delivery check in the verify drivers)
+    lp = get_free_port()
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", lp))
+    lst.listen(1)
+    hits = []
+
+    def drop_server():
+        while True:
+            try:
+                c, _ = lst.accept()
+            except OSError:
+                return
+            hits.append(1)
+            c.recv(65536)
+            c.close()
+
+    t = threading.Thread(target=drop_server, daemon=True)
+    t.start()
+    cli2 = MessageEndpointClient("127.0.0.1", lp, lp, timeout=2.0)
+    try:
+        with pytest.raises(RpcError):
+            cli2.sync_send(1, header={"x": 1})
+        time.sleep(0.2)
+        assert len(hits) == 1
+    finally:
+        cli2.close()
+        lst.close()
