@@ -211,6 +211,7 @@ class BatchScheduler:
 # ---------------------------------------------------------------------------
 
 _scheduler: Optional[BatchScheduler] = None
+_mode_override: Optional[str] = None
 _scheduler_lock = threading.Lock()
 
 
@@ -223,7 +224,7 @@ def get_batch_scheduler() -> BatchScheduler:
     global _scheduler
     with _scheduler_lock:
         if _scheduler is None:
-            mode = get_system_config().batch_scheduler_mode
+            mode = _mode_override or get_system_config().batch_scheduler_mode
             if mode == "bin-pack":
                 _scheduler = BinPackScheduler()
             elif mode == "compact":
@@ -236,13 +237,10 @@ def get_batch_scheduler() -> BatchScheduler:
 
 
 def reset_batch_scheduler(new_mode: str | None = None) -> None:
-    import os
-
-    global _scheduler
+    """Drop the cached policy; an explicit ``new_mode`` overrides the config
+    knob for this process without touching the environment or the live
+    SystemConfig (reference resetBatchScheduler(newMode))."""
+    global _scheduler, _mode_override
     with _scheduler_lock:
         _scheduler = None
-    if new_mode is not None:
-        os.environ["BATCH_SCHEDULER_MODE"] = new_mode
-        from faabric_tpu.util.config import get_system_config
-
-        get_system_config().reset()
+        _mode_override = new_mode

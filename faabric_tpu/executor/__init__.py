@@ -1,0 +1,25 @@
+"""Pluggable executor engine (reference src/executor)."""
+
+from faabric_tpu.executor.context import ExecutorContext
+from faabric_tpu.executor.executor import (
+    Executor,
+    ExecutorTask,
+    FunctionFrozenException,
+    FunctionMigratedException,
+)
+from faabric_tpu.executor.factory import (
+    ExecutorFactory,
+    get_executor_factory,
+    set_executor_factory,
+)
+
+__all__ = [
+    "Executor",
+    "ExecutorContext",
+    "ExecutorFactory",
+    "ExecutorTask",
+    "FunctionFrozenException",
+    "FunctionMigratedException",
+    "get_executor_factory",
+    "set_executor_factory",
+]

@@ -1,0 +1,51 @@
+"""Thread-local execution context (reference:
+include/faabric/executor/ExecutorContext.h:168-207).
+
+Guest code running inside an executor thread can look up which executor,
+batch request and message index it belongs to. On TPU this is also where a
+task finds its assigned device (the chip the planner pinned its rank to).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Optional
+
+from faabric_tpu.proto import BatchExecuteRequest, Message
+
+if TYPE_CHECKING:  # pragma: no cover
+    from faabric_tpu.executor.executor import Executor
+
+_tls = threading.local()
+
+
+class ExecutorContext:
+    def __init__(self, executor: "Executor", req: BatchExecuteRequest,
+                 msg_idx: int) -> None:
+        self.executor = executor
+        self.req = req
+        self.msg_idx = msg_idx
+
+    @property
+    def msg(self) -> Message:
+        return self.req.messages[self.msg_idx]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def set(executor: "Executor", req: BatchExecuteRequest, msg_idx: int) -> None:
+        _tls.context = ExecutorContext(executor, req, msg_idx)
+
+    @staticmethod
+    def unset() -> None:
+        _tls.context = None
+
+    @staticmethod
+    def get() -> "ExecutorContext":
+        ctx = getattr(_tls, "context", None)
+        if ctx is None:
+            raise RuntimeError("No executor context set on this thread")
+        return ctx
+
+    @staticmethod
+    def is_set() -> bool:
+        return getattr(_tls, "context", None) is not None

@@ -1,0 +1,247 @@
+"""Executor: the per-host execution engine.
+
+Reference analog: src/executor/Executor.cpp:111-215 (executeTasks),
+:307-581 (threadPoolThread), include/faabric/executor/Executor.h:21-118.
+
+An executor is bound to one function (user/function) and runs one batch at a
+time (claim/release). It owns a pool of worker threads with per-thread task
+queues; ``execute_task`` is the virtual the embedding runtime implements —
+on TPU typically a jitted JAX callable running on the chip the planner
+pinned this rank to (``ExecutorContext.get().device_id``).
+
+Snapshot restore / dirty tracking hooks (``restore``, ``get_memory_view``,
+``set_memory_size``) mirror the reference's THREADS path; the snapshot layer
+wires into them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import TYPE_CHECKING, Optional
+
+from faabric_tpu.executor.context import ExecutorContext
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    BatchExecuteType,
+    Message,
+    ReturnValue,
+    get_main_thread_snapshot_key,
+)
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.queues import Queue
+
+if TYPE_CHECKING:  # pragma: no cover
+    from faabric_tpu.scheduler.scheduler import Scheduler
+
+logger = get_logger(__name__)
+
+POOL_SHUTDOWN = -1
+
+
+class FunctionMigratedException(Exception):
+    """Thrown by guest code when it detects it must migrate
+    (reference include/faabric/executor/Executor.h)."""
+
+
+class FunctionFrozenException(Exception):
+    """Thrown by guest code when its app is spot-frozen."""
+
+
+class ExecutorTask:
+    def __init__(self, msg_idx: int, req: BatchExecuteRequest) -> None:
+        self.msg_idx = msg_idx
+        self.req = req
+
+
+class Executor:
+    """Base executor; subclasses implement ``execute_task`` and the memory
+    hooks."""
+
+    def __init__(self, msg: Message) -> None:
+        conf = get_system_config()
+        self.bound_msg = msg
+        self.id = f"{msg.user}/{msg.function}-{msg.id}"
+
+        self.pool_size = conf.get_usable_cores()
+        self._task_queues: dict[int, Queue[ExecutorTask]] = {}
+        self._pool_threads: dict[int, threading.Thread] = {}
+
+        self._claimed = False
+        self._claim_lock = threading.Lock()
+
+        self.last_exec: float = time.monotonic()
+
+        # Batch bookkeeping: tasks outstanding in the current batch
+        self._batch_lock = threading.Lock()
+        self._tasks_outstanding = 0
+
+        self._chained_lock = threading.Lock()
+        self._chained_messages: dict[int, Message] = {}
+
+        self._shutdown = False
+
+        # Set by the scheduler right after the factory creates the executor;
+        # carries host identity and the planner client used to report
+        # results.
+        self.scheduler: Optional["Scheduler"] = None
+
+    # ------------------------------------------------------------------
+    # Virtual hooks (reference Executor.h:60-104)
+    # ------------------------------------------------------------------
+    def execute_task(self, thread_pool_idx: int, msg_idx: int,
+                     req: BatchExecuteRequest) -> int:
+        raise NotImplementedError
+
+    def reset(self, msg: Message) -> None:
+        """Return the executor to a clean state between batches."""
+
+    def restore(self, snapshot_key: str) -> None:
+        """Map a snapshot onto this executor's memory (THREADS batches)."""
+
+    def get_memory_view(self) -> Optional[memoryview]:
+        return None
+
+    def set_memory_size(self, size: int) -> None:
+        pass
+
+    def get_max_memory_size(self) -> int:
+        return 0
+
+    # ------------------------------------------------------------------
+    # Claiming (reference Executor::tryClaim/releaseClaim)
+    # ------------------------------------------------------------------
+    def try_claim(self) -> bool:
+        with self._claim_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def release_claim(self) -> None:
+        with self._claim_lock:
+            self._claimed = False
+
+    def is_claimed(self) -> bool:
+        with self._claim_lock:
+            return self._claimed
+
+    # ------------------------------------------------------------------
+    # Batch execution
+    # ------------------------------------------------------------------
+    def execute_tasks(self, msg_idxs: list[int], req: BatchExecuteRequest) -> None:
+        logger.debug("%s executing %d/%d tasks of app %d", self.id,
+                     len(msg_idxs), req.n_messages(), req.app_id)
+        self.last_exec = time.monotonic()
+
+        is_threads = req.type == int(BatchExecuteType.THREADS)
+
+        # Multi-host THREADS batches restore from the main thread's snapshot
+        # before any task runs (reference Executor.cpp:137-160). The
+        # snapshot layer provides restore(); single-host batches skip this.
+        if is_threads and not req.single_host and req.snapshot_key:
+            self.restore(req.snapshot_key)
+
+        with self._batch_lock:
+            self._tasks_outstanding += len(msg_idxs)
+
+        for msg_idx in msg_idxs:
+            # Tasks spread over the pool by message index; THREADS batches
+            # of up to pool_size threads therefore get one thread each.
+            self._enqueue(msg_idx % self.pool_size, ExecutorTask(msg_idx, req))
+
+    def _enqueue(self, pool_idx: int, task: ExecutorTask) -> None:
+        if pool_idx not in self._task_queues:
+            self._task_queues[pool_idx] = Queue()
+            t = threading.Thread(
+                target=self._pool_thread_loop, args=(pool_idx,),
+                name=f"{self.id}-pool-{pool_idx}", daemon=True,
+            )
+            self._pool_threads[pool_idx] = t
+            t.start()
+        self._task_queues[pool_idx].enqueue(task)
+
+    def _pool_thread_loop(self, pool_idx: int) -> None:
+        q = self._task_queues[pool_idx]
+        while not self._shutdown:
+            task = q.dequeue()
+            if task is POOL_SHUTDOWN:
+                return
+            self._run_task(pool_idx, task)
+
+    def _run_task(self, pool_idx: int, task: ExecutorTask) -> None:
+        req = task.req
+        msg = req.messages[task.msg_idx]
+        is_threads = req.type == int(BatchExecuteType.THREADS)
+        msg.executed_host = self.scheduler.host if self.scheduler else ""
+
+        ExecutorContext.set(self, req, task.msg_idx)
+        try:
+            ret = self.execute_task(pool_idx, task.msg_idx, req)
+        except FunctionMigratedException:
+            logger.debug("%s task %d migrated", self.id, msg.id)
+            ret = int(ReturnValue.MIGRATED)
+        except FunctionFrozenException:
+            logger.debug("%s task %d frozen", self.id, msg.id)
+            ret = int(ReturnValue.FROZEN)
+        except Exception as e:  # noqa: BLE001 — guest errors become results
+            logger.exception("%s task %d failed", self.id, msg.id)
+            ret = int(ReturnValue.FAILED)
+            msg.output_data = str(e).encode()
+        finally:
+            ExecutorContext.unset()
+
+        msg.return_value = ret
+        msg.finish_timestamp = time.time()
+        self.last_exec = time.monotonic()
+
+        with self._batch_lock:
+            self._tasks_outstanding -= 1
+            last_in_batch = self._tasks_outstanding == 0
+
+        # Report the result. THREADS results go through the thread-result
+        # path (snapshot diffs ride along once the snapshot layer is in);
+        # everything else reports to the planner.
+        if self.scheduler is not None:
+            if is_threads:
+                self.scheduler.set_thread_result(msg, ret)
+            else:
+                self.scheduler.report_message_result(msg)
+
+        # Last task of the batch returns the executor to the pool
+        # (reference Executor.cpp:520-570).
+        if last_in_batch:
+            if not is_threads:
+                self.reset(self.bound_msg)
+            self.release_claim()
+            if self.scheduler is not None:
+                self.scheduler.notify_executor_idle(self)
+
+    # ------------------------------------------------------------------
+    # Chained messages (reference Executor::getChainedMessage)
+    # ------------------------------------------------------------------
+    def add_chained_message(self, msg: Message) -> None:
+        with self._chained_lock:
+            self._chained_messages[msg.id] = msg
+
+    def get_chained_message(self, msg_id: int) -> Message:
+        with self._chained_lock:
+            return self._chained_messages[msg_id]
+
+    def get_chained_message_ids(self) -> list[int]:
+        with self._chained_lock:
+            return list(self._chained_messages)
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for idx, q in self._task_queues.items():
+            q.enqueue(POOL_SHUTDOWN)
+        for t in self._pool_threads.values():
+            t.join(timeout=2.0)
+        self._pool_threads.clear()
+        self._task_queues.clear()
+
+    def uptime_idle(self) -> float:
+        return time.monotonic() - self.last_exec

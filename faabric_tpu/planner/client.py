@@ -1,0 +1,238 @@
+"""Per-host planner client.
+
+Reference analog: src/planner/PlannerClient.cpp (429 lines) — including the
+blocking getMessageResult with a local promise cache (the planner registers
+the host's interest and pushes the result to the host's FunctionCallServer,
+which resolves the promise; :202-270), callFunctions (:283-370) and the
+KeepAliveThread re-registering the host every half-timeout
+(PlannerClient.h:21-33).
+
+Mock mode records batch calls / results instead of sending.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.planner.server import PlannerCalls
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    BatchExecuteRequestStatus,
+    BatchExecuteType,
+    Message,
+    ber_to_wire,
+    get_main_thread_snapshot_key,
+    messages_from_wire,
+    messages_to_wire,
+)
+from faabric_tpu.transport.client import MessageEndpointClient
+from faabric_tpu.transport.common import PLANNER_ASYNC_PORT, PLANNER_SYNC_PORT
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.periodic import PeriodicBackgroundThread
+from faabric_tpu.util.testing import is_mock_mode
+
+logger = get_logger(__name__)
+
+# ---------------------------------------------------------------------------
+# Mock recording
+# ---------------------------------------------------------------------------
+_mock_lock = threading.Lock()
+_mock_batch_calls: list[BatchExecuteRequest] = []
+_mock_results: list[Message] = []
+
+
+def get_mock_batch_calls() -> list[BatchExecuteRequest]:
+    with _mock_lock:
+        return list(_mock_batch_calls)
+
+
+def get_mock_set_results() -> list[Message]:
+    with _mock_lock:
+        return list(_mock_results)
+
+
+def clear_mock_planner_calls() -> None:
+    with _mock_lock:
+        _mock_batch_calls.clear()
+        _mock_results.clear()
+
+
+class KeepAliveThread(PeriodicBackgroundThread):
+    def __init__(self, client: "PlannerClient", slots: int, n_devices: int) -> None:
+        super().__init__()
+        self.client = client
+        self.slots = slots
+        self.n_devices = n_devices
+
+    def do_work(self) -> None:
+        self.client.register_host(self.slots, self.n_devices)
+
+
+class PlannerClient(MessageEndpointClient):
+    """One per worker runtime, carrying the worker's host identity."""
+
+    def __init__(self, this_host: str = "",
+                 planner_host: str | None = None) -> None:
+        conf = get_system_config()
+        super().__init__(planner_host or conf.planner_host,
+                         PLANNER_ASYNC_PORT, PLANNER_SYNC_PORT)
+        self.this_host = this_host
+        self._keep_alive: Optional[KeepAliveThread] = None
+
+        # Local result promises: msg_id → Event; results land either via the
+        # planner's push to our FunctionCallServer or via a direct response.
+        # The cache is bounded (oldest-first) — a long-lived worker must not
+        # accumulate one Message per completed invocation forever.
+        self._results_lock = threading.Lock()
+        self._local_results: dict[int, Message] = {}
+        self._local_results_order: list[int] = []
+        self._result_events: dict[int, threading.Event] = {}
+
+    MAX_CACHED_RESULTS = 10_000
+
+    # ------------------------------------------------------------------
+    def ping(self) -> bool:
+        resp = self.sync_send(int(PlannerCalls.PING), idempotent=True)
+        return bool(resp.header.get("pong"))
+
+    def register_host(self, slots: int, n_devices: int = 0,
+                      overwrite: bool = False, start_keep_alive: bool = False) -> float:
+        resp = self.sync_send(int(PlannerCalls.REGISTER_HOST), {
+            "host": self.this_host, "slots": slots,
+            "n_devices": n_devices, "overwrite": overwrite,
+        }, idempotent=True)
+        timeout = float(resp.header.get("host_timeout", 30.0))
+        if start_keep_alive and self._keep_alive is None:
+            self._keep_alive = KeepAliveThread(self, slots, n_devices)
+            self._keep_alive.start(max(0.5, timeout / 2))
+        return timeout
+
+    def remove_host(self) -> None:
+        if self._keep_alive is not None:
+            self._keep_alive.stop()
+            self._keep_alive = None
+        self.sync_send(int(PlannerCalls.REMOVE_HOST), {"host": self.this_host},
+                       idempotent=True)
+
+    def get_available_hosts(self) -> list[dict]:
+        resp = self.sync_send(int(PlannerCalls.GET_AVAILABLE_HOSTS),
+                              idempotent=True)
+        return resp.header.get("hosts", [])
+
+    # ------------------------------------------------------------------
+    def call_functions(self, req: BatchExecuteRequest) -> SchedulingDecision:
+        """Invoke a batch through the planner (reference callFunctions)."""
+        if is_mock_mode():
+            with _mock_lock:
+                _mock_batch_calls.append(req)
+            return SchedulingDecision(req.app_id, req.group_id)
+
+        # THREADS batches set the main host and snapshot key before the
+        # planner sees them (reference PlannerClient.cpp:283-370); the
+        # actual snapshot push is wired by the snapshot layer.
+        if req.type == int(BatchExecuteType.THREADS) and req.messages:
+            for m in req.messages:
+                m.main_host = self.this_host
+            if not req.snapshot_key:
+                req.snapshot_key = get_main_thread_snapshot_key(req.messages[0])
+
+        header, tail = ber_to_wire(req)
+        resp = self.sync_send(int(PlannerCalls.CALL_BATCH), {"ber": header}, tail)
+        return SchedulingDecision.from_dict(resp.header["decision"])
+
+    # ------------------------------------------------------------------
+    def set_message_result(self, msg: Message) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _mock_results.append(msg)
+            return
+        dicts, tail = messages_to_wire([msg])
+        self.async_send(int(PlannerCalls.SET_MESSAGE_RESULT),
+                        {"msg": dicts[0]}, tail)
+
+    def set_message_result_locally(self, msg: Message) -> None:
+        """Resolve a local waiter (called by our FunctionCallServer when the
+        planner pushes a result; reference setMessageResultLocally)."""
+        with self._results_lock:
+            if msg.id not in self._local_results:
+                self._local_results_order.append(msg.id)
+            self._local_results[msg.id] = msg
+            while len(self._local_results_order) > self.MAX_CACHED_RESULTS:
+                oldest = self._local_results_order.pop(0)
+                self._local_results.pop(oldest, None)
+            ev = self._result_events.pop(msg.id, None)
+            if ev is not None:
+                ev.set()
+
+    def get_message_result(self, app_id: int, msg_id: int,
+                           timeout: float | None = None) -> Message:
+        """Blocking result fetch. Registers interest with the planner; the
+        result arrives in the sync response (already done) or is pushed to
+        this host's FunctionCallServer."""
+        conf = get_system_config()
+        timeout = timeout if timeout is not None else conf.global_message_timeout
+
+        with self._results_lock:
+            cached = self._local_results.get(msg_id)
+            if cached is not None:
+                return cached
+            ev = self._result_events.setdefault(msg_id, threading.Event())
+
+        resp = self.sync_send(int(PlannerCalls.GET_MESSAGE_RESULT), {
+            "app_id": app_id, "msg_id": msg_id, "host": self.this_host,
+        }, idempotent=True)
+        if resp.header.get("found"):
+            result = messages_from_wire([resp.header["msg"]], resp.payload)[0]
+            self.set_message_result_locally(result)
+            return result
+
+        if not ev.wait(timeout):
+            with self._results_lock:
+                self._result_events.pop(msg_id, None)
+            raise TimeoutError(
+                f"Timed out waiting for result of msg {msg_id} (app {app_id})")
+        with self._results_lock:
+            return self._local_results[msg_id]
+
+    def get_batch_results(self, app_id: int) -> BatchExecuteRequestStatus:
+        resp = self.sync_send(int(PlannerCalls.GET_BATCH_RESULTS),
+                              {"app_id": app_id}, idempotent=True)
+        msgs = messages_from_wire(resp.header.get("messages", []), resp.payload)
+        return BatchExecuteRequestStatus(
+            app_id=resp.header["app_id"],
+            finished=resp.header["finished"],
+            message_results=msgs,
+            expected_num_messages=resp.header["expected_num_messages"],
+        )
+
+    def get_scheduling_decision(self, app_id: int) -> Optional[SchedulingDecision]:
+        resp = self.sync_send(int(PlannerCalls.GET_SCHEDULING_DECISION),
+                              {"app_id": app_id}, idempotent=True)
+        if not resp.header.get("found"):
+            return None
+        return SchedulingDecision.from_dict(resp.header["decision"])
+
+    def get_num_migrations(self) -> int:
+        resp = self.sync_send(int(PlannerCalls.GET_NUM_MIGRATIONS),
+                              idempotent=True)
+        return int(resp.header["num_migrations"])
+
+    def preload_scheduling_decision(self, decision: SchedulingDecision) -> None:
+        self.sync_send(int(PlannerCalls.PRELOAD_SCHEDULING_DECISION),
+                       {"decision": decision.to_dict()}, idempotent=True)
+
+    # ------------------------------------------------------------------
+    def clear_local_cache(self) -> None:
+        with self._results_lock:
+            self._local_results.clear()
+            self._local_results_order.clear()
+            self._result_events.clear()
+
+    def close(self) -> None:
+        if self._keep_alive is not None:
+            self._keep_alive.stop()
+            self._keep_alive = None
+        super().close()

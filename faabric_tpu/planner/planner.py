@@ -1,0 +1,588 @@
+"""The cluster-singleton control plane.
+
+Reference analog: src/planner/Planner.cpp (1415 lines), in particular
+callBatch (:807-1292), dispatchSchedulingDecision (:1293-1397),
+registerHost (:295-365), setMessageResult (:394-540), host expiry
+(:383-392).
+
+State held: host map (slots, chips, MPI port pool, register timestamp),
+in-flight apps (request + decision), app results, result waiters (hosts to
+push results to), preloaded decisions, frozen (evicted) apps, and the
+migration counter.
+
+TPU-first deltas from the reference:
+- Slots are execution slots as in the reference, but every placement also
+  pins a **device id** — the chip on the chosen host — picked least-loaded
+  from the host's chip inventory; MPI/collective groups read it from the
+  decision to build their ``jax.sharding.Mesh``.
+- MPI ports come from a per-host pool as in the reference
+  (Planner.cpp:79-120); on TPU they parameterise the host-side PTP data
+  plane, while the device data plane rides ICI via XLA collectives.
+
+Like the reference (Planner.cpp:814), call_batch serialises on one lock —
+scheduling throughput is not the bottleneck; slot accounting correctness is.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from faabric_tpu.batch_scheduler import (
+    DecisionType,
+    HostState,
+    SchedulingDecision,
+    get_batch_scheduler,
+    is_sentinel_decision,
+)
+from faabric_tpu.batch_scheduler.decision import MUST_FREEZE, NOT_ENOUGH_SLOTS
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    BatchExecuteRequestStatus,
+    BatchExecuteType,
+    Message,
+    ReturnValue,
+    update_batch_exec_group_id,
+)
+from faabric_tpu.transport.common import MPI_BASE_PORT, MPI_PORTS_PER_HOST
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.gids import generate_gid
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PlannerHost:
+    """Planner-side record for one registered worker host."""
+
+    def __init__(self, ip: str, slots: int, n_devices: int = 0) -> None:
+        self.state = HostState(ip=ip, slots=slots, n_devices=n_devices)
+        self.register_ts = time.monotonic()
+        self.used_mpi_ports: set[int] = set()
+        # ranks pinned per chip — placements pick the least-loaded chip
+        self.device_load: list[int] = [0] * max(0, n_devices)
+
+    def claim_mpi_port(self) -> int:
+        for port in range(MPI_BASE_PORT, MPI_BASE_PORT + MPI_PORTS_PER_HOST):
+            if port not in self.used_mpi_ports:
+                self.used_mpi_ports.add(port)
+                return port
+        raise RuntimeError(f"Host {self.state.ip} exhausted its MPI port pool")
+
+    def release_mpi_port(self, port: int) -> None:
+        self.used_mpi_ports.discard(port)
+
+    def claim_device(self) -> int:
+        if not self.device_load:
+            return -1
+        dev = self.device_load.index(min(self.device_load))
+        self.device_load[dev] += 1
+        return dev
+
+    def release_device(self, dev: int) -> None:
+        if 0 <= dev < len(self.device_load) and self.device_load[dev] > 0:
+            self.device_load[dev] -= 1
+
+
+class Planner:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._hosts: dict[str, PlannerHost] = {}
+        # app_id → (req, decision)
+        self._in_flight: dict[int, tuple[BatchExecuteRequest, SchedulingDecision]] = {}
+        # app_id → {msg_id: result Message}
+        self._results: dict[int, dict[int, Message]] = {}
+        # app_id → expected message count (survives in_flight cleanup)
+        self._expected: dict[int, int] = {}
+        # app_id → next unassigned app/group index (monotonic — never
+        # derived from remaining-message counts, which shrink as results
+        # complete)
+        self._next_idx: dict[int, int] = {}
+        # Completed apps in completion order, for bounded result retention
+        self._completed_order: list[int] = []
+        # (app_id, msg_id) → hosts to push the result to
+        self._waiters: dict[tuple[int, int], set[str]] = {}
+        # app_id → decision preloaded via REST/tests
+        self._preloaded: dict[int, SchedulingDecision] = {}
+        # app_id → frozen request (spot eviction)
+        self._evicted: dict[int, BatchExecuteRequest] = {}
+        self._next_evicted_ips: set[str] = set()
+        self._num_migrations = 0
+        self._clients: dict[str, "object"] = {}
+        self._clients_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Host membership (reference Planner.cpp:267-392)
+    # ------------------------------------------------------------------
+    def register_host(self, ip: str, slots: int, n_devices: int = 0,
+                      overwrite: bool = False) -> float:
+        conf = get_system_config()
+        with self._lock:
+            existing = self._hosts.get(ip)
+            if existing is None or overwrite:
+                self._hosts[ip] = PlannerHost(ip, slots, n_devices)
+                logger.debug("Planner registered host %s (slots=%d chips=%d)",
+                             ip, slots, n_devices)
+            else:
+                # Keep-alive: refresh timestamp (and allow growing slots)
+                existing.register_ts = time.monotonic()
+                existing.state.slots = slots
+                if n_devices != len(existing.device_load):
+                    existing.device_load = [0] * max(0, n_devices)
+                    existing.state.n_devices = n_devices
+        return conf.planner_host_timeout
+
+    def remove_host(self, ip: str) -> None:
+        with self._lock:
+            self._hosts.pop(ip, None)
+
+    def expire_hosts(self) -> None:
+        conf = get_system_config()
+        now = time.monotonic()
+        with self._lock:
+            stale = [ip for ip, h in self._hosts.items()
+                     if now - h.register_ts > conf.planner_host_timeout]
+            for ip in stale:
+                logger.warning("Expiring host %s (no keep-alive)", ip)
+                del self._hosts[ip]
+
+    def get_available_hosts(self) -> list[HostState]:
+        self.expire_hosts()
+        with self._lock:
+            return [HostState(ip=h.state.ip, slots=h.state.slots,
+                              used_slots=h.state.used_slots,
+                              n_devices=h.state.n_devices)
+                    for h in self._hosts.values()]
+
+    def set_next_evicted_host_ips(self, ips: list[str]) -> None:
+        with self._lock:
+            self._next_evicted_ips = set(ips)
+
+    # ------------------------------------------------------------------
+    # The scheduling brain (reference Planner::callBatch)
+    # ------------------------------------------------------------------
+    def call_batch(self, req: BatchExecuteRequest) -> SchedulingDecision:
+        """Schedule a batch. Accounting happens under the planner lock;
+        network dispatch happens after it is released, so one unreachable
+        worker cannot stall keep-alives and other apps' scheduling."""
+        with self._lock:
+            scheduler = get_batch_scheduler()
+            decision_type = scheduler.get_decision_type(self._in_flight, req)
+
+            # Thaw: a NEW request for a frozen app resumes it
+            if decision_type == DecisionType.NEW and req.app_id in self._evicted:
+                req = self._evicted.pop(req.app_id)
+                decision_type = DecisionType.NEW
+
+            host_map = self._policy_host_map()
+
+            decision = None
+            preloaded = self._preloaded.get(req.app_id)
+            if preloaded is not None and decision_type in (
+                    DecisionType.NEW, DecisionType.SCALE_CHANGE):
+                decision = self._slice_preloaded(preloaded, req)
+            if decision is None:
+                decision = scheduler.make_scheduling_decision(
+                    host_map, self._in_flight, req)
+
+            if decision.app_id == NOT_ENOUGH_SLOTS:
+                logger.warning("Not enough slots for app %d (%d msgs)",
+                               req.app_id, req.n_messages())
+                return decision
+
+            if decision.app_id == MUST_FREEZE:
+                self._freeze_app(req)
+                return decision
+
+            if is_sentinel_decision(decision):  # DO_NOT_MIGRATE
+                return decision
+
+            if decision_type == DecisionType.NEW:
+                decision, dispatches = self._handle_new(req, decision)
+            elif decision_type == DecisionType.SCALE_CHANGE:
+                decision, dispatches = self._handle_scale_change(req, decision)
+            else:
+                decision, dispatches = self._handle_dist_change(req, decision)
+
+        self._do_dispatch(dispatches)
+        return decision
+
+    # -- decision handling (all run under self._lock; they return the
+    # network dispatches to perform after the lock is released) -----------
+    def _handle_new(self, req: BatchExecuteRequest,
+                    decision: SchedulingDecision
+                    ) -> tuple[SchedulingDecision, list]:
+        group_id = req.group_id or generate_gid()
+        decision.group_id = group_id
+        update_batch_exec_group_id(req, group_id)
+        for i, msg in enumerate(req.messages):
+            msg.group_idx = decision.group_idxs[i]
+        self._claim_for_decision(decision, req)
+        self._in_flight[req.app_id] = (req, decision)
+        self._expected[req.app_id] = req.n_messages()
+        self._next_idx[req.app_id] = 1 + max(
+            (m.app_idx for m in req.messages), default=req.n_messages() - 1)
+        self._results.setdefault(req.app_id, {})
+        self._send_mappings(decision)
+        return decision, self._build_dispatches(req, decision)
+
+    def _handle_scale_change(self, req: BatchExecuteRequest,
+                             decision: SchedulingDecision
+                             ) -> tuple[SchedulingDecision, list]:
+        old_req, old_decision = self._in_flight[req.app_id]
+        update_batch_exec_group_id(req, old_decision.group_id)
+        decision.group_id = old_decision.group_id
+
+        # New messages continue the app's index space monotonically —
+        # never derived from the remaining-message count, which shrinks as
+        # results complete and would hand out duplicate group indices.
+        for i, msg in enumerate(req.messages):
+            if not msg.app_idx:
+                msg.app_idx = self._next_idx[req.app_id]
+                self._next_idx[req.app_id] += 1
+            else:
+                self._next_idx[req.app_id] = max(
+                    self._next_idx[req.app_id], msg.app_idx + 1)
+            msg.group_idx = msg.group_idx or msg.app_idx
+            decision.app_idxs[i] = msg.app_idx
+            decision.group_idxs[i] = msg.group_idx
+            decision.message_ids[i] = msg.id
+
+        self._claim_for_decision(decision, req)
+
+        # Merge into the in-flight record
+        for i in range(decision.n_messages):
+            old_decision.add_message(
+                decision.hosts[i], decision.message_ids[i],
+                decision.app_idxs[i], decision.group_idxs[i],
+                decision.mpi_ports[i], decision.device_ids[i])
+            old_req.messages.append(req.messages[i])
+        self._expected[req.app_id] = (
+            self._expected.get(req.app_id, 0) + req.n_messages())
+
+        self._send_mappings(old_decision)
+        return decision, self._build_dispatches(req, decision)
+
+    def _handle_dist_change(self, req: BatchExecuteRequest,
+                            decision: SchedulingDecision
+                            ) -> tuple[SchedulingDecision, list]:
+        old_req, old_decision = self._in_flight[req.app_id]
+
+        # Transfer claims: release every old placement, then re-claim.
+        # Unmoved messages keep their ports/devices (keep_from); moved ones
+        # get fresh allocations.
+        self._release_for_decision(old_decision, old_req)
+        self._claim_for_decision(decision, old_req, keep_from=old_decision)
+
+        new_group_id = generate_gid()
+        decision.group_id = new_group_id
+        self._num_migrations += 1
+
+        update_batch_exec_group_id(old_req, new_group_id)
+        self._in_flight[req.app_id] = (old_req, decision)
+        self._send_mappings(decision)
+        # The migrating ranks re-dispatch themselves via the migration
+        # exception + MIGRATION batch (reference §3.5); no dispatch here.
+        return decision, []
+
+    def _freeze_app(self, req: BatchExecuteRequest) -> None:
+        """Park a running app: release its resources and remember the
+        request for a later thaw (reference Planner.cpp:1005-1019)."""
+        in_flight = self._in_flight.pop(req.app_id, None)
+        if in_flight is not None:
+            old_req, old_decision = in_flight
+            self._release_for_decision(old_decision, old_req)
+            self._evicted[req.app_id] = old_req
+        else:
+            self._evicted[req.app_id] = req
+
+    # -- resource accounting ---------------------------------------------
+    def _policy_host_map(self) -> dict[str, HostState]:
+        self.expire_hosts()
+        out: dict[str, HostState] = {}
+        for ip, h in self._hosts.items():
+            out[ip] = HostState(
+                ip=ip, slots=h.state.slots, used_slots=h.state.used_slots,
+                n_devices=h.state.n_devices,
+                for_eviction=ip in self._next_evicted_ips)
+        return out
+
+    def _claim_for_decision(self, decision: SchedulingDecision,
+                            req: BatchExecuteRequest,
+                            keep_from: SchedulingDecision | None = None) -> None:
+        is_mpi = req.n_messages() > 0 and req.messages[0].is_mpi
+        for i, ip in enumerate(decision.hosts):
+            host = self._hosts.get(ip)
+            if host is None:
+                continue
+            host.state.claim(1)
+            if keep_from is not None and keep_from.hosts[i] == ip:
+                # Unmoved rank: re-claim its previous port/device
+                port = keep_from.mpi_ports[i]
+                dev = keep_from.device_ids[i]
+                if port:
+                    host.used_mpi_ports.add(port)
+                if 0 <= dev < len(host.device_load):
+                    host.device_load[dev] += 1
+                decision.mpi_ports[i] = port
+                decision.device_ids[i] = dev
+            else:
+                decision.mpi_ports[i] = host.claim_mpi_port() if is_mpi else 0
+                decision.device_ids[i] = host.claim_device()
+
+    def _release_for_decision(self, decision: SchedulingDecision,
+                              req: BatchExecuteRequest) -> None:
+        for i, ip in enumerate(decision.hosts):
+            host = self._hosts.get(ip)
+            if host is None:
+                continue
+            host.state.free(1)
+            if decision.mpi_ports[i]:
+                host.release_mpi_port(decision.mpi_ports[i])
+            host.release_device(decision.device_ids[i])
+
+    def _release_message(self, app_id: int, msg_id: int) -> None:
+        in_flight = self._in_flight.get(app_id)
+        if in_flight is None:
+            return
+        _, decision = in_flight
+        try:
+            i = decision.message_ids.index(msg_id)
+        except ValueError:
+            return
+        host = self._hosts.get(decision.hosts[i])
+        if host is not None:
+            host.state.free(1)
+            if decision.mpi_ports[i]:
+                host.release_mpi_port(decision.mpi_ports[i])
+            host.release_device(decision.device_ids[i])
+
+    # -- preload ----------------------------------------------------------
+    def preload_scheduling_decision(self, decision: SchedulingDecision) -> None:
+        with self._lock:
+            self._preloaded[decision.app_id] = decision
+            logger.debug("Preloaded decision for app %d (%d msgs)",
+                         decision.app_id, decision.n_messages)
+
+    def _slice_preloaded(self, preloaded: SchedulingDecision,
+                         req: BatchExecuteRequest
+                         ) -> Optional[SchedulingDecision]:
+        """Take the preloaded rows matching this request's app idxs
+        (reference Planner.cpp:1121-1136). Returns None — falling back to
+        the policy — when the preload doesn't cover the request."""
+        out = SchedulingDecision(req.app_id, preloaded.group_id)
+        by_idx = {preloaded.app_idxs[i]: i for i in range(preloaded.n_messages)}
+        for msg in req.messages:
+            i = by_idx.get(msg.app_idx)
+            if i is None:
+                logger.warning(
+                    "Preloaded decision for app %d lacks app_idx %d; "
+                    "falling back to the policy", req.app_id, msg.app_idx)
+                return None
+            out.add_message(preloaded.hosts[i], msg.id, msg.app_idx,
+                            preloaded.group_idxs[i])
+        return out
+
+    # ------------------------------------------------------------------
+    # Dispatch (reference Planner::dispatchSchedulingDecision)
+    # ------------------------------------------------------------------
+    def _build_dispatches(self, req: BatchExecuteRequest,
+                          decision: SchedulingDecision
+                          ) -> list[tuple[str, BatchExecuteRequest]]:
+        """Build the per-host sub-batches under the lock; the network sends
+        happen afterwards in _do_dispatch."""
+        per_host: dict[str, list[int]] = {}
+        for i, ip in enumerate(decision.hosts):
+            per_host.setdefault(ip, []).append(i)
+
+        single_host = len(per_host) == 1
+        out: list[tuple[str, BatchExecuteRequest]] = []
+        for ip, idxs in per_host.items():
+            sub = BatchExecuteRequest(
+                app_id=req.app_id, group_id=req.group_id, user=req.user,
+                function=req.function, type=req.type, subtype=req.subtype,
+                single_host=single_host, snapshot_key=req.snapshot_key,
+            )
+            sub.messages = [req.messages[i] for i in idxs]
+            out.append((ip, sub))
+        return out
+
+    def _do_dispatch(self, dispatches: list[tuple[str, BatchExecuteRequest]]) -> None:
+        for ip, sub in dispatches:
+            is_threads = sub.type == int(BatchExecuteType.THREADS)
+            if is_threads and not sub.single_host:
+                self._push_snapshot_for_threads(sub, ip)
+            try:
+                self._get_client(ip).execute_functions(sub)
+            except Exception:  # noqa: BLE001 — a dead host must not stall others
+                logger.exception("Dispatch of app %d to %s failed",
+                                 sub.app_id, ip)
+                continue
+            logger.debug("Dispatched %d msgs of app %d to %s",
+                         sub.n_messages(), sub.app_id, ip)
+
+    def _push_snapshot_for_threads(self, req: BatchExecuteRequest,
+                                   host: str) -> None:
+        """Push the main-thread snapshot ahead of remote THREADS dispatch
+        (reference Planner.cpp:1334-1360); wired by the snapshot layer."""
+
+    def _send_mappings(self, decision: SchedulingDecision) -> None:
+        """Distribute group mappings to involved hosts; wired by the PTP
+        broker layer (reference PointToPointBroker
+        setAndSendMappingsFromSchedulingDecision)."""
+        from faabric_tpu.transport import ptp_hook
+
+        ptp_hook.send_mappings_from_decision(decision)
+
+    def _get_client(self, ip: str):
+        from faabric_tpu.scheduler.function_call import FunctionCallClient
+
+        with self._clients_lock:
+            if ip not in self._clients:
+                self._clients[ip] = FunctionCallClient(ip)
+            return self._clients[ip]
+
+    # ------------------------------------------------------------------
+    # Results (reference Planner::setMessageResult / getMessageResult)
+    # ------------------------------------------------------------------
+    def set_message_result(self, msg: Message) -> None:
+        with self._lock:
+            app_id, msg_id = msg.app_id, msg.id
+
+            migrated = msg.return_value == int(ReturnValue.MIGRATED)
+            frozen = msg.return_value == int(ReturnValue.FROZEN)
+            if not migrated and not frozen:
+                self._release_message(app_id, msg_id)
+                self._results.setdefault(app_id, {})[msg_id] = msg
+
+                in_flight = self._in_flight.get(app_id)
+                if in_flight is not None:
+                    req, decision = in_flight
+                    decision.remove_message(msg_id)
+                    for i, m in enumerate(req.messages):
+                        if m.id == msg_id:
+                            del req.messages[i]
+                            break
+                    if decision.n_messages == 0:
+                        del self._in_flight[app_id]
+                        self._next_idx.pop(app_id, None)
+                        self._preloaded.pop(app_id, None)
+                        self._completed_order.append(app_id)
+                        self._evict_old_results()
+                        logger.debug("App %d complete", app_id)
+
+            waiters = self._waiters.pop((app_id, msg_id), set())
+            clients = [self._get_client(ip) for ip in waiters]
+
+        # Push results outside the lock (network)
+        for client in clients:
+            try:
+                client.set_message_result(msg)
+            except Exception:  # noqa: BLE001
+                logger.exception("Failed pushing result %d to waiter", msg_id)
+
+    # The planner is cluster-singleton and long-lived: completed apps'
+    # results are retained for late readers but bounded, oldest-first.
+    MAX_KEPT_APP_RESULTS = 1000
+
+    def _evict_old_results(self) -> None:
+        while len(self._completed_order) > self.MAX_KEPT_APP_RESULTS:
+            oldest = self._completed_order.pop(0)
+            self._results.pop(oldest, None)
+            self._expected.pop(oldest, None)
+
+    def get_message_result(self, app_id: int, msg_id: int,
+                           waiting_host: str = "") -> Optional[Message]:
+        """Return the result if known; otherwise register the waiting host
+        for a push when it lands (reference Planner.cpp:543-589)."""
+        with self._lock:
+            result = self._results.get(app_id, {}).get(msg_id)
+            if result is not None:
+                return result
+            if waiting_host:
+                self._waiters.setdefault((app_id, msg_id), set()).add(waiting_host)
+            return None
+
+    def get_batch_results(self, app_id: int) -> BatchExecuteRequestStatus:
+        with self._lock:
+            results = list(self._results.get(app_id, {}).values())
+            expected = self._expected.get(app_id, 0)
+            return BatchExecuteRequestStatus(
+                app_id=app_id,
+                finished=(app_id not in self._in_flight
+                          and expected > 0 and len(results) >= expected),
+                message_results=results,
+                expected_num_messages=expected,
+            )
+
+    def get_scheduling_decision(self, app_id: int) -> Optional[SchedulingDecision]:
+        with self._lock:
+            in_flight = self._in_flight.get(app_id)
+            return in_flight[1] if in_flight else None
+
+    # ------------------------------------------------------------------
+    # Observability / reset
+    # ------------------------------------------------------------------
+    def get_num_migrations(self) -> int:
+        with self._lock:
+            return self._num_migrations
+
+    def get_in_flight_apps(self) -> dict[int, SchedulingDecision]:
+        with self._lock:
+            return {app: d for app, (_, d) in self._in_flight.items()}
+
+    def get_frozen_apps(self) -> list[int]:
+        with self._lock:
+            return list(self._evicted)
+
+    def num_registered_hosts(self) -> int:
+        with self._lock:
+            return len(self._hosts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hosts.clear()
+            self._in_flight.clear()
+            self._results.clear()
+            self._expected.clear()
+            self._next_idx.clear()
+            self._completed_order.clear()
+            self._waiters.clear()
+            self._preloaded.clear()
+            self._evicted.clear()
+            self._next_evicted_ips.clear()
+            self._num_migrations = 0
+            for c in self._clients.values():
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._clients.clear()
+
+    def flush_scheduling_state(self) -> None:
+        with self._lock:
+            self._in_flight.clear()
+            self._results.clear()
+            self._expected.clear()
+            self._next_idx.clear()
+            self._completed_order.clear()
+            self._waiters.clear()
+            self._preloaded.clear()
+            for h in self._hosts.values():
+                h.state.used_slots = 0
+                h.used_mpi_ports.clear()
+                h.device_load = [0] * len(h.device_load)
+
+
+_planner: Optional[Planner] = None
+_planner_lock = threading.Lock()
+
+
+def get_planner() -> Planner:
+    global _planner
+    if _planner is None:
+        with _planner_lock:
+            if _planner is None:
+                _planner = Planner()
+    return _planner

@@ -1,0 +1,123 @@
+"""Planner RPC server (ports 8011/8012).
+
+Reference analog: src/planner/PlannerServer.cpp (249 lines), call enum
+include/faabric/planner/PlannerApi.h:207-224.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.planner.planner import get_planner
+from faabric_tpu.proto import (
+    ber_from_wire,
+    messages_from_wire,
+    messages_to_wire,
+)
+from faabric_tpu.transport.common import PLANNER_ASYNC_PORT, PLANNER_SYNC_PORT
+from faabric_tpu.transport.message import TransportMessage
+from faabric_tpu.transport.server import MessageEndpointServer, handler_response
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PlannerCalls(enum.IntEnum):
+    NO_CALL = 0
+    PING = 1
+    REGISTER_HOST = 2
+    REMOVE_HOST = 3
+    GET_AVAILABLE_HOSTS = 4
+    SET_MESSAGE_RESULT = 5
+    GET_MESSAGE_RESULT = 6
+    GET_BATCH_RESULTS = 7
+    GET_SCHEDULING_DECISION = 8
+    GET_NUM_MIGRATIONS = 9
+    CALL_BATCH = 10
+    PRELOAD_SCHEDULING_DECISION = 11
+
+
+class PlannerServer(MessageEndpointServer):
+    def __init__(self, port_offset: int = 0, n_threads: int = 4) -> None:
+        super().__init__(
+            PLANNER_ASYNC_PORT + port_offset,
+            PLANNER_SYNC_PORT + port_offset,
+            label="planner-server",
+            n_threads=n_threads,
+        )
+        self.planner = get_planner()
+
+    # ------------------------------------------------------------------
+    def do_async_recv(self, msg: TransportMessage) -> None:
+        if msg.code == int(PlannerCalls.SET_MESSAGE_RESULT):
+            result = messages_from_wire([msg.header["msg"]], msg.payload)[0]
+            self.planner.set_message_result(result)
+        else:
+            logger.warning("Unknown async planner call %d", msg.code)
+
+    # ------------------------------------------------------------------
+    def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:
+        code = msg.code
+        h = msg.header
+
+        if code == int(PlannerCalls.PING):
+            return handler_response(header={"pong": True})
+
+        if code == int(PlannerCalls.REGISTER_HOST):
+            timeout = self.planner.register_host(
+                h["host"], h["slots"], h.get("n_devices", 0),
+                overwrite=h.get("overwrite", False))
+            return handler_response(header={"host_timeout": timeout})
+
+        if code == int(PlannerCalls.REMOVE_HOST):
+            self.planner.remove_host(h["host"])
+            return handler_response()
+
+        if code == int(PlannerCalls.GET_AVAILABLE_HOSTS):
+            hosts = self.planner.get_available_hosts()
+            return handler_response(header={"hosts": [
+                {"ip": x.ip, "slots": x.slots, "used_slots": x.used_slots,
+                 "n_devices": x.n_devices} for x in hosts]})
+
+        if code == int(PlannerCalls.GET_MESSAGE_RESULT):
+            result = self.planner.get_message_result(
+                h["app_id"], h["msg_id"], h.get("host", ""))
+            if result is None:
+                return handler_response(header={"found": False})
+            dicts, tail = messages_to_wire([result])
+            return handler_response(header={"found": True, "msg": dicts[0]},
+                                    payload=tail)
+
+        if code == int(PlannerCalls.GET_BATCH_RESULTS):
+            status = self.planner.get_batch_results(h["app_id"])
+            dicts, tail = messages_to_wire(status.message_results)
+            return handler_response(header={
+                "app_id": status.app_id,
+                "finished": status.finished,
+                "expected_num_messages": status.expected_num_messages,
+                "messages": dicts,
+            }, payload=tail)
+
+        if code == int(PlannerCalls.GET_SCHEDULING_DECISION):
+            decision = self.planner.get_scheduling_decision(h["app_id"])
+            if decision is None:
+                return handler_response(header={"found": False})
+            return handler_response(header={"found": True,
+                                            "decision": decision.to_dict()})
+
+        if code == int(PlannerCalls.GET_NUM_MIGRATIONS):
+            return handler_response(
+                header={"num_migrations": self.planner.get_num_migrations()})
+
+        if code == int(PlannerCalls.CALL_BATCH):
+            req = ber_from_wire(msg.header["ber"], msg.payload)
+            decision = self.planner.call_batch(req)
+            return handler_response(header={"decision": decision.to_dict()})
+
+        if code == int(PlannerCalls.PRELOAD_SCHEDULING_DECISION):
+            decision = SchedulingDecision.from_dict(h["decision"])
+            self.planner.preload_scheduling_decision(decision)
+            return handler_response()
+
+        raise ValueError(f"Unknown sync planner call {code}")

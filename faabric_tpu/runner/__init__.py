@@ -1,0 +1,5 @@
+"""Worker runtime assembly (reference src/runner)."""
+
+from faabric_tpu.runner.runtime import WorkerRuntime
+
+__all__ = ["WorkerRuntime"]

@@ -1,0 +1,80 @@
+"""Worker runtime assembly — the FaabricMain analog
+(reference src/runner/FaabricMain.cpp:19-108).
+
+Boots one worker host: planner registration (+keep-alive), the
+FunctionCallServer, and — as the layers land — state/snapshot/PTP servers.
+Instantiable with an explicit host identity so two full workers can coexist
+in one process on aliased port ranges (SURVEY §4.2's dist-test trick).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from faabric_tpu.executor.factory import ExecutorFactory, set_executor_factory
+from faabric_tpu.planner.client import PlannerClient
+from faabric_tpu.scheduler.function_call import FunctionCallServer
+from faabric_tpu.scheduler.scheduler import Scheduler
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.network import get_primary_ip_for_this_host
+
+logger = get_logger(__name__)
+
+
+class WorkerRuntime:
+    def __init__(self, host: str = "", slots: int = 0, n_devices: int = 0,
+                 factory: Optional[ExecutorFactory] = None,
+                 planner_host: str | None = None) -> None:
+        conf = get_system_config()
+        self.host = host or get_primary_ip_for_this_host()
+        self.slots = slots or conf.get_usable_cores()
+        self.n_devices = n_devices
+
+        if factory is not None:
+            set_executor_factory(factory)
+
+        self.planner_client = PlannerClient(self.host, planner_host)
+        self.scheduler = Scheduler(self.host, self.planner_client)
+        self.function_server = FunctionCallServer(self.scheduler)
+
+        # Started by later layers: PTP server, snapshot server, state server
+        self.extra_servers: list = []
+
+        self._started = False
+
+    # ------------------------------------------------------------------
+    def start(self, register: bool = True) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.function_server.start()
+        self.scheduler.start()
+        self._start_extra_servers()
+        if register:
+            self.planner_client.register_host(
+                self.slots, self.n_devices, overwrite=True,
+                start_keep_alive=True)
+        logger.debug("Worker %s up (slots=%d chips=%d)", self.host,
+                     self.slots, self.n_devices)
+
+    def _start_extra_servers(self) -> None:
+        """Hook for PTP/snapshot/state servers as those layers land."""
+        for server in self.extra_servers:
+            server.start()
+
+    def shutdown(self, remove_host: bool = True) -> None:
+        if not self._started:
+            return
+        self._started = False
+        if remove_host:
+            try:
+                self.planner_client.remove_host()
+            except Exception:  # noqa: BLE001 — planner may already be gone
+                logger.debug("Could not deregister %s", self.host)
+        self.scheduler.shutdown()
+        for server in reversed(self.extra_servers):
+            server.stop()
+        self.function_server.stop()
+        self.planner_client.close()
+        logger.debug("Worker %s down", self.host)

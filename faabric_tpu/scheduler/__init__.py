@@ -1,0 +1,23 @@
+"""Per-host scheduler + function-call RPC (reference src/scheduler)."""
+
+from faabric_tpu.scheduler.function_call import (
+    FunctionCallClient,
+    FunctionCalls,
+    FunctionCallServer,
+    clear_mock_requests,
+    get_batch_requests,
+    get_flush_calls,
+    get_message_results,
+)
+from faabric_tpu.scheduler.scheduler import Scheduler
+
+__all__ = [
+    "FunctionCallClient",
+    "FunctionCallServer",
+    "FunctionCalls",
+    "Scheduler",
+    "clear_mock_requests",
+    "get_batch_requests",
+    "get_flush_calls",
+    "get_message_results",
+]

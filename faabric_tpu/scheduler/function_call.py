@@ -1,0 +1,150 @@
+"""Worker-to-worker / planner-to-worker function RPC.
+
+Reference analog: src/scheduler/FunctionCallServer.cpp (ports 8005/8006) and
+src/scheduler/FunctionCallClient.cpp. Async plane: EXECUTE_FUNCTIONS
+(planner dispatch → host scheduler) and SET_MESSAGE_RESULT (planner pushing
+a result to a waiting host). Sync plane: FLUSH.
+
+In mock mode the client records calls instead of sending — the backbone of
+the reference's unit-test strategy (FunctionCallClient.cpp:22-60).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import TYPE_CHECKING
+
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    Message,
+    ber_from_wire,
+    ber_to_wire,
+)
+from faabric_tpu.transport.client import MessageEndpointClient
+from faabric_tpu.transport.common import (
+    FUNCTION_CALL_ASYNC_PORT,
+    FUNCTION_CALL_SYNC_PORT,
+    get_host_alias_offset,
+)
+from faabric_tpu.transport.message import TransportMessage
+from faabric_tpu.transport.server import MessageEndpointServer, handler_response
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.testing import is_mock_mode
+
+if TYPE_CHECKING:  # pragma: no cover
+    from faabric_tpu.scheduler.scheduler import Scheduler
+
+logger = get_logger(__name__)
+
+
+class FunctionCalls(enum.IntEnum):
+    NO_FUNCTION_CALL = 0
+    EXECUTE_FUNCTIONS = 1
+    FLUSH = 2
+    SET_MESSAGE_RESULT = 3
+
+
+# ---------------------------------------------------------------------------
+# Mock recording (reference getBatchRequests/getMessageResults)
+# ---------------------------------------------------------------------------
+_mock_lock = threading.Lock()
+_batch_messages: list[tuple[str, BatchExecuteRequest]] = []
+_message_results: list[tuple[str, Message]] = []
+_flush_calls: list[str] = []
+
+
+def get_batch_requests() -> list[tuple[str, BatchExecuteRequest]]:
+    with _mock_lock:
+        return list(_batch_messages)
+
+
+def get_message_results() -> list[tuple[str, Message]]:
+    with _mock_lock:
+        return list(_message_results)
+
+
+def get_flush_calls() -> list[str]:
+    with _mock_lock:
+        return list(_flush_calls)
+
+
+def clear_mock_requests() -> None:
+    with _mock_lock:
+        _batch_messages.clear()
+        _message_results.clear()
+        _flush_calls.clear()
+
+
+# ---------------------------------------------------------------------------
+
+class FunctionCallClient(MessageEndpointClient):
+    def __init__(self, host: str) -> None:
+        super().__init__(host, FUNCTION_CALL_ASYNC_PORT, FUNCTION_CALL_SYNC_PORT)
+
+    def execute_functions(self, req: BatchExecuteRequest) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _batch_messages.append((self.host, req))
+            return
+        header, tail = ber_to_wire(req)
+        self.async_send(int(FunctionCalls.EXECUTE_FUNCTIONS), header, tail)
+
+    def set_message_result(self, msg: Message) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _message_results.append((self.host, msg))
+            return
+        header, tail = _message_to_wire(msg)
+        self.async_send(int(FunctionCalls.SET_MESSAGE_RESULT), header, tail)
+
+    def send_flush(self) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _flush_calls.append(self.host)
+            return
+        self.sync_send(int(FunctionCalls.FLUSH))
+
+
+def _message_to_wire(msg: Message) -> tuple[dict, bytes]:
+    from faabric_tpu.proto import messages_to_wire
+
+    dicts, tail = messages_to_wire([msg])
+    return {"msg": dicts[0]}, tail
+
+
+def _message_from_wire(header: dict, tail: bytes) -> Message:
+    from faabric_tpu.proto import messages_from_wire
+
+    return messages_from_wire([header["msg"]], tail)[0]
+
+
+class FunctionCallServer(MessageEndpointServer):
+    def __init__(self, scheduler: "Scheduler") -> None:
+        conf = get_system_config()
+        offset = get_host_alias_offset(scheduler.host)
+        super().__init__(
+            FUNCTION_CALL_ASYNC_PORT + offset,
+            FUNCTION_CALL_SYNC_PORT + offset,
+            label=f"function-server-{scheduler.host}",
+            n_threads=conf.function_server_threads,
+        )
+        self.scheduler = scheduler
+
+    def do_async_recv(self, msg: TransportMessage) -> None:
+        code = msg.code
+        if code == int(FunctionCalls.EXECUTE_FUNCTIONS):
+            req = ber_from_wire(msg.header, msg.payload)
+            self.scheduler.execute_batch(req)
+        elif code == int(FunctionCalls.SET_MESSAGE_RESULT):
+            result = _message_from_wire(msg.header, msg.payload)
+            self.scheduler.planner_client.set_message_result_locally(result)
+        else:
+            logger.warning("Unknown async function call %d", code)
+
+    def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:
+        if msg.code == int(FunctionCalls.FLUSH):
+            self.scheduler.flush()
+            return handler_response()
+        raise ValueError(f"Unknown sync function call {msg.code}")

@@ -1,0 +1,217 @@
+"""Host-local scheduler.
+
+Reference analog: src/scheduler/Scheduler.cpp:250-386 (executeBatch /
+claimExecutor), :160-237 (reaper), include/faabric/scheduler/Scheduler.h.
+
+Each worker host runs one Scheduler. It receives dispatched batches from
+the planner, claims a warm executor per message (one executor runs all
+messages of a THREADS batch), and reports results back to the planner.
+Executors idle longer than ``bound_timeout`` are reaped periodically.
+
+Unlike the reference (process-wide singleton), a Scheduler is instantiable
+with an explicit host identity so in-process multi-host tests can run two
+full worker runtimes side by side (SURVEY §4.2's aliasing trick).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Optional
+
+from faabric_tpu.executor.executor import Executor
+from faabric_tpu.executor.factory import get_executor_factory
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    BatchExecuteType,
+    Message,
+    ReturnValue,
+    func_to_string,
+)
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.periodic import PeriodicBackgroundThread
+
+if TYPE_CHECKING:  # pragma: no cover
+    from faabric_tpu.planner.client import PlannerClient
+
+logger = get_logger(__name__)
+
+
+class ReaperThread(PeriodicBackgroundThread):
+    """Reaps executors idle beyond bound_timeout
+    (reference SchedulerReaperThread, Scheduler.cpp:160-237)."""
+
+    def __init__(self, scheduler: "Scheduler") -> None:
+        super().__init__()
+        self.scheduler = scheduler
+
+    def do_work(self) -> None:
+        self.scheduler.reap_idle_executors()
+
+
+class Scheduler:
+    def __init__(self, host: str, planner_client: "PlannerClient") -> None:
+        self.host = host
+        self.planner_client = planner_client
+
+        self._lock = threading.RLock()
+        # func string → executors (warm pool)
+        self._executors: dict[str, list[Executor]] = {}
+
+        self._reaper = ReaperThread(self)
+        self._started = False
+
+        # Thread results cache for THREADS batches (msg id → (ret, msg))
+        self._thread_results: dict[int, tuple[int, Message]] = {}
+        self._thread_result_cv = threading.Condition()
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        conf = get_system_config()
+        self._reaper.start(conf.reaper_interval_secs)
+
+    def shutdown(self) -> None:
+        self._reaper.stop()
+        with self._lock:
+            executors = [e for lst in self._executors.values() for e in lst]
+            self._executors.clear()
+        for e in executors:
+            e.shutdown()
+        self._started = False
+
+    def reset(self) -> None:
+        """Test reset: drop executors, keep identity."""
+        self.shutdown()
+        with self._thread_result_cv:
+            self._thread_results.clear()
+
+    def flush(self) -> None:
+        """Host flush (reference FunctionCallServer::recvFlush): clear
+        executors and give the factory its flush hook."""
+        logger.debug("Flushing host %s", self.host)
+        with self._lock:
+            executors = [e for lst in self._executors.values() for e in lst]
+            self._executors.clear()
+        for e in executors:
+            e.shutdown()
+        try:
+            get_executor_factory().flush_host()
+        except RuntimeError:
+            pass
+
+    # ------------------------------------------------------------------
+    # Batch execution (reference Scheduler.cpp:250-325)
+    # ------------------------------------------------------------------
+    def execute_batch(self, req: BatchExecuteRequest) -> None:
+        if req.n_messages() == 0:
+            return
+        is_threads = req.type == int(BatchExecuteType.THREADS)
+        first = req.messages[0]
+
+        if is_threads:
+            # One executor runs every thread of the batch (shared memory)
+            executor = self.claim_executor(first)
+            if executor is None:
+                self._fail_batch(req)
+                return
+            executor.execute_tasks(list(range(req.n_messages())), req)
+            return
+
+        # FUNCTIONS/PROCESSES/MIGRATION: one executor per message
+        for idx, msg in enumerate(req.messages):
+            executor = self.claim_executor(msg)
+            if executor is None:
+                # Could not claim: report failure so callers don't hang
+                # (reference Scheduler.cpp:307-322)
+                msg.return_value = int(ReturnValue.FAILED)
+                msg.output_data = b"No executor available"
+                self.report_message_result(msg)
+                continue
+            executor.execute_tasks([idx], req)
+
+    def _fail_batch(self, req: BatchExecuteRequest) -> None:
+        for msg in req.messages:
+            msg.return_value = int(ReturnValue.FAILED)
+            msg.output_data = b"No executor available"
+            self.report_message_result(msg)
+
+    def claim_executor(self, msg: Message) -> Optional[Executor]:
+        """Reuse a warm executor or create one via the factory
+        (reference Scheduler.cpp:339-386)."""
+        func = func_to_string(msg)
+        with self._lock:
+            for e in self._executors.get(func, []):
+                if e.try_claim():
+                    return e
+            try:
+                factory = get_executor_factory()
+            except RuntimeError:
+                logger.error("No executor factory while claiming for %s", func)
+                return None
+            executor = factory.create_executor(msg)
+            executor.scheduler = self
+            if not executor.try_claim():  # pragma: no cover — fresh executor
+                return None
+            self._executors.setdefault(func, []).append(executor)
+            logger.debug("%s created executor %s (%d warm)", self.host,
+                         executor.id, len(self._executors[func]))
+            return executor
+
+    def notify_executor_idle(self, executor: Executor) -> None:
+        """Hook from the executor when its batch drains; reaping happens on
+        the periodic thread."""
+
+    def reap_idle_executors(self) -> None:
+        conf = get_system_config()
+        to_shutdown: list[Executor] = []
+        with self._lock:
+            for func, lst in list(self._executors.items()):
+                keep: list[Executor] = []
+                for e in lst:
+                    if not e.is_claimed() and e.uptime_idle() > conf.bound_timeout:
+                        to_shutdown.append(e)
+                    else:
+                        keep.append(e)
+                if keep:
+                    self._executors[func] = keep
+                else:
+                    self._executors.pop(func, None)
+        for e in to_shutdown:
+            logger.debug("Reaping executor %s (idle %.1fs)", e.id, e.uptime_idle())
+            e.shutdown()
+
+    def get_executor_count(self, msg: Message | None = None) -> int:
+        with self._lock:
+            if msg is not None:
+                return len(self._executors.get(func_to_string(msg), []))
+            return sum(len(v) for v in self._executors.values())
+
+    # ------------------------------------------------------------------
+    # Results
+    # ------------------------------------------------------------------
+    def report_message_result(self, msg: Message) -> None:
+        self.planner_client.set_message_result(msg)
+
+    def set_thread_result(self, msg: Message, return_value: int) -> None:
+        """THREADS results stay host-local until the batch's diffs merge
+        (reference setThreadResultLocally); the planner still learns the
+        message result so waiters unblock."""
+        with self._thread_result_cv:
+            self._thread_results[msg.id] = (return_value, msg)
+            self._thread_result_cv.notify_all()
+        self.planner_client.set_message_result(msg)
+
+    def await_thread_result(self, msg_id: int, timeout: float | None = None) -> int:
+        conf = get_system_config()
+        timeout = timeout if timeout is not None else conf.global_message_timeout
+        with self._thread_result_cv:
+            ok = self._thread_result_cv.wait_for(
+                lambda: msg_id in self._thread_results, timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"Timed out waiting for thread result {msg_id}")
+            return self._thread_results[msg_id][0]

@@ -78,19 +78,20 @@ class MessageEndpointClient:
                         ) from e
 
     def sync_send(self, code: int, header: dict[str, Any] | None = None,
-                  payload: bytes = b"") -> TransportMessage:
+                  payload: bytes = b"", idempotent: bool = False) -> TransportMessage:
         """Send a request and await its response.
 
-        Retry discipline (at-most-once for non-idempotent RPCs):
+        Retry discipline:
         - Failure while dialing or sending → retry once on a fresh
           connection; the request cannot have been executed.
-        - Failure after send on a REUSED keep-alive connection with zero
-          response bytes read → retry once. On TCP a stale socket usually
-          accepts the send into the kernel buffer and only fails at recv
-          with a reset, so this is the common server-restart signature.
-        - Failure after send on a FRESH connection, or after response bytes
-          arrived, or a recv timeout → surface the error; the server may
-          already have run the RPC.
+        - Failure after the request was fully sent → NOT retried by
+          default: the server may already have executed it, and a
+          zero-response-bytes signature cannot distinguish "never
+          delivered" from "executed but the response was lost". Callers
+          whose RPC is safe to repeat pass ``idempotent=True`` to also
+          retry the common stale-keep-alive signature (reused connection,
+          zero response bytes, not a timeout — i.e. a server restart
+          between requests).
         """
         msg = TransportMessage(code=code, header=header or {}, payload=payload)
         with self._locks["sync"]:
@@ -106,7 +107,8 @@ class MessageEndpointClient:
                 except (OSError, TransportError) as e:
                     self._reset_sock("sync")
                     likely_stale = (
-                        not fresh
+                        idempotent
+                        and not fresh
                         and not isinstance(e, socket.timeout)
                         and getattr(e, "no_response_data", False)
                     )

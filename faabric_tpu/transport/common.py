@@ -32,6 +32,7 @@ DEFAULT_SOCKET_TIMEOUT = 60.0
 
 _aliases: dict[str, tuple[str, int]] = {}
 _alias_lock = threading.Lock()
+_env_aliases_loaded = False
 
 
 def register_host_alias(host: str, ip: str = "127.0.0.1", port_offset: int = 0) -> None:
@@ -39,9 +40,30 @@ def register_host_alias(host: str, ip: str = "127.0.0.1", port_offset: int = 0) 
         _aliases[host] = (ip, port_offset)
 
 
+def _load_env_aliases_locked() -> None:
+    """Multi-process single-machine clusters share one alias table via
+    FAABRIC_HOST_ALIASES="w1=127.0.0.1+30000,w2=127.0.0.1+31000" — the
+    analog of the reference's docker-compose network hostnames."""
+    global _env_aliases_loaded
+    if _env_aliases_loaded:
+        return
+    _env_aliases_loaded = True
+    import os
+
+    spec = os.environ.get("FAABRIC_HOST_ALIASES", "")
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        try:
+            name, target = entry.split("=", 1)
+            ip, _, offset = target.partition("+")
+            _aliases.setdefault(name, (ip or "127.0.0.1", int(offset or 0)))
+        except ValueError:
+            continue
+
+
 def resolve_host(host: str, port: int) -> tuple[str, int]:
     """Map a logical host + canonical port to a dialable (ip, port)."""
     with _alias_lock:
+        _load_env_aliases_locked()
         if host in _aliases:
             ip, offset = _aliases[host]
             return ip, port + offset
@@ -50,11 +72,14 @@ def resolve_host(host: str, port: int) -> tuple[str, int]:
 
 def get_host_alias_offset(host: str) -> int:
     with _alias_lock:
+        _load_env_aliases_locked()
         if host in _aliases:
             return _aliases[host][1]
     return 0
 
 
 def clear_host_aliases() -> None:
+    global _env_aliases_loaded
     with _alias_lock:
         _aliases.clear()
+        _env_aliases_loaded = False

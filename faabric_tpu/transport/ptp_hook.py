@@ -1,0 +1,26 @@
+"""Indirection between the planner and the PTP broker.
+
+The planner distributes group mappings for every decision it takes
+(reference Planner.cpp → PointToPointBroker::
+setAndSendMappingsFromSchedulingDecision). The broker registers itself here
+at import time; until then sending mappings is a no-op so the control plane
+works stand-alone.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+
+_sender: Optional[Callable[[SchedulingDecision], None]] = None
+
+
+def register_mapping_sender(fn: Callable[[SchedulingDecision], None]) -> None:
+    global _sender
+    _sender = fn
+
+
+def send_mappings_from_decision(decision: SchedulingDecision) -> None:
+    if _sender is not None:
+        _sender(decision)

@@ -24,6 +24,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 @dataclasses.dataclass
 class SystemConfig:
     # Logging
@@ -71,6 +78,9 @@ class SystemConfig:
     # Planner
     planner_host: str = "localhost"
     planner_port: int = 8011
+    # Hosts expire if they miss keep-alives for this long (reference
+    # PlannerConfig.hostTimeout; workers re-register every half-timeout)
+    planner_host_timeout: float = 30.0
 
     # Transport
     serialisation: str = "json"
@@ -125,6 +135,7 @@ class SystemConfig:
 
         self.planner_host = _env("PLANNER_HOST", "localhost")
         self.planner_port = _env_int("PLANNER_PORT", 8011)
+        self.planner_host_timeout = _env_float("PLANNER_HOST_TIMEOUT", 30.0)
 
         self.serialisation = _env("SERIALISATION", "json")
         self.mesh_device_kind = _env("MESH_DEVICE_KIND", "auto")

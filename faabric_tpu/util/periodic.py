@@ -32,7 +32,12 @@ class PeriodicBackgroundThread:
 
     def start(self, interval_seconds: float) -> None:
         if self._thread is not None:
-            return
+            # A previously stuck thread that has since drained can be
+            # reclaimed; a live one means we're already running.
+            if self._thread.is_alive():
+                return
+            self._thread = None
+            self.tidy_up()
         self.interval = interval_seconds
         self._stop_event.clear()
         self._thread = threading.Thread(

@@ -1,0 +1,213 @@
+"""End-to-end control-plane + execution tests.
+
+The reference exercises this with two containers (tests/dist); here two full
+worker runtimes run in one process on aliased port ranges (SURVEY §4.2), a
+real PlannerServer in between — every RPC crosses real sockets.
+"""
+
+import random
+import time
+
+import pytest
+
+from faabric_tpu.executor import (
+    Executor,
+    ExecutorContext,
+    ExecutorFactory,
+    set_executor_factory,
+)
+from faabric_tpu.planner import PlannerClient, PlannerServer, get_planner
+from faabric_tpu.proto import ReturnValue, batch_exec_factory
+from faabric_tpu.runner import WorkerRuntime
+from faabric_tpu.scheduler import (
+    FunctionCallClient,
+    clear_mock_requests,
+    get_batch_requests,
+)
+from faabric_tpu.transport.common import register_host_alias
+from faabric_tpu.util.testing import set_mock_mode
+
+
+class EchoExecutor(Executor):
+    """Echoes input reversed; function "fail" raises; "ctx" asserts context."""
+
+    def execute_task(self, thread_pool_idx, msg_idx, req):
+        msg = req.messages[msg_idx]
+        if msg.function == "fail":
+            raise RuntimeError("intentional failure")
+        ctx = ExecutorContext.get()
+        assert ctx.msg is msg
+        assert ctx.executor is self
+        msg.output_data = msg.input_data[::-1]
+        return int(ReturnValue.SUCCESS)
+
+
+class EchoFactory(ExecutorFactory):
+    def __init__(self):
+        self.created = 0
+
+    def create_executor(self, msg):
+        self.created += 1
+        return EchoExecutor(msg)
+
+
+@pytest.fixture
+def cluster():
+    """PlannerServer + two aliased worker runtimes in one process."""
+    # Offsets keep every port in (8003..8012)+offset within 16-bit range
+    base = random.randint(100, 500) * 100
+    register_host_alias("planner", "127.0.0.1", base)
+    register_host_alias("hostA", "127.0.0.1", base + 1000)
+    register_host_alias("hostB", "127.0.0.1", base + 2000)
+
+    get_planner().reset()
+    planner_server = PlannerServer(port_offset=base)
+    planner_server.start()
+
+    factory = EchoFactory()
+    set_executor_factory(factory)
+
+    workers = {}
+    for name in ("hostA", "hostB"):
+        w = WorkerRuntime(host=name, slots=4, n_devices=4,
+                          planner_host="planner")
+        w.start()
+        workers[name] = w
+
+    yield {"planner_server": planner_server, "workers": workers,
+           "factory": factory}
+
+    for w in workers.values():
+        w.shutdown()
+    planner_server.stop()
+    get_planner().reset()
+    set_executor_factory(None)
+
+
+def test_single_host_batch(cluster):
+    w = cluster["workers"]["hostA"]
+    req = batch_exec_factory("demo", "echo", 3)
+    for i, m in enumerate(req.messages):
+        m.input_data = f"msg-{i}".encode()
+    decision = w.planner_client.call_functions(req)
+    assert decision.n_messages == 3
+    for m in req.messages:
+        result = w.planner_client.get_message_result(req.app_id, m.id,
+                                                     timeout=10.0)
+        assert result.return_value == int(ReturnValue.SUCCESS)
+        assert result.output_data == m.input_data[::-1]
+        assert result.executed_host in ("hostA", "hostB")
+
+
+def test_two_host_batch_spreads_and_completes(cluster):
+    """The VERDICT round-2 'done' criterion: an 8-message batch through the
+    planner executes on both hosts and results flow back."""
+    w = cluster["workers"]["hostA"]
+    req = batch_exec_factory("demo", "echo", 8)
+    for i, m in enumerate(req.messages):
+        m.input_data = bytes([i]) * 8
+
+    decision = w.planner_client.call_functions(req)
+    assert decision.n_messages == 8
+    assert set(decision.hosts) == {"hostA", "hostB"}
+    # Chips pinned from each host's 4-chip inventory
+    assert all(d >= 0 for d in decision.device_ids)
+
+    executed_hosts = set()
+    for m in req.messages:
+        result = w.planner_client.get_message_result(req.app_id, m.id,
+                                                     timeout=10.0)
+        assert result.return_value == int(ReturnValue.SUCCESS)
+        assert result.output_data == m.input_data[::-1]
+        executed_hosts.add(result.executed_host)
+    assert executed_hosts == {"hostA", "hostB"}
+
+    # Batch completes: slots return, in-flight drains
+    planner = get_planner()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        status = planner.get_batch_results(req.app_id)
+        if status.finished:
+            break
+        time.sleep(0.05)
+    assert status.finished
+    assert status.expected_num_messages == 8
+    hosts = planner.get_available_hosts()
+    assert all(h.used_slots == 0 for h in hosts)
+    assert planner.get_scheduling_decision(req.app_id) is None
+
+
+def test_failure_result_propagates(cluster):
+    w = cluster["workers"]["hostA"]
+    req = batch_exec_factory("demo", "fail", 1)
+    w.planner_client.call_functions(req)
+    result = w.planner_client.get_message_result(
+        req.app_id, req.messages[0].id, timeout=10.0)
+    assert result.return_value == int(ReturnValue.FAILED)
+    assert b"intentional failure" in result.output_data
+
+
+def test_warm_executor_reuse(cluster):
+    w = cluster["workers"]["hostA"]
+    factory = cluster["factory"]
+    for _ in range(3):
+        req = batch_exec_factory("demo", "echo", 2)
+        w.planner_client.call_functions(req)
+        for m in req.messages:
+            w.planner_client.get_message_result(req.app_id, m.id, timeout=10.0)
+    # Executors are reused across batches, never recreated per message
+    assert factory.created <= 4
+
+
+def test_scale_change_adds_messages(cluster):
+    w = cluster["workers"]["hostA"]
+    req = batch_exec_factory("demo", "echo", 2)
+    w.planner_client.call_functions(req)
+    decision1 = w.planner_client.get_scheduling_decision(req.app_id)
+    assert decision1 is not None and decision1.n_messages == 2
+
+    # Chain two more messages into the running app
+    scale = batch_exec_factory("demo", "echo", 2)
+    scale.app_id = req.app_id
+    for i, m in enumerate(scale.messages):
+        m.app_id = req.app_id
+        m.app_idx = 2 + i
+    d2 = w.planner_client.call_functions(scale)
+    assert d2.n_messages == 2
+
+    for m in req.messages + scale.messages:
+        result = w.planner_client.get_message_result(req.app_id, m.id,
+                                                     timeout=10.0)
+        assert result.return_value == int(ReturnValue.SUCCESS)
+
+
+def test_get_available_hosts_and_expiry(cluster):
+    w = cluster["workers"]["hostA"]
+    hosts = w.planner_client.get_available_hosts()
+    assert {h["ip"] for h in hosts} == {"hostA", "hostB"}
+    assert all(h["n_devices"] == 4 for h in hosts)
+    # Manual removal drops the host
+    cluster["workers"]["hostB"].planner_client.remove_host()
+    hosts = w.planner_client.get_available_hosts()
+    assert {h["ip"] for h in hosts} == {"hostA"}
+
+
+def test_planner_ping(cluster):
+    assert cluster["workers"]["hostA"].planner_client.ping()
+
+
+def test_mock_mode_records_function_calls():
+    """Mock mode short-circuits the wire (reference
+    FunctionCallClient.cpp:22-60) — no servers needed at all."""
+    set_mock_mode(True)
+    try:
+        cli = FunctionCallClient("nowhere")
+        req = batch_exec_factory("demo", "echo", 2)
+        cli.execute_functions(req)
+        recorded = get_batch_requests()
+        assert len(recorded) == 1
+        assert recorded[0][0] == "nowhere"
+        assert recorded[0][1].app_id == req.app_id
+    finally:
+        set_mock_mode(False)
+        clear_mock_requests()

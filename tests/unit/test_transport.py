@@ -251,13 +251,20 @@ def test_sync_send_recovers_stale_keepalive_but_not_fresh_failure():
     srv.start()
     cli = MessageEndpointClient("127.0.0.1", ap, sp, timeout=3.0)
     try:
-        assert cli.sync_send(1).header["pong"]
+        assert cli.sync_send(1, idempotent=True).header["pong"]
         # Restart the server: the client's keep-alive socket is now stale
         srv.stop()
         srv = Srv(ap, sp)
         srv.start()
-        # Must transparently retry on a fresh connection
-        assert cli.sync_send(1).header["pong"]
+        # Idempotent RPCs transparently retry on a fresh connection
+        assert cli.sync_send(1, idempotent=True).header["pong"]
+        # Non-idempotent RPCs surface the stale-socket error instead of
+        # risking double execution
+        srv.stop()
+        srv = Srv(ap, sp)
+        srv.start()
+        with pytest.raises(RpcError):
+            cli.sync_send(1)
     finally:
         cli.close()
         srv.stop()
