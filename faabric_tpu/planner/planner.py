@@ -108,6 +108,8 @@ class Planner:
         # app_id → frozen request (spot eviction)
         self._evicted: dict[int, BatchExecuteRequest] = {}
         self._next_evicted_ips: set[str] = set()
+        # app_id → (group_id, hosts ever involved) for group cleanup
+        self._group_hosts: dict[int, tuple[int, set[str]]] = {}
         self._num_migrations = 0
         self._clients: dict[str, "object"] = {}
         self._clients_lock = threading.Lock()
@@ -199,24 +201,39 @@ class Planner:
                 return decision
 
             if decision_type == DecisionType.NEW:
-                decision, dispatches = self._handle_new(req, decision)
+                decision, mappings, dispatches = self._handle_new(req, decision)
             elif decision_type == DecisionType.SCALE_CHANGE:
-                decision, dispatches = self._handle_scale_change(req, decision)
+                decision, mappings, dispatches = self._handle_scale_change(
+                    req, decision)
             else:
-                decision, dispatches = self._handle_dist_change(req, decision)
+                decision, mappings, dispatches = self._handle_dist_change(
+                    req, decision)
 
+        # Network I/O strictly outside the lock: mappings first (guest code
+        # blocks on wait_for_mappings before messaging), then dispatch.
+        with self._lock:
+            gid, hosts = self._group_hosts.get(req.app_id, (mappings.group_id,
+                                                            set()))
+            self._group_hosts[req.app_id] = (
+                mappings.group_id, hosts | set(mappings.hosts))
+        self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         return decision
 
     # -- decision handling (all run under self._lock; they return the
-    # network dispatches to perform after the lock is released) -----------
+    # mapping distribution + dispatches to perform after the lock drops) --
     def _handle_new(self, req: BatchExecuteRequest,
                     decision: SchedulingDecision
-                    ) -> tuple[SchedulingDecision, list]:
+                    ) -> tuple[SchedulingDecision, SchedulingDecision, list]:
         group_id = req.group_id or generate_gid()
         decision.group_id = group_id
         update_batch_exec_group_id(req, group_id)
         for i, msg in enumerate(req.messages):
+            # Messages that didn't pick their own group idx (plain FUNCTIONS
+            # batches) take their app idx, so every batch forms a usable
+            # PTP group
+            if decision.group_idxs[i] == 0 and decision.app_idxs[i] != 0:
+                decision.group_idxs[i] = decision.app_idxs[i]
             msg.group_idx = decision.group_idxs[i]
         self._claim_for_decision(decision, req)
         self._in_flight[req.app_id] = (req, decision)
@@ -224,12 +241,11 @@ class Planner:
         self._next_idx[req.app_id] = 1 + max(
             (m.app_idx for m in req.messages), default=req.n_messages() - 1)
         self._results.setdefault(req.app_id, {})
-        self._send_mappings(decision)
-        return decision, self._build_dispatches(req, decision)
+        return decision, decision, self._build_dispatches(req, decision)
 
     def _handle_scale_change(self, req: BatchExecuteRequest,
                              decision: SchedulingDecision
-                             ) -> tuple[SchedulingDecision, list]:
+                             ) -> tuple[SchedulingDecision, SchedulingDecision, list]:
         old_req, old_decision = self._in_flight[req.app_id]
         update_batch_exec_group_id(req, old_decision.group_id)
         decision.group_id = old_decision.group_id
@@ -261,12 +277,11 @@ class Planner:
         self._expected[req.app_id] = (
             self._expected.get(req.app_id, 0) + req.n_messages())
 
-        self._send_mappings(old_decision)
-        return decision, self._build_dispatches(req, decision)
+        return decision, old_decision, self._build_dispatches(req, decision)
 
     def _handle_dist_change(self, req: BatchExecuteRequest,
                             decision: SchedulingDecision
-                            ) -> tuple[SchedulingDecision, list]:
+                            ) -> tuple[SchedulingDecision, SchedulingDecision, list]:
         old_req, old_decision = self._in_flight[req.app_id]
 
         # Transfer claims: release every old placement, then re-claim.
@@ -281,10 +296,9 @@ class Planner:
 
         update_batch_exec_group_id(old_req, new_group_id)
         self._in_flight[req.app_id] = (old_req, decision)
-        self._send_mappings(decision)
         # The migrating ranks re-dispatch themselves via the migration
         # exception + MIGRATION batch (reference §3.5); no dispatch here.
-        return decision, []
+        return decision, decision, []
 
     def _freeze_app(self, req: BatchExecuteRequest) -> None:
         """Park a running app: release its resources and remember the
@@ -428,12 +442,12 @@ class Planner:
         (reference Planner.cpp:1334-1360); wired by the snapshot layer."""
 
     def _send_mappings(self, decision: SchedulingDecision) -> None:
-        """Distribute group mappings to involved hosts; wired by the PTP
-        broker layer (reference PointToPointBroker
+        """Distribute group mappings to every involved host's PTP server
+        (reference PointToPointBroker::
         setAndSendMappingsFromSchedulingDecision)."""
-        from faabric_tpu.transport import ptp_hook
+        from faabric_tpu.transport.ptp_remote import send_mappings_from_decision
 
-        ptp_hook.send_mappings_from_decision(decision)
+        send_mappings_from_decision(decision)
 
     def _get_client(self, ip: str):
         from faabric_tpu.scheduler.function_call import FunctionCallClient
@@ -474,13 +488,21 @@ class Planner:
 
             waiters = self._waiters.pop((app_id, msg_id), set())
             clients = [self._get_client(ip) for ip in waiters]
+            group_cleanup = None
+            if app_id not in self._in_flight:
+                group_cleanup = self._group_hosts.pop(app_id, None)
 
-        # Push results outside the lock (network)
+        # Push results + group cleanup outside the lock (network)
         for client in clients:
             try:
                 client.set_message_result(msg)
             except Exception:  # noqa: BLE001
                 logger.exception("Failed pushing result %d to waiter", msg_id)
+        if group_cleanup is not None:
+            from faabric_tpu.transport.ptp_remote import send_clear_group
+
+            gid, hosts = group_cleanup
+            send_clear_group(gid, sorted(hosts))
 
     # The planner is cluster-singleton and long-lived: completed apps'
     # results are retained for late readers but bounded, oldest-first.
@@ -552,6 +574,7 @@ class Planner:
             self._preloaded.clear()
             self._evicted.clear()
             self._next_evicted_ips.clear()
+            self._group_hosts.clear()
             self._num_migrations = 0
             for c in self._clients.values():
                 try:
@@ -559,6 +582,9 @@ class Planner:
                 except Exception:  # noqa: BLE001
                     pass
             self._clients.clear()
+        from faabric_tpu.transport.ptp_remote import close_mapping_clients
+
+        close_mapping_clients()
 
     def flush_scheduling_state(self) -> None:
         with self._lock:

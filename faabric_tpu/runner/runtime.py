@@ -38,8 +38,16 @@ class WorkerRuntime:
         self.scheduler = Scheduler(self.host, self.planner_client)
         self.function_server = FunctionCallServer(self.scheduler)
 
-        # Started by later layers: PTP server, snapshot server, state server
-        self.extra_servers: list = []
+        # PTP group messaging (reference FaabricMain starts a
+        # PointToPointServer per worker)
+        from faabric_tpu.transport.point_to_point import PointToPointBroker
+        from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+        self.ptp_broker = PointToPointBroker(self.host)
+        self.scheduler.ptp_broker = self.ptp_broker
+
+        # Started by later layers: snapshot server, state server
+        self.extra_servers: list = [PointToPointServer(self.ptp_broker)]
 
         self._started = False
 
@@ -76,5 +84,6 @@ class WorkerRuntime:
         for server in reversed(self.extra_servers):
             server.stop()
         self.function_server.stop()
+        self.ptp_broker.clear()
         self.planner_client.close()
         logger.debug("Worker %s down", self.host)

@@ -61,6 +61,10 @@ class Scheduler:
         self._reaper = ReaperThread(self)
         self._started = False
 
+        # Set by the WorkerRuntime: this host's PTP broker, reachable from
+        # guest code via ExecutorContext → executor → scheduler
+        self.ptp_broker = None
+
         # Thread results cache for THREADS batches (msg id → (ret, msg))
         self._thread_results: dict[int, tuple[int, Message]] = {}
         self._thread_result_cv = threading.Condition()

@@ -43,3 +43,20 @@ __all__ = [
     "PLANNER_SYNC_PORT",
     "MPI_BASE_PORT",
 ]
+
+from faabric_tpu.transport.point_to_point import (  # noqa: E402
+    POINT_TO_POINT_MAIN_IDX,
+    PointToPointBroker,
+    PointToPointGroup,
+    mappings_from_decision,
+)
+from faabric_tpu.transport.ptp_remote import (  # noqa: E402
+    PointToPointCall,
+    PointToPointClient,
+    PointToPointServer,
+    clear_sent_ptp,
+    get_lock_ops,
+    get_sent_mappings,
+    get_sent_ptp_messages,
+    send_mappings_from_decision,
+)

@@ -1,0 +1,424 @@
+"""Point-to-point group messaging: broker + distributed coordination.
+
+Reference analog: src/transport/PointToPointBroker.cpp (933 lines) —
+sendMessage (:672-764), ordered recvMessage with out-of-order buffer
+(:778-862), mappings from scheduling decisions (:416-478), and
+PointToPointGroup lock/unlock/barrier/notify (:142-365).
+
+The broker maps (group_id, group_idx) → (host, mpi_port, device_id) from a
+SchedulingDecision and routes messages: same-host delivery lands in
+in-process queues; cross-host delivery goes through PointToPointClient to
+the receiving host's PointToPointServer (see ptp_remote.py).
+
+Unlike the reference's process-singleton, a broker is instantiable per host
+identity so in-process multi-host tests run several side by side. The
+device ids carried in the mappings are how TPU gangs recover their chip
+placement: an MPI world asks the broker for the device of each rank and
+builds its ``jax.sharding`` mesh accordingly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.proto import PointToPointMapping, PointToPointMappings
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.latch import FlagWaiter
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.queues import Queue, QueueTimeoutException
+
+logger = get_logger(__name__)
+
+POINT_TO_POINT_MAIN_IDX = 0
+NO_LOCK_OWNER_IDX = -1
+NO_SEQUENCE_NUM = -1
+
+
+class PointToPointBroker:
+    def __init__(self, host: str) -> None:
+        self.host = host
+        self._lock = threading.RLock()
+
+        # group_id → {group_idx: mapping}
+        self._mappings: dict[int, dict[int, PointToPointMapping]] = {}
+        # group_id → waiter fired once mappings for the group arrive
+        self._flags: dict[int, FlagWaiter] = {}
+        # (group, send, recv) → delivery queue of (seq, bytes)
+        self._queues: dict[tuple[int, int, int], Queue] = {}
+        # ordered-delivery state per channel
+        self._sent_seq: dict[tuple[int, int, int], int] = {}
+        self._recv_seq: dict[tuple[int, int, int], int] = {}
+        self._ooo: dict[tuple[int, int, int], dict[int, bytes]] = {}
+
+        self._groups: dict[int, PointToPointGroup] = {}
+        self._clients: dict[str, object] = {}
+
+    # ------------------------------------------------------------------
+    # Mappings
+    # ------------------------------------------------------------------
+    def set_up_local_mappings_from_decision(
+            self, decision: SchedulingDecision) -> list[str]:
+        """Install this host's view of a group; returns the hosts involved
+        (reference setUpLocalMappingsFromSchedulingDecision)."""
+        group_id = decision.group_id
+        with self._lock:
+            group = self._mappings.setdefault(group_id, {})
+            for m in mappings_from_decision(decision).mappings:
+                group[m.group_idx] = m
+            self._get_flag(group_id).set_flag()
+            PointToPointGroup.add_group_if_not_exists(
+                self, decision.app_id, group_id, len(group))
+        return decision.unique_hosts()
+
+    def set_up_local_mappings_from_mappings(
+            self, mappings: PointToPointMappings) -> None:
+        decision = SchedulingDecision.from_point_to_point_mappings(mappings)
+        self.set_up_local_mappings_from_decision(decision)
+
+    def _get_flag(self, group_id: int) -> FlagWaiter:
+        # caller holds self._lock or accepts benign double-create
+        with self._lock:
+            return self._flags.setdefault(group_id, FlagWaiter())
+
+    def wait_for_mappings(self, group_id: int,
+                          timeout: float | None = None) -> None:
+        conf = get_system_config()
+        timeout = timeout if timeout is not None else conf.global_message_timeout
+        self._get_flag(group_id).wait_on_flag(timeout)
+
+    def get_host_for_receiver(self, group_id: int, recv_idx: int) -> str:
+        with self._lock:
+            return self._mappings[group_id][recv_idx].host
+
+    def get_mpi_port_for_receiver(self, group_id: int, recv_idx: int) -> int:
+        with self._lock:
+            return self._mappings[group_id][recv_idx].mpi_port
+
+    def get_device_for_idx(self, group_id: int, idx: int) -> int:
+        with self._lock:
+            devs = self._mappings[group_id][idx].device_ids
+            return devs[0] if devs else -1
+
+    def get_idxs_registered_for_host(self, group_id: int, host: str) -> set[int]:
+        with self._lock:
+            return {idx for idx, m in self._mappings.get(group_id, {}).items()
+                    if m.host == host}
+
+    def update_host_for_idx(self, group_id: int, idx: int, host: str) -> None:
+        """Post-migration remap (reference updateHostForIdx)."""
+        with self._lock:
+            self._mappings[group_id][idx].host = host
+
+    def group_size(self, group_id: int) -> int:
+        with self._lock:
+            return len(self._mappings.get(group_id, {}))
+
+    # ------------------------------------------------------------------
+    # Messaging
+    # ------------------------------------------------------------------
+    def send_message(self, group_id: int, send_idx: int, recv_idx: int,
+                     data: bytes, must_order: bool = False) -> None:
+        self.wait_for_mappings(group_id)
+        dst_host = self.get_host_for_receiver(group_id, recv_idx)
+        key = (group_id, send_idx, recv_idx)
+
+        seq = NO_SEQUENCE_NUM
+        if must_order:
+            with self._lock:
+                seq = self._sent_seq.get(key, -1) + 1
+                self._sent_seq[key] = seq
+
+        if dst_host == self.host:
+            self.deliver(group_id, send_idx, recv_idx, data, seq)
+        else:
+            self._get_client(dst_host).send_message(
+                group_id, send_idx, recv_idx, data, seq)
+
+    def deliver(self, group_id: int, send_idx: int, recv_idx: int,
+                data: bytes, seq: int = NO_SEQUENCE_NUM) -> None:
+        """Enqueue an inbound message (local send or arriving RPC)."""
+        self._get_queue((group_id, send_idx, recv_idx)).enqueue((seq, data))
+
+    def recv_message(self, group_id: int, send_idx: int, recv_idx: int,
+                     must_order: bool = False,
+                     timeout: float | None = None) -> bytes:
+        conf = get_system_config()
+        timeout = timeout if timeout is not None else conf.global_message_timeout
+        key = (group_id, send_idx, recv_idx)
+        q = self._get_queue(key)
+
+        if not must_order:
+            try:
+                _, data = q.dequeue(timeout=timeout)
+            except QueueTimeoutException as e:
+                raise TimeoutError(
+                    f"PTP recv timed out on {key}") from e
+            return data
+
+        # Ordered path: consume in seq order, buffering whatever arrives
+        # early (reference PointToPointBroker.cpp:778-862).
+        with self._lock:
+            expected = self._recv_seq.get(key, -1) + 1
+            buf = self._ooo.setdefault(key, {})
+        while True:
+            if expected in buf:
+                with self._lock:
+                    self._recv_seq[key] = expected
+                return buf.pop(expected)
+            try:
+                seq, data = q.dequeue(timeout=timeout)
+            except QueueTimeoutException as e:
+                raise TimeoutError(
+                    f"PTP ordered recv timed out on {key} "
+                    f"(expected seq {expected})") from e
+            if seq == expected or seq == NO_SEQUENCE_NUM:
+                with self._lock:
+                    self._recv_seq[key] = max(self._recv_seq.get(key, -1),
+                                              seq)
+                return data
+            buf[seq] = data
+
+    def _get_queue(self, key: tuple[int, int, int]) -> Queue:
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None:
+                q = Queue()
+                self._queues[key] = q
+            return q
+
+    # ------------------------------------------------------------------
+    # Groups
+    # ------------------------------------------------------------------
+    def get_group(self, group_id: int) -> "PointToPointGroup":
+        with self._lock:
+            group = self._groups.get(group_id)
+            if group is None:
+                raise KeyError(f"Group {group_id} not registered on {self.host}")
+            return group
+
+    def group_exists(self, group_id: int) -> bool:
+        with self._lock:
+            return group_id in self._groups
+
+    def clear_group(self, group_id: int) -> None:
+        with self._lock:
+            self._groups.pop(group_id, None)
+            self._mappings.pop(group_id, None)
+            self._flags.pop(group_id, None)
+            for key in [k for k in self._queues if k[0] == group_id]:
+                del self._queues[key]
+            for d in (self._sent_seq, self._recv_seq, self._ooo):
+                for key in [k for k in d if k[0] == group_id]:
+                    del d[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._groups.clear()
+            self._mappings.clear()
+            self._flags.clear()
+            self._queues.clear()
+            self._sent_seq.clear()
+            self._recv_seq.clear()
+            self._ooo.clear()
+            for c in self._clients.values():
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._clients.clear()
+
+    def _get_client(self, host: str):
+        from faabric_tpu.transport.ptp_remote import PointToPointClient
+
+        with self._lock:
+            if host not in self._clients:
+                self._clients[host] = PointToPointClient(host)
+            return self._clients[host]
+
+
+class PointToPointGroup:
+    """Distributed coordination for one group: the main idx (0) hosts the
+    lock state; lock/barrier/notify ride PTP messages
+    (reference PointToPointBroker.h:26-97)."""
+
+    def __init__(self, broker: PointToPointBroker, app_id: int,
+                 group_id: int, group_size: int) -> None:
+        self.broker = broker
+        self.app_id = app_id
+        self.group_id = group_id
+        self.group_size = group_size
+
+        self._mx = threading.RLock()
+        self._local_mx = threading.Lock()
+        self._lock_owner_idx = NO_LOCK_OWNER_IDX
+        self._recursive_owners: list[int] = []
+        # Waiters remember whether they asked for a recursive acquisition,
+        # so a grant restores the right ownership structure
+        self._lock_waiters: list[tuple[int, bool]] = []
+        self._local_barrier: Optional[threading.Barrier] = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def add_group_if_not_exists(broker: PointToPointBroker, app_id: int,
+                                group_id: int, group_size: int) -> "PointToPointGroup":
+        with broker._lock:
+            group = broker._groups.get(group_id)
+            if group is None:
+                group = PointToPointGroup(broker, app_id, group_id, group_size)
+                broker._groups[group_id] = group
+            else:
+                group.group_size = group_size
+            return group
+
+    # ------------------------------------------------------------------
+    # Distributed lock
+    # ------------------------------------------------------------------
+    def lock(self, group_idx: int, recursive: bool = False) -> None:
+        main_host = self.broker.get_host_for_receiver(
+            self.group_id, POINT_TO_POINT_MAIN_IDX)
+
+        if main_host == self.broker.host:
+            acquired = False
+            with self._mx:
+                # Recursive and plain ownership exclude each other: a
+                # recursive acquisition needs the plain lock free, and vice
+                # versa.
+                free_of_plain = self._lock_owner_idx == NO_LOCK_OWNER_IDX
+                if recursive and free_of_plain and (
+                        not self._recursive_owners
+                        or self._recursive_owners[-1] == group_idx):
+                    self._recursive_owners.append(group_idx)
+                    acquired = True
+                elif (not recursive and free_of_plain
+                        and not self._recursive_owners):
+                    self._lock_owner_idx = group_idx
+                    acquired = True
+                if not acquired:
+                    self._lock_waiters.append((group_idx, recursive))
+
+            locker_host = self.broker.get_host_for_receiver(
+                self.group_id, group_idx)
+            locker_is_local = locker_host == self.broker.host
+            if acquired:
+                if not locker_is_local:
+                    self._notify_locked(group_idx)
+                return
+            if locker_is_local:
+                # Queued: wait for the grant message from main
+                self.broker.recv_message(self.group_id,
+                                         POINT_TO_POINT_MAIN_IDX, group_idx)
+            # A remote queued locker is notified by unlock() later
+        else:
+            # Ask the main host, then wait for the grant
+            self.broker._get_client(main_host).group_lock(
+                self.app_id, self.group_id, group_idx, recursive)
+            self.broker.recv_message(self.group_id,
+                                     POINT_TO_POINT_MAIN_IDX, group_idx)
+
+    def unlock(self, group_idx: int, recursive: bool = False) -> None:
+        main_host = self.broker.get_host_for_receiver(
+            self.group_id, POINT_TO_POINT_MAIN_IDX)
+
+        if main_host != self.broker.host:
+            self.broker._get_client(main_host).group_unlock(
+                self.app_id, self.group_id, group_idx, recursive)
+            return
+
+        with self._mx:
+            if recursive:
+                if self._recursive_owners:
+                    self._recursive_owners.pop()
+                if self._recursive_owners:
+                    return
+            else:
+                self._lock_owner_idx = NO_LOCK_OWNER_IDX
+            if self._lock_waiters:
+                nxt, nxt_recursive = self._lock_waiters.pop(0)
+                if nxt_recursive:
+                    self._recursive_owners.append(nxt)
+                else:
+                    self._lock_owner_idx = nxt
+                self._grant(nxt)
+
+    def _grant(self, group_idx: int) -> None:
+        self._notify_locked(group_idx)
+
+    def _notify_locked(self, group_idx: int) -> None:
+        self.broker.send_message(self.group_id, POINT_TO_POINT_MAIN_IDX,
+                                 group_idx, b"\x00")
+
+    def get_lock_owner(self, recursive: bool = False) -> int:
+        with self._mx:
+            if recursive:
+                return (self._recursive_owners[-1]
+                        if self._recursive_owners else NO_LOCK_OWNER_IDX)
+            return self._lock_owner_idx
+
+    def local_lock(self) -> None:
+        self._local_mx.acquire()
+
+    def local_try_lock(self) -> bool:
+        return self._local_mx.acquire(blocking=False)
+
+    def local_unlock(self) -> None:
+        self._local_mx.release()
+
+    # ------------------------------------------------------------------
+    # Barrier / notify
+    # ------------------------------------------------------------------
+    def is_single_host(self) -> bool:
+        idxs = self.broker.get_idxs_registered_for_host(self.group_id,
+                                                        self.broker.host)
+        return len(idxs) == self.group_size
+
+    def barrier(self, group_idx: int) -> None:
+        # Single-host fast path (reference uses a std::barrier)
+        if self.is_single_host():
+            with self._mx:
+                if (self._local_barrier is None
+                        or self._local_barrier.parties != self.group_size):
+                    self._local_barrier = threading.Barrier(self.group_size)
+            self._local_barrier.wait()
+            return
+
+        if group_idx == POINT_TO_POINT_MAIN_IDX:
+            for i in range(1, self.group_size):
+                self.broker.recv_message(self.group_id, i,
+                                         POINT_TO_POINT_MAIN_IDX)
+            for i in range(1, self.group_size):
+                self.broker.send_message(self.group_id,
+                                         POINT_TO_POINT_MAIN_IDX, i, b"\x00")
+        else:
+            self.broker.send_message(self.group_id, group_idx,
+                                     POINT_TO_POINT_MAIN_IDX, b"\x00")
+            self.broker.recv_message(self.group_id, POINT_TO_POINT_MAIN_IDX,
+                                     group_idx)
+
+    def notify(self, group_idx: int) -> None:
+        """Non-main idxs signal the main, which collects all of them
+        (reference PointToPointBroker.cpp:348-365)."""
+        if group_idx == POINT_TO_POINT_MAIN_IDX:
+            for i in range(1, self.group_size):
+                self.broker.recv_message(self.group_id, i,
+                                         POINT_TO_POINT_MAIN_IDX)
+        else:
+            self.broker.send_message(self.group_id, group_idx,
+                                     POINT_TO_POINT_MAIN_IDX, b"\x00")
+
+
+def mappings_from_decision(decision: SchedulingDecision) -> PointToPointMappings:
+    out = PointToPointMappings(app_id=decision.app_id,
+                               group_id=decision.group_id)
+    for i in range(decision.n_messages):
+        out.mappings.append(PointToPointMapping(
+            host=decision.hosts[i],
+            message_id=decision.message_ids[i],
+            app_idx=decision.app_idxs[i],
+            group_idx=decision.group_idxs[i],
+            mpi_port=decision.mpi_ports[i],
+            device_ids=[decision.device_ids[i]]
+            if decision.device_ids[i] >= 0 else [],
+        ))
+    return out

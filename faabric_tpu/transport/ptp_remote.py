@@ -1,0 +1,249 @@
+"""PTP RPC: server (ports 8009/8010) + client with mock recording.
+
+Reference analog: src/transport/PointToPointServer.cpp (async MESSAGE with
+seqnum re-injection, LOCK/UNLOCK; sync MAPPING install) and
+src/transport/PointToPointClient.cpp:11-145 (mock-mode recording of
+messages, mappings and lock ops — the unit-test backbone).
+
+The planner calls send_mappings_from_decision() here directly: every
+scheduling decision's group mappings are pushed to all involved hosts' PTP
+servers.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import TYPE_CHECKING
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.proto import PointToPointMappings
+from faabric_tpu.transport.client import MessageEndpointClient
+from faabric_tpu.transport.common import (
+    POINT_TO_POINT_ASYNC_PORT,
+    POINT_TO_POINT_SYNC_PORT,
+    get_host_alias_offset,
+)
+from faabric_tpu.transport.message import TransportMessage
+from faabric_tpu.transport.point_to_point import mappings_from_decision
+from faabric_tpu.transport.server import MessageEndpointServer, handler_response
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.testing import is_mock_mode
+
+if TYPE_CHECKING:  # pragma: no cover
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+
+logger = get_logger(__name__)
+
+
+class PointToPointCall(enum.IntEnum):
+    MESSAGE = 1
+    LOCK_GROUP = 2
+    LOCK_GROUP_RECURSIVE = 3
+    UNLOCK_GROUP = 4
+    UNLOCK_GROUP_RECURSIVE = 5
+    MAPPING = 6
+    CLEAR_GROUP = 7
+
+
+# Lock/unlock handlers run on the shared server worker pool; they must not
+# park a worker for the full message timeout waiting on mappings that may
+# never come, or healthy groups' traffic starves.
+LOCK_MAPPING_WAIT_SECONDS = 5.0
+
+
+# ---------------------------------------------------------------------------
+# Mock recording (reference PointToPointClient.cpp:11-48)
+# ---------------------------------------------------------------------------
+_mock_lock = threading.Lock()
+# (host, group_id, send_idx, recv_idx, payload)
+_sent_messages: list[tuple[str, int, int, int, bytes]] = []
+# (host, PointToPointMappings)
+_sent_mappings: list[tuple[str, PointToPointMappings]] = []
+# (call, host, group_id, group_idx)
+_lock_ops: list[tuple[int, str, int, int]] = []
+
+
+def get_sent_ptp_messages() -> list[tuple[str, int, int, int, bytes]]:
+    with _mock_lock:
+        return list(_sent_messages)
+
+
+def get_sent_mappings() -> list[tuple[str, PointToPointMappings]]:
+    with _mock_lock:
+        return list(_sent_mappings)
+
+
+def get_lock_ops() -> list[tuple[int, str, int, int]]:
+    with _mock_lock:
+        return list(_lock_ops)
+
+
+def clear_sent_ptp() -> None:
+    with _mock_lock:
+        _sent_messages.clear()
+        _sent_mappings.clear()
+        _lock_ops.clear()
+
+
+# ---------------------------------------------------------------------------
+
+class PointToPointClient(MessageEndpointClient):
+    def __init__(self, host: str) -> None:
+        super().__init__(host, POINT_TO_POINT_ASYNC_PORT,
+                         POINT_TO_POINT_SYNC_PORT)
+
+    def send_mappings(self, mappings: PointToPointMappings) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _sent_mappings.append((self.host, mappings))
+            return
+        self.sync_send(int(PointToPointCall.MAPPING),
+                       {"mappings": mappings.to_dict()}, idempotent=True)
+
+    def send_message(self, group_id: int, send_idx: int, recv_idx: int,
+                     data: bytes, seq: int = -1) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _sent_messages.append(
+                    (self.host, group_id, send_idx, recv_idx, data))
+            return
+        self.async_send(int(PointToPointCall.MESSAGE), {
+            "group_id": group_id, "send_idx": send_idx, "recv_idx": recv_idx,
+        }, data, seqnum=seq)
+
+    def group_lock(self, app_id: int, group_id: int, group_idx: int,
+                   recursive: bool = False) -> None:
+        call = (PointToPointCall.LOCK_GROUP_RECURSIVE if recursive
+                else PointToPointCall.LOCK_GROUP)
+        if is_mock_mode():
+            with _mock_lock:
+                _lock_ops.append((int(call), self.host, group_id, group_idx))
+            return
+        self.async_send(int(call), {
+            "app_id": app_id, "group_id": group_id, "group_idx": group_idx,
+        })
+
+    def group_unlock(self, app_id: int, group_id: int, group_idx: int,
+                     recursive: bool = False) -> None:
+        call = (PointToPointCall.UNLOCK_GROUP_RECURSIVE if recursive
+                else PointToPointCall.UNLOCK_GROUP)
+        if is_mock_mode():
+            with _mock_lock:
+                _lock_ops.append((int(call), self.host, group_id, group_idx))
+            return
+        self.async_send(int(call), {
+            "app_id": app_id, "group_id": group_id, "group_idx": group_idx,
+        })
+
+    def clear_group(self, group_id: int) -> None:
+        if is_mock_mode():
+            return
+        self.async_send(int(PointToPointCall.CLEAR_GROUP),
+                        {"group_id": group_id})
+
+
+class PointToPointServer(MessageEndpointServer):
+    def __init__(self, broker: "PointToPointBroker") -> None:
+        conf = get_system_config()
+        offset = get_host_alias_offset(broker.host)
+        super().__init__(
+            POINT_TO_POINT_ASYNC_PORT + offset,
+            POINT_TO_POINT_SYNC_PORT + offset,
+            label=f"ptp-server-{broker.host}",
+            n_threads=conf.point_to_point_server_threads,
+        )
+        self.broker = broker
+
+    def do_async_recv(self, msg: TransportMessage) -> None:
+        code = msg.code
+        h = msg.header
+        if code == int(PointToPointCall.MESSAGE):
+            self.broker.deliver(h["group_id"], h["send_idx"], h["recv_idx"],
+                                msg.payload, msg.seqnum)
+        elif code in (int(PointToPointCall.LOCK_GROUP),
+                      int(PointToPointCall.LOCK_GROUP_RECURSIVE),
+                      int(PointToPointCall.UNLOCK_GROUP),
+                      int(PointToPointCall.UNLOCK_GROUP_RECURSIVE)):
+            recursive = code in (int(PointToPointCall.LOCK_GROUP_RECURSIVE),
+                                 int(PointToPointCall.UNLOCK_GROUP_RECURSIVE))
+            is_lock = code in (int(PointToPointCall.LOCK_GROUP),
+                               int(PointToPointCall.LOCK_GROUP_RECURSIVE))
+            # Mappings may still be in flight when the first lock arrives,
+            # but a missing group must not park this worker for long
+            try:
+                self.broker.wait_for_mappings(h["group_id"],
+                                              LOCK_MAPPING_WAIT_SECONDS)
+            except Exception:  # noqa: BLE001
+                logger.warning("Dropping %s for unknown group %d",
+                               "lock" if is_lock else "unlock", h["group_id"])
+                return
+            group = self.broker.get_group(h["group_id"])
+            if is_lock:
+                group.lock(h["group_idx"], recursive)
+            else:
+                group.unlock(h["group_idx"], recursive)
+        elif code == int(PointToPointCall.CLEAR_GROUP):
+            self.broker.clear_group(h["group_id"])
+        else:
+            logger.warning("Unknown async PTP call %d", code)
+
+    def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:
+        if msg.code == int(PointToPointCall.MAPPING):
+            mappings = PointToPointMappings.from_dict(msg.header["mappings"])
+            self.broker.set_up_local_mappings_from_mappings(mappings)
+            return handler_response()
+        raise ValueError(f"Unknown sync PTP call {msg.code}")
+
+
+# ---------------------------------------------------------------------------
+# Planner-side mapping distribution
+# (reference PointToPointBroker::setAndSendMappingsFromSchedulingDecision)
+# ---------------------------------------------------------------------------
+
+_dist_clients: dict[str, PointToPointClient] = {}
+_dist_lock = threading.Lock()
+
+
+def send_mappings_from_decision(decision: SchedulingDecision) -> None:
+    if decision.n_messages == 0 or not decision.group_id:
+        return
+    mappings = mappings_from_decision(decision)
+    for host in decision.unique_hosts():
+        with _dist_lock:
+            client = _dist_clients.get(host)
+            if client is None:
+                client = PointToPointClient(host)
+                _dist_clients[host] = client
+        try:
+            client.send_mappings(mappings)
+        except Exception:  # noqa: BLE001 — a dead host must not stall others
+            logger.exception("Failed sending mappings of group %d to %s",
+                             decision.group_id, host)
+
+
+def send_clear_group(group_id: int, hosts: list[str]) -> None:
+    """Tell every involved host to drop a finished group's broker state —
+    without this, long-lived workers accumulate mappings/queues per batch."""
+    for host in hosts:
+        with _dist_lock:
+            client = _dist_clients.get(host)
+            if client is None:
+                client = PointToPointClient(host)
+                _dist_clients[host] = client
+        try:
+            client.clear_group(group_id)
+        except Exception:  # noqa: BLE001
+            logger.debug("Failed sending clear-group %d to %s", group_id, host)
+
+
+def close_mapping_clients() -> None:
+    with _dist_lock:
+        for c in _dist_clients.values():
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        _dist_clients.clear()
+

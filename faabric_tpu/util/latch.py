@@ -64,8 +64,8 @@ class FlagWaiter:
         self._event = threading.Event()
         self.timeout = timeout
 
-    def wait_on_flag(self) -> None:
-        if not self._event.wait(self.timeout):
+    def wait_on_flag(self, timeout: float | None = None) -> None:
+        if not self._event.wait(timeout if timeout is not None else self.timeout):
             raise LatchTimeoutException("Timeout waiting on flag")
 
     def set_flag(self, value: bool = True) -> None:

@@ -211,3 +211,22 @@ def test_mock_mode_records_function_calls():
     finally:
         set_mock_mode(False)
         clear_mock_requests()
+
+
+def test_group_mappings_distributed_with_dispatch(cluster):
+    """Every scheduling decision pushes PTP group mappings to the involved
+    hosts (reference Planner → setAndSendMappingsFromSchedulingDecision)."""
+    w = cluster["workers"]["hostA"]
+    req = batch_exec_factory("demo", "echo", 8)
+    decision = w.planner_client.call_functions(req)
+    assert decision.group_id != 0
+
+    for name, worker in cluster["workers"].items():
+        broker = worker.ptp_broker
+        broker.wait_for_mappings(decision.group_id, timeout=5.0)
+        assert broker.group_size(decision.group_id) == 8
+        # Each broker knows which group idxs live on this host
+        own = broker.get_idxs_registered_for_host(decision.group_id, name)
+        assert own  # bin-pack spread 8 over two 4-slot hosts
+    for m in req.messages:
+        w.planner_client.get_message_result(req.app_id, m.id, timeout=10.0)

@@ -1,0 +1,293 @@
+"""PTP broker, groups and remote RPC tests
+(reference: tests/test/transport/test_point_to_point*.cpp,
+tests/dist/transport/)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.transport.common import register_host_alias
+from faabric_tpu.transport.point_to_point import (
+    POINT_TO_POINT_MAIN_IDX,
+    PointToPointBroker,
+)
+from faabric_tpu.transport.ptp_remote import (
+    PointToPointClient,
+    PointToPointServer,
+    clear_sent_ptp,
+    get_sent_mappings,
+    get_sent_ptp_messages,
+    send_mappings_from_decision,
+)
+from faabric_tpu.util.testing import set_mock_mode
+
+
+def make_decision(group_id, placements):
+    """placements: list of (host, group_idx)"""
+    d = SchedulingDecision(app_id=group_id, group_id=group_id)
+    for host, idx in placements:
+        d.add_message(host, 1000 + idx, idx, idx)
+    return d
+
+
+@pytest.fixture
+def two_host_ptp():
+    """Two brokers with live PTP servers on aliased ports."""
+    base = random.randint(100, 500) * 100
+    register_host_alias("ptpA", "127.0.0.1", base)
+    register_host_alias("ptpB", "127.0.0.1", base + 1000)
+    brokers = {h: PointToPointBroker(h) for h in ("ptpA", "ptpB")}
+    servers = [PointToPointServer(b) for b in brokers.values()]
+    for s in servers:
+        s.start()
+    yield brokers
+    for s in servers:
+        s.stop()
+    for b in brokers.values():
+        b.clear()
+
+
+def install(brokers, decision):
+    for b in brokers.values():
+        b.set_up_local_mappings_from_decision(decision)
+
+
+def test_local_send_recv_unordered(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(7, [("ptpA", 0), ("ptpA", 1)])
+    install(brokers, d)
+    a = brokers["ptpA"]
+    a.send_message(7, 0, 1, b"hello")
+    assert a.recv_message(7, 0, 1, timeout=5.0) == b"hello"
+
+
+def test_cross_host_send_recv(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(8, [("ptpA", 0), ("ptpB", 1)])
+    install(brokers, d)
+    brokers["ptpA"].send_message(8, 0, 1, b"over-the-wire")
+    # Arrives at B's broker through its PTP server
+    assert brokers["ptpB"].recv_message(8, 0, 1, timeout=5.0) == b"over-the-wire"
+    # And the reverse direction
+    brokers["ptpB"].send_message(8, 1, 0, b"reply")
+    assert brokers["ptpA"].recv_message(8, 1, 0, timeout=5.0) == b"reply"
+
+
+def test_ordered_delivery_reorders_wire_races(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(9, [("ptpA", 0), ("ptpA", 1)])
+    install(brokers, d)
+    a = brokers["ptpA"]
+    # Simulate out-of-order arrival from racing server worker threads
+    payloads = [f"m{i}".encode() for i in range(10)]
+    order = list(range(10))
+    random.shuffle(order)
+    for seq in order:
+        a.deliver(9, 0, 1, payloads[seq], seq)
+    got = [a.recv_message(9, 0, 1, must_order=True, timeout=5.0)
+           for _ in range(10)]
+    assert got == payloads
+
+
+def test_ordered_send_assigns_sequence(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(10, [("ptpA", 0), ("ptpB", 1)])
+    install(brokers, d)
+    for i in range(20):
+        brokers["ptpA"].send_message(10, 0, 1, f"x{i}".encode(),
+                                     must_order=True)
+    got = [brokers["ptpB"].recv_message(10, 0, 1, must_order=True, timeout=5.0)
+           for i in range(20)]
+    assert got == [f"x{i}".encode() for i in range(20)]
+
+
+def test_barrier_across_hosts(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(11, [("ptpA", 0), ("ptpB", 1), ("ptpB", 2)])
+    install(brokers, d)
+
+    passed = []
+    barrier_hits = []
+
+    def worker(broker, idx):
+        group = broker.get_group(11)
+        for round_num in range(3):
+            barrier_hits.append((idx, round_num))
+            group.barrier(idx)
+            passed.append((idx, round_num))
+
+    threads = [
+        threading.Thread(target=worker, args=(brokers["ptpA"], 0)),
+        threading.Thread(target=worker, args=(brokers["ptpB"], 1)),
+        threading.Thread(target=worker, args=(brokers["ptpB"], 2)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in threads)
+    # Nobody passes barrier N before everyone hits barrier N
+    for idx, round_num in passed:
+        hits = {i for i, r in barrier_hits if r == round_num}
+        assert hits == {0, 1, 2}
+
+
+def test_distributed_lock_mutual_exclusion(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(12, [("ptpA", 0), ("ptpB", 1), ("ptpB", 2)])
+    install(brokers, d)
+
+    counter = {"v": 0, "max_concurrent": 0, "in_section": 0}
+    guard = threading.Lock()
+
+    def worker(broker, idx):
+        group = broker.get_group(12)
+        for _ in range(5):
+            group.lock(idx)
+            with guard:
+                counter["in_section"] += 1
+                counter["max_concurrent"] = max(counter["max_concurrent"],
+                                                counter["in_section"])
+            v = counter["v"]
+            time.sleep(0.002)
+            counter["v"] = v + 1
+            with guard:
+                counter["in_section"] -= 1
+            group.unlock(idx)
+
+    threads = [
+        threading.Thread(target=worker, args=(brokers["ptpA"], 0)),
+        threading.Thread(target=worker, args=(brokers["ptpB"], 1)),
+        threading.Thread(target=worker, args=(brokers["ptpB"], 2)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20.0)
+    assert not any(t.is_alive() for t in threads)
+    assert counter["max_concurrent"] == 1
+    assert counter["v"] == 15  # no lost updates
+
+
+def test_recursive_lock(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(13, [("ptpA", 0), ("ptpA", 1)])
+    install(brokers, d)
+    group = brokers["ptpA"].get_group(13)
+    group.lock(0, recursive=True)
+    group.lock(0, recursive=True)  # re-entrant
+    assert group.get_lock_owner(recursive=True) == 0
+    group.unlock(0, recursive=True)
+    assert group.get_lock_owner(recursive=True) == 0  # still held once
+    group.unlock(0, recursive=True)
+    assert group.get_lock_owner(recursive=True) == -1
+
+
+def test_notify(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(14, [("ptpA", 0), ("ptpB", 1), ("ptpB", 2)])
+    install(brokers, d)
+
+    done = threading.Event()
+
+    def main_waiter():
+        brokers["ptpA"].get_group(14).notify(0)
+        done.set()
+
+    t = threading.Thread(target=main_waiter)
+    t.start()
+    time.sleep(0.1)
+    assert not done.is_set()  # main waits for both
+    brokers["ptpB"].get_group(14).notify(1)
+    brokers["ptpB"].get_group(14).notify(2)
+    assert done.wait(5.0)
+    t.join(timeout=5.0)
+
+
+def test_migration_remap(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(15, [("ptpA", 0), ("ptpA", 1)])
+    install(brokers, d)
+    a = brokers["ptpA"]
+    assert a.get_host_for_receiver(15, 1) == "ptpA"
+    a.update_host_for_idx(15, 1, "ptpB")
+    assert a.get_host_for_receiver(15, 1) == "ptpB"
+    # Sends now route to B
+    brokers["ptpB"].set_up_local_mappings_from_decision(
+        make_decision(15, [("ptpA", 0), ("ptpB", 1)]))
+    a.send_message(15, 0, 1, b"after-move")
+    assert brokers["ptpB"].recv_message(15, 0, 1, timeout=5.0) == b"after-move"
+
+
+def test_mock_mode_records_ptp():
+    set_mock_mode(True)
+    try:
+        cli = PointToPointClient("phantom")
+        cli.send_message(77, 0, 1, b"recorded")
+        cli.group_lock(1, 77, 2)
+        d = make_decision(77, [("phantom", 0)])
+        send_mappings_from_decision(d)
+        msgs = get_sent_ptp_messages()
+        assert msgs == [("phantom", 77, 0, 1, b"recorded")]
+        assert get_sent_mappings()[0][0] == "phantom"
+        assert get_sent_mappings()[0][1].group_id == 77
+    finally:
+        set_mock_mode(False)
+        clear_sent_ptp()
+
+
+def test_device_ids_recovered_from_mappings(two_host_ptp):
+    brokers = two_host_ptp
+    d = SchedulingDecision(app_id=16, group_id=16)
+    d.add_message("ptpA", 1, 0, 0, mpi_port=8020, device_id=2)
+    d.add_message("ptpB", 2, 1, 1, mpi_port=8021, device_id=3)
+    install(brokers, d)
+    a = brokers["ptpA"]
+    assert a.get_device_for_idx(16, 0) == 2
+    assert a.get_device_for_idx(16, 1) == 3
+    assert a.get_mpi_port_for_receiver(16, 1) == 8021
+
+
+def test_mixed_recursive_and_plain_lock_exclusion(two_host_ptp):
+    """Recursive and plain ownership exclude each other and queued waiters
+    are granted in the mode they asked for."""
+    brokers = two_host_ptp
+    d = make_decision(17, [("ptpA", 0), ("ptpA", 1), ("ptpA", 2)])
+    install(brokers, d)
+    group = brokers["ptpA"].get_group(17)
+
+    group.lock(0, recursive=True)
+    # Plain lock while a recursive owner holds: must queue, not acquire
+    acquired = threading.Event()
+
+    def plain_locker():
+        group.lock(1, recursive=False)
+        acquired.set()
+
+    t = threading.Thread(target=plain_locker)
+    t.start()
+    time.sleep(0.1)
+    assert not acquired.is_set()
+    group.unlock(0, recursive=True)
+    assert acquired.wait(5.0)
+    # Waiter got the PLAIN lock, not a recursive grant
+    assert group.get_lock_owner() == 1
+    assert group.get_lock_owner(recursive=True) == -1
+    group.unlock(1)
+    assert group.get_lock_owner() == -1
+    t.join(timeout=5.0)
+
+
+def test_clear_group_drops_state(two_host_ptp):
+    brokers = two_host_ptp
+    d = make_decision(18, [("ptpA", 0), ("ptpA", 1)])
+    install(brokers, d)
+    a = brokers["ptpA"]
+    a.send_message(18, 0, 1, b"x")
+    assert a.group_exists(18)
+    a.clear_group(18)
+    assert not a.group_exists(18)
+    assert a.group_size(18) == 0
