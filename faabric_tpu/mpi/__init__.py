@@ -1,0 +1,28 @@
+"""MPI-semantics layer on the group substrate (reference src/mpi)."""
+
+from faabric_tpu.mpi.types import (
+    MpiDataType,
+    MpiMessageType,
+    MpiOp,
+    MpiStatus,
+    apply_op,
+    mpi_dtype_for,
+    np_dtype_for,
+)
+from faabric_tpu.mpi.world import MAIN_RANK, MpiWorld
+from faabric_tpu.mpi.registry import MpiContext, MpiWorldRegistry, get_mpi_context
+
+__all__ = [
+    "MAIN_RANK",
+    "MpiContext",
+    "MpiDataType",
+    "MpiMessageType",
+    "MpiOp",
+    "MpiStatus",
+    "MpiWorld",
+    "MpiWorldRegistry",
+    "apply_op",
+    "get_mpi_context",
+    "mpi_dtype_for",
+    "np_dtype_for",
+]

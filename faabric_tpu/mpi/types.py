@@ -1,0 +1,161 @@
+"""MPI datatypes, reduce ops and message framing.
+
+Reference analog: include/faabric/mpi/mpi.h (datatype/op singletons,
+597 lines) and include/faabric/mpi/MpiMessage.h:8-68 (the 40-byte POD
+header {id, worldId, sendRank, recvRank, typeSize, count, requestId,
+messageType}).
+
+Buffers are numpy arrays end-to-end: typed reduce loops become numpy
+ufuncs on the host path and jax.lax collectives on the device path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+
+import numpy as np
+
+
+class MpiDataType(enum.IntEnum):
+    # mirror of faabric_datatype_t (mpi.h)
+    INT8 = 1
+    INT16 = 2
+    INT32 = 3
+    INT = 4
+    INT64 = 5
+    UINT8 = 6
+    UINT16 = 7
+    UINT32 = 8
+    UINT = 9
+    UINT64 = 10
+    LONG = 11
+    LONG_LONG = 12
+    LONG_LONG_INT = 13
+    FLOAT = 14
+    DOUBLE = 15
+    DOUBLE_INT = 16
+    CHAR = 17
+    C_BOOL = 18
+    BYTE = 19
+
+
+_NP_DTYPES: dict[int, np.dtype] = {
+    MpiDataType.INT8: np.dtype(np.int8),
+    MpiDataType.INT16: np.dtype(np.int16),
+    MpiDataType.INT32: np.dtype(np.int32),
+    MpiDataType.INT: np.dtype(np.int32),
+    MpiDataType.INT64: np.dtype(np.int64),
+    MpiDataType.UINT8: np.dtype(np.uint8),
+    MpiDataType.UINT16: np.dtype(np.uint16),
+    MpiDataType.UINT32: np.dtype(np.uint32),
+    MpiDataType.UINT: np.dtype(np.uint32),
+    MpiDataType.UINT64: np.dtype(np.uint64),
+    MpiDataType.LONG: np.dtype(np.int64),
+    MpiDataType.LONG_LONG: np.dtype(np.int64),
+    MpiDataType.LONG_LONG_INT: np.dtype(np.int64),
+    MpiDataType.FLOAT: np.dtype(np.float32),
+    MpiDataType.DOUBLE: np.dtype(np.float64),
+    MpiDataType.CHAR: np.dtype(np.uint8),
+    MpiDataType.C_BOOL: np.dtype(np.uint8),
+    MpiDataType.BYTE: np.dtype(np.uint8),
+}
+
+
+def np_dtype_for(dtype: MpiDataType) -> np.dtype:
+    return _NP_DTYPES[dtype]
+
+
+def mpi_dtype_for(np_dtype: np.dtype) -> MpiDataType:
+    np_dtype = np.dtype(np_dtype)
+    for mpi_t, np_t in _NP_DTYPES.items():
+        if np_t == np_dtype:
+            return MpiDataType(mpi_t)
+    raise ValueError(f"No MPI datatype for numpy {np_dtype}")
+
+
+class MpiOp(enum.IntEnum):
+    # mirror of faabric_op_t
+    MAX = 1
+    MIN = 2
+    SUM = 3
+    PROD = 4
+    LAND = 5
+    LOR = 6
+    BAND = 7
+    BOR = 8
+    MAXLOC = 9
+    MINLOC = 10
+
+
+_NP_OPS = {
+    MpiOp.MAX: np.maximum,
+    MpiOp.MIN: np.minimum,
+    MpiOp.SUM: np.add,
+    MpiOp.PROD: np.multiply,
+    MpiOp.LAND: np.logical_and,
+    MpiOp.LOR: np.logical_or,
+    MpiOp.BAND: np.bitwise_and,
+    MpiOp.BOR: np.bitwise_or,
+}
+
+
+def apply_op(op: MpiOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Typed reduce (reference MpiWorld::op_reduce:1266-1388 — there hand
+    rolled loops per dtype; numpy ufuncs vectorise the same semantics)."""
+    fn = _NP_OPS.get(op)
+    if fn is None:
+        raise NotImplementedError(f"MPI op {op} not supported")
+    out = fn(a, b)
+    return out.astype(a.dtype, copy=False)
+
+
+class MpiMessageType(enum.IntEnum):
+    # mirror of MpiMessage.h MpiMessageType
+    NORMAL = 0
+    BARRIER_JOIN = 1
+    BARRIER_DONE = 2
+    SCATTER = 3
+    GATHER = 4
+    ALLGATHER = 5
+    REDUCE = 6
+    SCAN = 7
+    ALLREDUCE = 8
+    ALLTOALL = 9
+    ALLTOALL_PACKED = 10
+    SENDRECV = 11
+    BROADCAST = 12
+    UNACKED = 13
+    HANDSHAKE = 14
+
+
+# Wire header for MPI payloads riding PTP: type u8, dtype u8, pad u16,
+# count u64, request_id i64 (a compact analog of the reference's POD header)
+MPI_HEADER_FMT = "<BBHQq"
+MPI_HEADER_LEN = struct.calcsize(MPI_HEADER_FMT)
+
+
+@dataclasses.dataclass
+class MpiStatus:
+    source: int = 0
+    error: int = 0
+    count: int = 0
+    dtype: int = int(MpiDataType.BYTE)
+
+
+def pack_mpi_payload(msg_type: MpiMessageType, data: np.ndarray,
+                     request_id: int = 0) -> bytes:
+    data = np.ascontiguousarray(data)
+    head = struct.pack(MPI_HEADER_FMT, int(msg_type),
+                       int(mpi_dtype_for(data.dtype)), 0, data.size,
+                       request_id)
+    return head + data.tobytes()
+
+
+def unpack_mpi_payload(raw: bytes) -> tuple[MpiMessageType, np.ndarray, int]:
+    msg_type, dtype, _, count, request_id = struct.unpack(
+        MPI_HEADER_FMT, raw[:MPI_HEADER_LEN])
+    arr = np.frombuffer(raw, dtype=np_dtype_for(MpiDataType(dtype)),
+                        count=count, offset=MPI_HEADER_LEN).copy()
+    return MpiMessageType(msg_type), arr, request_id

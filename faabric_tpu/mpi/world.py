@@ -1,0 +1,438 @@
+"""MpiWorld: MPI semantics on the framework's group substrate.
+
+Reference analog: src/mpi/MpiWorld.cpp (2132 lines) and
+include/faabric/mpi/MpiWorld.h. One world per app; rank 0 creates the
+world by chaining (size-1) functions through the planner
+(MpiWorld.cpp:157-226); other ranks join from their dispatched message.
+
+Transport split, re-designed TPU-first:
+- **Host path** (this file): rank↔host routing comes from the PTP group
+  mappings; send/recv/sendrecv/isend/irecv and the collectives ride the
+  PTP broker — same-host ranks through in-process queues, cross-host over
+  the PTP RPC plane. Collectives keep the reference's locality-aware
+  local-leader trees (broadcast :786-853, reduce :1127-1249, gather
+  two-step :917-1080) so cross-host traffic is one leg per host, not per
+  rank.
+- **Device path** (``device_collectives()``): when buffers are
+  device-resident, collectives compile to ``jax.lax`` ops over a
+  ``jax.sharding.Mesh`` built from the chips the planner pinned each rank
+  to (decision device ids → mesh positions) — see
+  parallel/collectives.py. This replaces the reference's per-rank-pair
+  TCP mesh (initSendRecvSockets :1789-1934): on TPU the rank mesh IS the
+  ICI topology and XLA owns the schedule.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from faabric_tpu.mpi.types import (
+    MpiDataType,
+    MpiMessageType,
+    MpiOp,
+    MpiStatus,
+    apply_op,
+    mpi_dtype_for,
+    np_dtype_for,
+    pack_mpi_payload,
+    unpack_mpi_payload,
+)
+from faabric_tpu.proto import BatchExecuteRequest, Message
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+MAIN_RANK = 0
+
+
+class MpiWorld:
+    def __init__(self, broker, world_id: int, size: int, group_id: int,
+                 user: str = "", function: str = "") -> None:
+        self.broker = broker
+        self.id = world_id
+        self.size = size
+        self.group_id = group_id
+        self.user = user
+        self.function = function
+
+        self._lock = threading.RLock()
+        # Per-rank async request bookkeeping (reference MpiRankState)
+        self._requests: dict[int, dict[int, tuple]] = {}
+        self._next_request_id = 1
+
+        # rank → host cache (initLocalRemoteLeaders, MpiWorld.cpp:318-366)
+        self._rank_hosts: dict[int, str] = {}
+        self._local_leader_cache: dict[str, int] = {}
+
+        # Exec-graph accounting (MpiWorld.h:13-18)
+        self._msg_count_to_rank: dict[int, int] = {}
+        self._msg_type_count: dict[tuple[int, int], int] = {}
+        self.record_exec_graph = False
+
+        self._device_collectives = None
+
+    # ------------------------------------------------------------------
+    # Topology
+    # ------------------------------------------------------------------
+    def refresh_rank_hosts(self) -> None:
+        self.broker.wait_for_mappings(self.group_id)
+        with self._lock:
+            self._rank_hosts = {
+                idx: self.broker.get_host_for_receiver(self.group_id, idx)
+                for idx in range(self.size)
+            }
+            self._local_leader_cache.clear()
+
+    def host_for_rank(self, rank: int) -> str:
+        with self._lock:
+            if rank not in self._rank_hosts:
+                self.refresh_rank_hosts()
+            return self._rank_hosts[rank]
+
+    def ranks_on_host(self, host: str) -> list[int]:
+        return [r for r in range(self.size) if self.host_for_rank(r) == host]
+
+    def local_leader(self, host: str) -> int:
+        """Lowest rank on a host (reference initLocalRemoteLeaders)."""
+        with self._lock:
+            if host not in self._local_leader_cache:
+                ranks = self.ranks_on_host(host)
+                if not ranks:
+                    raise ValueError(f"No ranks on host {host}")
+                self._local_leader_cache[host] = min(ranks)
+            return self._local_leader_cache[host]
+
+    def hosts(self) -> list[str]:
+        seen: dict[str, None] = {}
+        for r in range(self.size):
+            seen.setdefault(self.host_for_rank(r))
+        return list(seen)
+
+    def device_for_rank(self, rank: int) -> int:
+        self.broker.wait_for_mappings(self.group_id)
+        return self.broker.get_device_for_idx(self.group_id, rank)
+
+    # ------------------------------------------------------------------
+    # Device path
+    # ------------------------------------------------------------------
+    def device_collectives(self):
+        """Compiled XLA collectives over the mesh of this world's chips
+        (rank i ↔ planner-assigned device of rank i)."""
+        with self._lock:
+            if self._device_collectives is None:
+                from faabric_tpu.parallel.collectives import (
+                    DeviceCollectives,
+                    local_devices_for_ids,
+                )
+
+                device_ids = [self.device_for_rank(r) for r in range(self.size)]
+                devices = local_devices_for_ids(device_ids)
+                self._device_collectives = DeviceCollectives(devices)
+            return self._device_collectives
+
+    # ------------------------------------------------------------------
+    # Point-to-point
+    # ------------------------------------------------------------------
+    def send(self, send_rank: int, recv_rank: int, data: np.ndarray,
+             msg_type: MpiMessageType = MpiMessageType.NORMAL,
+             request_id: int = 0) -> None:
+        payload = pack_mpi_payload(msg_type, np.asarray(data), request_id)
+        if self.record_exec_graph:
+            with self._lock:
+                self._msg_count_to_rank[recv_rank] = \
+                    self._msg_count_to_rank.get(recv_rank, 0) + 1
+                key = (int(msg_type), recv_rank)
+                self._msg_type_count[key] = self._msg_type_count.get(key, 0) + 1
+        self.broker.send_message(self.group_id, send_rank, recv_rank,
+                                 payload, must_order=True)
+
+    def recv(self, send_rank: int, recv_rank: int,
+             timeout: float | None = None) -> tuple[np.ndarray, MpiStatus]:
+        raw = self.broker.recv_message(self.group_id, send_rank, recv_rank,
+                                       must_order=True, timeout=timeout)
+        msg_type, arr, _req = unpack_mpi_payload(raw)
+        status = MpiStatus(source=send_rank, count=arr.size,
+                           dtype=int(mpi_dtype_for(arr.dtype)))
+        return arr, status
+
+    def sendrecv(self, send_data: np.ndarray, send_rank: int, dst: int,
+                 src: int, recv_rank: int) -> tuple[np.ndarray, MpiStatus]:
+        """Concurrent send+recv for one rank (reference :752-785 uses an
+        async send; sends here never block on the receiver). ``send_rank``
+        is the sending index of the outbound message; ``recv_rank`` the
+        receiving index of the inbound one (normally the same rank)."""
+        self.send(send_rank, dst, send_data, MpiMessageType.SENDRECV)
+        return self.recv(src, recv_rank)
+
+    # -- async (reference :496-540 encodes requests; here a registry) ----
+    def isend(self, send_rank: int, recv_rank: int, data: np.ndarray) -> int:
+        with self._lock:
+            rid = self._next_request_id
+            self._next_request_id += 1
+            self._requests.setdefault(send_rank, {})[rid] = ("send",)
+        # PTP sends are buffered and non-blocking; fire immediately
+        self.send(send_rank, recv_rank, data, request_id=rid)
+        return rid
+
+    def irecv(self, send_rank: int, recv_rank: int) -> int:
+        with self._lock:
+            rid = self._next_request_id
+            self._next_request_id += 1
+            self._requests.setdefault(recv_rank, {})[rid] = (
+                "recv", send_rank, recv_rank)
+        return rid
+
+    def await_async(self, rank: int, request_id: int
+                    ) -> Optional[tuple[np.ndarray, MpiStatus]]:
+        """MPI_Wait. Recvs complete here (lazy, like the reference's
+        recvBatchReturnLast :1963-2030); sends completed at isend."""
+        with self._lock:
+            entry = self._requests.get(rank, {}).pop(request_id, None)
+        if entry is None:
+            raise KeyError(f"Unknown MPI request {request_id} for rank {rank}")
+        if entry[0] == "send":
+            return None
+        _, send_rank, recv_rank = entry
+        return self.recv(send_rank, recv_rank)
+
+    def pending_requests(self, rank: int) -> int:
+        with self._lock:
+            return len(self._requests.get(rank, {}))
+
+    # ------------------------------------------------------------------
+    # Collectives — locality-aware leader trees on the host path
+    # ------------------------------------------------------------------
+    def barrier(self, rank: int) -> None:
+        # Gather-to-0 + broadcast (reference :1753-1775) — delegated to the
+        # group barrier, which already has a single-host fast path
+        self.broker.wait_for_mappings(self.group_id)
+        group = self.broker.get_group(self.group_id)
+        group.barrier(rank)
+
+    def broadcast(self, send_rank: int, recv_rank: int, data: np.ndarray
+                  ) -> np.ndarray:
+        """Reference :786-853: root sends once per remote host (to its
+        local leader) + to its own host's ranks; leaders re-broadcast
+        locally."""
+        my_host = self.host_for_rank(recv_rank)
+        root_host = self.host_for_rank(send_rank)
+
+        if recv_rank == send_rank:
+            for host in self.hosts():
+                if host == root_host:
+                    for r in self.ranks_on_host(host):
+                        if r != send_rank:
+                            self.send(send_rank, r, data,
+                                      MpiMessageType.BROADCAST)
+                else:
+                    self.send(send_rank, self.local_leader(host), data,
+                              MpiMessageType.BROADCAST)
+            return np.asarray(data)
+
+        leader = self.local_leader(my_host)
+        if my_host != root_host and recv_rank == leader:
+            arr, _ = self.recv(send_rank, recv_rank)
+            for r in self.ranks_on_host(my_host):
+                if r != recv_rank:
+                    self.send(recv_rank, r, arr, MpiMessageType.BROADCAST)
+            return arr
+        src = send_rank if my_host == root_host else leader
+        arr, _ = self.recv(src, recv_rank)
+        return arr
+
+    def reduce(self, rank: int, root: int, data: np.ndarray,
+               op: MpiOp = MpiOp.SUM) -> Optional[np.ndarray]:
+        """Reference :1127-1249: non-leaders send to their local leader;
+        leaders partially reduce and forward one message to root."""
+        my_host = self.host_for_rank(rank)
+        root_host = self.host_for_rank(root)
+        leader = self.local_leader(my_host)
+        data = np.asarray(data)
+
+        if rank == root:
+            acc = data.copy()
+            # Local ranks send directly (root acts as its host's sink)
+            for r in self.ranks_on_host(root_host):
+                if r != root:
+                    arr, _ = self.recv(r, root)
+                    acc = apply_op(op, acc, arr)
+            # One partial result per remote host
+            for host in self.hosts():
+                if host != root_host:
+                    arr, _ = self.recv(self.local_leader(host), root)
+                    acc = apply_op(op, acc, arr)
+            return acc
+
+        if my_host == root_host:
+            # Same host as root: send directly
+            self.send(rank, root, data, MpiMessageType.REDUCE)
+            return None
+
+        if rank == leader:
+            acc = data.copy()
+            for r in self.ranks_on_host(my_host):
+                if r != rank:
+                    arr, _ = self.recv(r, rank)
+                    acc = apply_op(op, acc, arr)
+            self.send(rank, root, acc, MpiMessageType.REDUCE)
+            return None
+
+        self.send(rank, leader, data, MpiMessageType.REDUCE)
+        return None
+
+    def allreduce(self, rank: int, data: np.ndarray,
+                  op: MpiOp = MpiOp.SUM) -> np.ndarray:
+        # reduce to 0 + broadcast (reference :1251-1264)
+        reduced = self.reduce(rank, MAIN_RANK, data, op)
+        return self.broadcast(MAIN_RANK, rank,
+                              reduced if rank == MAIN_RANK else np.asarray(data))
+
+    def scatter(self, send_rank: int, recv_rank: int, data: np.ndarray,
+                recv_count: int) -> np.ndarray:
+        """Root splits (size*recv_count) into per-rank chunks."""
+        if recv_rank == send_rank:
+            data = np.asarray(data)
+            chunks = data.reshape(self.size, recv_count)
+            for r in range(self.size):
+                if r != send_rank:
+                    self.send(send_rank, r, chunks[r], MpiMessageType.SCATTER)
+            return chunks[send_rank].copy()
+        arr, _ = self.recv(send_rank, recv_rank)
+        return arr
+
+    def gather(self, send_rank: int, root: int, data: np.ndarray
+               ) -> Optional[np.ndarray]:
+        """Two-step local-leader aggregation (reference :917-1080)."""
+        my_host = self.host_for_rank(send_rank)
+        root_host = self.host_for_rank(root)
+        leader = self.local_leader(my_host)
+        data = np.asarray(data)
+        chunk = data.size
+
+        if send_rank == root:
+            out = np.empty((self.size, chunk), dtype=data.dtype)
+            out[root] = data
+            for r in self.ranks_on_host(root_host):
+                if r != root:
+                    arr, _ = self.recv(r, root)
+                    out[r] = arr
+            for host in self.hosts():
+                if host != root_host:
+                    remote_ranks = sorted(self.ranks_on_host(host))
+                    arr, _ = self.recv(self.local_leader(host), root)
+                    packed = arr.reshape(len(remote_ranks), chunk)
+                    for i, r in enumerate(remote_ranks):
+                        out[r] = packed[i]
+            return out.reshape(-1)
+
+        if my_host == root_host:
+            self.send(send_rank, root, data, MpiMessageType.GATHER)
+            return None
+
+        if send_rank == leader:
+            local_ranks = sorted(self.ranks_on_host(my_host))
+            packed = np.empty((len(local_ranks), chunk), dtype=data.dtype)
+            packed[local_ranks.index(send_rank)] = data
+            for r in local_ranks:
+                if r != send_rank:
+                    arr, _ = self.recv(r, send_rank)
+                    packed[local_ranks.index(r)] = arr
+            self.send(send_rank, root, packed.reshape(-1),
+                      MpiMessageType.GATHER)
+            return None
+
+        self.send(send_rank, leader, data, MpiMessageType.GATHER)
+        return None
+
+    def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
+        # gather(0) + broadcast (reference :1082-1111)
+        gathered = self.gather(rank, MAIN_RANK, data)
+        return self.broadcast(MAIN_RANK, rank,
+                              gathered if rank == MAIN_RANK
+                              else np.asarray(data))
+
+    def scan(self, rank: int, data: np.ndarray,
+             op: MpiOp = MpiOp.SUM) -> np.ndarray:
+        """Linear chain (reference :1390-1431): rank r receives the prefix
+        from r-1, merges, forwards to r+1."""
+        data = np.asarray(data)
+        if rank > 0:
+            prev, _ = self.recv(rank - 1, rank)
+            acc = apply_op(op, prev, data)
+        else:
+            acc = data.copy()
+        if rank < self.size - 1:
+            self.send(rank, rank + 1, acc, MpiMessageType.SCAN)
+        return acc
+
+    def alltoall(self, rank: int, data: np.ndarray) -> np.ndarray:
+        """All-pairs exchange of equal chunks (reference :1433-1736 naive
+        variant): data is (size*chunk,), row r goes to rank r."""
+        data = np.asarray(data)
+        chunk = data.size // self.size
+        rows = data.reshape(self.size, chunk)
+        for r in range(self.size):
+            if r != rank:
+                self.send(rank, r, rows[r], MpiMessageType.ALLTOALL)
+        out = np.empty_like(rows)
+        out[rank] = rows[rank]
+        for r in range(self.size):
+            if r != rank:
+                arr, _ = self.recv(r, rank)
+                out[r] = arr
+        return out.reshape(-1)
+
+    # ------------------------------------------------------------------
+    # Cartesian topology (reference :369-493, 2-D periodic, LAMMPS-style)
+    # ------------------------------------------------------------------
+    def cart_dims(self) -> tuple[int, int]:
+        side = int(np.floor(np.sqrt(self.size)))
+        while side > 1 and self.size % side != 0:
+            side -= 1
+        return side, self.size // side
+
+    def cart_coords(self, rank: int) -> tuple[int, int]:
+        _, cols = self.cart_dims()
+        return rank // cols, rank % cols
+
+    def cart_rank(self, coords: tuple[int, int]) -> int:
+        rows, cols = self.cart_dims()
+        return (coords[0] % rows) * cols + (coords[1] % cols)
+
+    def cart_shift(self, rank: int, dim: int, disp: int) -> tuple[int, int]:
+        """(source, dest) for a periodic shift along dim."""
+        row, col = self.cart_coords(rank)
+        if dim == 0:
+            src = self.cart_rank((row - disp, col))
+            dst = self.cart_rank((row + disp, col))
+        else:
+            src = self.cart_rank((row, col - disp))
+            dst = self.cart_rank((row, col + disp))
+        return src, dst
+
+    # ------------------------------------------------------------------
+    # Migration (reference prepareMigration :2095-2131)
+    # ------------------------------------------------------------------
+    def prepare_migration(self, rank: int, new_group_id: int | None = None) -> None:
+        with self._lock:
+            if any(self._requests.values()):
+                raise RuntimeError(
+                    "Cannot migrate an MPI world with pending async requests")
+            if new_group_id is not None:
+                self.group_id = new_group_id
+            self._rank_hosts.clear()
+            self._local_leader_cache.clear()
+            self._device_collectives = None
+
+    # ------------------------------------------------------------------
+    def exec_graph_details(self) -> dict[str, int]:
+        with self._lock:
+            out = {f"mpi-msgcount-torank-{r}": n
+                   for r, n in self._msg_count_to_rank.items()}
+            for (t, r), n in self._msg_type_count.items():
+                out[f"mpi-msgtype-{t}-torank-{r}"] = n
+            return out

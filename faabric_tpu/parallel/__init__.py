@@ -1,0 +1,9 @@
+"""TPU mesh substrate: device collectives, shardings (reference analog:
+the ICI/XLA data plane replacing src/mpi's TCP collectives)."""
+
+from faabric_tpu.parallel.collectives import (
+    DeviceCollectives,
+    local_devices_for_ids,
+)
+
+__all__ = ["DeviceCollectives", "local_devices_for_ids"]

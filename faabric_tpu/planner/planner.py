@@ -108,8 +108,9 @@ class Planner:
         # app_id → frozen request (spot eviction)
         self._evicted: dict[int, BatchExecuteRequest] = {}
         self._next_evicted_ips: set[str] = set()
-        # app_id → (group_id, hosts ever involved) for group cleanup
-        self._group_hosts: dict[int, tuple[int, set[str]]] = {}
+        # app_id → (every group_id the app ever used — migration mints new
+        # ones — and all hosts ever involved) for group cleanup
+        self._group_hosts: dict[int, tuple[set[int], set[str]]] = {}
         self._num_migrations = 0
         self._clients: dict[str, "object"] = {}
         self._clients_lock = threading.Lock()
@@ -212,10 +213,9 @@ class Planner:
         # Network I/O strictly outside the lock: mappings first (guest code
         # blocks on wait_for_mappings before messaging), then dispatch.
         with self._lock:
-            gid, hosts = self._group_hosts.get(req.app_id, (mappings.group_id,
-                                                            set()))
+            gids, hosts = self._group_hosts.get(req.app_id, (set(), set()))
             self._group_hosts[req.app_id] = (
-                mappings.group_id, hosts | set(mappings.hosts))
+                gids | {mappings.group_id}, hosts | set(mappings.hosts))
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         return decision
@@ -501,8 +501,9 @@ class Planner:
         if group_cleanup is not None:
             from faabric_tpu.transport.ptp_remote import send_clear_group
 
-            gid, hosts = group_cleanup
-            send_clear_group(gid, sorted(hosts))
+            gids, hosts = group_cleanup
+            for gid in gids:
+                send_clear_group(gid, sorted(hosts))
 
     # The planner is cluster-singleton and long-lived: completed apps'
     # results are retained for late readers but bounded, oldest-first.

@@ -46,6 +46,14 @@ class WorkerRuntime:
         self.ptp_broker = PointToPointBroker(self.host)
         self.scheduler.ptp_broker = self.ptp_broker
 
+        # MPI worlds (reference FaabricMain's MpiWorldRegistry singleton;
+        # here per runtime for in-process multi-host tests)
+        from faabric_tpu.mpi.registry import MpiWorldRegistry
+
+        self.mpi_registry = MpiWorldRegistry(self.ptp_broker,
+                                             self.planner_client)
+        self.scheduler.mpi_registry = self.mpi_registry
+
         # Started by later layers: snapshot server, state server
         self.extra_servers: list = [PointToPointServer(self.ptp_broker)]
 

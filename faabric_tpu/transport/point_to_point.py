@@ -35,6 +35,14 @@ POINT_TO_POINT_MAIN_IDX = 0
 NO_LOCK_OWNER_IDX = -1
 NO_SEQUENCE_NUM = -1
 
+# Channel namespaces: group coordination traffic (lock grants, barrier
+# releases, notify) must never share a delivery queue with application
+# payloads on the same (group, send, recv) triple — an unordered
+# coordination byte could otherwise be consumed by an ordered data recv
+# (and vice versa) when server workers race.
+DATA_CHANNEL = 0
+COORD_CHANNEL = 1
+
 
 class PointToPointBroker:
     def __init__(self, host: str) -> None:
@@ -45,12 +53,12 @@ class PointToPointBroker:
         self._mappings: dict[int, dict[int, PointToPointMapping]] = {}
         # group_id → waiter fired once mappings for the group arrive
         self._flags: dict[int, FlagWaiter] = {}
-        # (group, send, recv) → delivery queue of (seq, bytes)
-        self._queues: dict[tuple[int, int, int], Queue] = {}
+        # (group, send, recv, channel) → delivery queue of (seq, bytes)
+        self._queues: dict[tuple[int, int, int, int], Queue] = {}
         # ordered-delivery state per channel
-        self._sent_seq: dict[tuple[int, int, int], int] = {}
-        self._recv_seq: dict[tuple[int, int, int], int] = {}
-        self._ooo: dict[tuple[int, int, int], dict[int, bytes]] = {}
+        self._sent_seq: dict[tuple[int, int, int, int], int] = {}
+        self._recv_seq: dict[tuple[int, int, int, int], int] = {}
+        self._ooo: dict[tuple[int, int, int, int], dict[int, bytes]] = {}
 
         self._groups: dict[int, PointToPointGroup] = {}
         self._clients: dict[str, object] = {}
@@ -119,10 +127,11 @@ class PointToPointBroker:
     # Messaging
     # ------------------------------------------------------------------
     def send_message(self, group_id: int, send_idx: int, recv_idx: int,
-                     data: bytes, must_order: bool = False) -> None:
+                     data: bytes, must_order: bool = False,
+                     channel: int = DATA_CHANNEL) -> None:
         self.wait_for_mappings(group_id)
         dst_host = self.get_host_for_receiver(group_id, recv_idx)
-        key = (group_id, send_idx, recv_idx)
+        key = (group_id, send_idx, recv_idx, channel)
 
         seq = NO_SEQUENCE_NUM
         if must_order:
@@ -131,22 +140,25 @@ class PointToPointBroker:
                 self._sent_seq[key] = seq
 
         if dst_host == self.host:
-            self.deliver(group_id, send_idx, recv_idx, data, seq)
+            self.deliver(group_id, send_idx, recv_idx, data, seq, channel)
         else:
             self._get_client(dst_host).send_message(
-                group_id, send_idx, recv_idx, data, seq)
+                group_id, send_idx, recv_idx, data, seq, channel)
 
     def deliver(self, group_id: int, send_idx: int, recv_idx: int,
-                data: bytes, seq: int = NO_SEQUENCE_NUM) -> None:
+                data: bytes, seq: int = NO_SEQUENCE_NUM,
+                channel: int = DATA_CHANNEL) -> None:
         """Enqueue an inbound message (local send or arriving RPC)."""
-        self._get_queue((group_id, send_idx, recv_idx)).enqueue((seq, data))
+        self._get_queue((group_id, send_idx, recv_idx, channel)).enqueue(
+            (seq, data))
 
     def recv_message(self, group_id: int, send_idx: int, recv_idx: int,
                      must_order: bool = False,
-                     timeout: float | None = None) -> bytes:
+                     timeout: float | None = None,
+                     channel: int = DATA_CHANNEL) -> bytes:
         conf = get_system_config()
         timeout = timeout if timeout is not None else conf.global_message_timeout
-        key = (group_id, send_idx, recv_idx)
+        key = (group_id, send_idx, recv_idx, channel)
         q = self._get_queue(key)
 
         if not must_order:
@@ -180,7 +192,7 @@ class PointToPointBroker:
                 return data
             buf[seq] = data
 
-    def _get_queue(self, key: tuple[int, int, int]) -> Queue:
+    def _get_queue(self, key: tuple[int, int, int, int]) -> Queue:
         with self._lock:
             q = self._queues.get(key)
             if q is None:
@@ -308,14 +320,16 @@ class PointToPointGroup:
             if locker_is_local:
                 # Queued: wait for the grant message from main
                 self.broker.recv_message(self.group_id,
-                                         POINT_TO_POINT_MAIN_IDX, group_idx)
+                                         POINT_TO_POINT_MAIN_IDX, group_idx,
+                                         channel=COORD_CHANNEL)
             # A remote queued locker is notified by unlock() later
         else:
             # Ask the main host, then wait for the grant
             self.broker._get_client(main_host).group_lock(
                 self.app_id, self.group_id, group_idx, recursive)
             self.broker.recv_message(self.group_id,
-                                     POINT_TO_POINT_MAIN_IDX, group_idx)
+                                     POINT_TO_POINT_MAIN_IDX, group_idx,
+                                     channel=COORD_CHANNEL)
 
     def unlock(self, group_idx: int, recursive: bool = False) -> None:
         main_host = self.broker.get_host_for_receiver(
@@ -347,7 +361,7 @@ class PointToPointGroup:
 
     def _notify_locked(self, group_idx: int) -> None:
         self.broker.send_message(self.group_id, POINT_TO_POINT_MAIN_IDX,
-                                 group_idx, b"\x00")
+                                 group_idx, b"\x00", channel=COORD_CHANNEL)
 
     def get_lock_owner(self, recursive: bool = False) -> int:
         with self._mx:
@@ -386,15 +400,18 @@ class PointToPointGroup:
         if group_idx == POINT_TO_POINT_MAIN_IDX:
             for i in range(1, self.group_size):
                 self.broker.recv_message(self.group_id, i,
-                                         POINT_TO_POINT_MAIN_IDX)
+                                         POINT_TO_POINT_MAIN_IDX,
+                                         channel=COORD_CHANNEL)
             for i in range(1, self.group_size):
                 self.broker.send_message(self.group_id,
-                                         POINT_TO_POINT_MAIN_IDX, i, b"\x00")
+                                         POINT_TO_POINT_MAIN_IDX, i, b"\x00",
+                                         channel=COORD_CHANNEL)
         else:
             self.broker.send_message(self.group_id, group_idx,
-                                     POINT_TO_POINT_MAIN_IDX, b"\x00")
+                                     POINT_TO_POINT_MAIN_IDX, b"\x00",
+                                     channel=COORD_CHANNEL)
             self.broker.recv_message(self.group_id, POINT_TO_POINT_MAIN_IDX,
-                                     group_idx)
+                                     group_idx, channel=COORD_CHANNEL)
 
     def notify(self, group_idx: int) -> None:
         """Non-main idxs signal the main, which collects all of them
@@ -402,10 +419,12 @@ class PointToPointGroup:
         if group_idx == POINT_TO_POINT_MAIN_IDX:
             for i in range(1, self.group_size):
                 self.broker.recv_message(self.group_id, i,
-                                         POINT_TO_POINT_MAIN_IDX)
+                                         POINT_TO_POINT_MAIN_IDX,
+                                         channel=COORD_CHANNEL)
         else:
             self.broker.send_message(self.group_id, group_idx,
-                                     POINT_TO_POINT_MAIN_IDX, b"\x00")
+                                     POINT_TO_POINT_MAIN_IDX, b"\x00",
+                                     channel=COORD_CHANNEL)
 
 
 def mappings_from_decision(decision: SchedulingDecision) -> PointToPointMappings:

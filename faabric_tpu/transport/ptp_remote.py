@@ -103,7 +103,7 @@ class PointToPointClient(MessageEndpointClient):
                        {"mappings": mappings.to_dict()}, idempotent=True)
 
     def send_message(self, group_id: int, send_idx: int, recv_idx: int,
-                     data: bytes, seq: int = -1) -> None:
+                     data: bytes, seq: int = -1, channel: int = 0) -> None:
         if is_mock_mode():
             with _mock_lock:
                 _sent_messages.append(
@@ -111,6 +111,7 @@ class PointToPointClient(MessageEndpointClient):
             return
         self.async_send(int(PointToPointCall.MESSAGE), {
             "group_id": group_id, "send_idx": send_idx, "recv_idx": recv_idx,
+            "channel": channel,
         }, data, seqnum=seq)
 
     def group_lock(self, app_id: int, group_id: int, group_idx: int,
@@ -161,7 +162,8 @@ class PointToPointServer(MessageEndpointServer):
         h = msg.header
         if code == int(PointToPointCall.MESSAGE):
             self.broker.deliver(h["group_id"], h["send_idx"], h["recv_idx"],
-                                msg.payload, msg.seqnum)
+                                msg.payload, msg.seqnum,
+                                h.get("channel", 0))
         elif code in (int(PointToPointCall.LOCK_GROUP),
                       int(PointToPointCall.LOCK_GROUP_RECURSIVE),
                       int(PointToPointCall.UNLOCK_GROUP),

@@ -7,13 +7,22 @@ path; bench.py runs on the real chip).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before any backend initialises.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# This image's sitecustomize registers the remote-TPU ("axon") PJRT plugin
+# and *explicitly* sets jax_platforms="axon,cpu", which overrides the env
+# var above; initialising that backend dials the TPU tunnel — minutes-slow
+# and single-claimant. Force the config back to CPU before any test can
+# touch a device.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,121 @@
+"""Device-path collectives: compiled XLA ops over the 8-device virtual
+mesh, checked against numpy. On real hardware the same code rides ICI."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from faabric_tpu.mpi import MpiOp
+from faabric_tpu.parallel import DeviceCollectives
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def coll():
+    devices = jax.devices()
+    assert len(devices) >= N, "conftest must provide the 8-device mesh"
+    return DeviceCollectives(devices[:N])
+
+
+def per_rank(shape=(16,), seed0=0):
+    return [np.random.RandomState(seed0 + r).rand(*shape).astype(np.float32)
+            for r in range(N)]
+
+
+def test_allreduce_sum(coll):
+    bufs = per_rank()
+    x = coll.shard_stacked(bufs)
+    out = coll.allreduce(x, MpiOp.SUM)
+    expected = np.sum(np.stack(bufs), axis=0)
+    for shard in coll.to_per_rank(out):
+        np.testing.assert_allclose(shard, expected, rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,npfn", [
+    (MpiOp.MAX, np.max), (MpiOp.MIN, np.min), (MpiOp.PROD, np.prod),
+])
+def test_allreduce_other_ops(coll, op, npfn):
+    bufs = per_rank()
+    out = coll.allreduce(coll.shard_stacked(bufs), op)
+    expected = npfn(np.stack(bufs), axis=0)
+    np.testing.assert_allclose(coll.to_per_rank(out)[3], expected, rtol=1e-5)
+
+
+def test_allgather(coll):
+    bufs = per_rank(shape=(4,))
+    out = coll.allgather(coll.shard_stacked(bufs).reshape(N * 4))
+    expected = np.concatenate(bufs)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_reduce_scatter(coll):
+    k = 3
+    bufs = per_rank(shape=(N * k,))
+    x = coll.shard_stacked(bufs)  # (N, N*k)
+    out = coll.reduce_scatter(x)  # (N, k)
+    summed = np.sum(np.stack(bufs), axis=0)  # (N*k,)
+    shards = coll.to_per_rank(out)
+    for r in range(N):
+        np.testing.assert_allclose(shards[r], summed[r * k:(r + 1) * k],
+                                   rtol=1e-5)
+
+
+def test_alltoall(coll):
+    k = 2
+    mats = [np.random.RandomState(r).rand(N, k).astype(np.float32)
+            for r in range(N)]
+    x = coll.shard_stacked(mats)  # (N, N, k)
+    out = coll.alltoall(x)
+    shards = coll.to_per_rank(out)
+    for r in range(N):
+        expected = np.stack([mats[src][r] for src in range(N)])
+        np.testing.assert_allclose(shards[r], expected, rtol=1e-6)
+
+
+def test_broadcast(coll):
+    bufs = per_rank()
+    out = coll.broadcast(coll.shard_stacked(bufs), root=5)
+    np.testing.assert_allclose(np.asarray(out), bufs[5], rtol=1e-6)
+
+
+def test_scan(coll):
+    bufs = per_rank(shape=(6,))
+    out = coll.scan(coll.shard_stacked(bufs), MpiOp.SUM)
+    prefixes = np.cumsum(np.stack(bufs), axis=0)
+    shards = coll.to_per_rank(out)
+    for r in range(N):
+        np.testing.assert_allclose(shards[r], prefixes[r].reshape(1, -1)[0],
+                                   rtol=1e-5)
+
+
+def test_compiled_cache_reused(coll):
+    bufs = per_rank()
+    x = coll.shard_stacked(bufs)
+    coll.allreduce(x)
+    n_before = len(coll._cache)
+    coll.allreduce(coll.shard_stacked(per_rank(seed0=50)))
+    assert len(coll._cache) == n_before  # same shape/dtype → cache hit
+
+
+def test_world_device_collectives_end_to_end():
+    """MpiWorld.device_collectives builds the mesh from planner-assigned
+    chips (group mappings) and runs a compiled allreduce."""
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.mpi import MpiWorld
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+
+    broker = PointToPointBroker("devhost")
+    d = SchedulingDecision(app_id=99, group_id=99)
+    for rank in range(N):
+        d.add_message("devhost", 3000 + rank, rank, rank, device_id=rank)
+    broker.set_up_local_mappings_from_decision(d)
+
+    world = MpiWorld(broker, 99, N, 99)
+    coll = world.device_collectives()
+    assert coll.n == N
+    bufs = [np.full(8, float(r), dtype=np.float32) for r in range(N)]
+    out = coll.allreduce(coll.shard_stacked(bufs))
+    np.testing.assert_allclose(coll.to_per_rank(out)[0],
+                               np.full(8, sum(range(N)), dtype=np.float32))

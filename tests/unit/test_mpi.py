@@ -1,0 +1,312 @@
+"""MpiWorld host-path tests (reference: tests/test/mpi/test_mpi_world.cpp,
+test_remote_mpi_worlds.cpp). Worlds run over two brokers with live PTP
+servers; every collective is checked against numpy."""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.mpi import MpiOp, MpiWorld, MpiWorldRegistry
+from faabric_tpu.transport.common import register_host_alias
+from faabric_tpu.transport.point_to_point import PointToPointBroker
+from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+WORLD_ID = 4242
+GROUP_ID = 4242
+
+
+@pytest.fixture
+def mpi_cluster():
+    """Two logical hosts, 6 ranks split 3+3, live PTP servers."""
+    base = random.randint(100, 500) * 100
+    register_host_alias("mpiA", "127.0.0.1", base)
+    register_host_alias("mpiB", "127.0.0.1", base + 1000)
+    brokers = {h: PointToPointBroker(h) for h in ("mpiA", "mpiB")}
+    servers = [PointToPointServer(b) for b in brokers.values()]
+    for s in servers:
+        s.start()
+
+    decision = SchedulingDecision(app_id=GROUP_ID, group_id=GROUP_ID)
+    for rank in range(6):
+        host = "mpiA" if rank < 3 else "mpiB"
+        decision.add_message(host, 2000 + rank, rank, rank,
+                             mpi_port=8020 + rank, device_id=rank % 4)
+    for b in brokers.values():
+        b.set_up_local_mappings_from_decision(decision)
+
+    worlds = {}
+    for host, b in brokers.items():
+        worlds[host] = MpiWorld(b, WORLD_ID, 6, GROUP_ID)
+
+    def world_for_rank(rank):
+        return worlds["mpiA"] if rank < 3 else worlds["mpiB"]
+
+    yield world_for_rank
+
+    for s in servers:
+        s.stop()
+    for b in brokers.values():
+        b.clear()
+
+
+def run_ranks(world_for_rank, fn, n=6, timeout=20.0):
+    """Run fn(world, rank) on a thread per rank; returns results by rank."""
+    results = {}
+    errors = []
+
+    def runner(rank):
+        try:
+            results[rank] = fn(world_for_rank(rank), rank)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=runner, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in threads), "rank thread hung"
+    assert not errors, errors
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Point-to-point
+# ---------------------------------------------------------------------------
+
+def test_send_recv_cross_host(mpi_cluster):
+    data = np.arange(100, dtype=np.float64)
+
+    def fn(world, rank):
+        if rank == 0:
+            world.send(0, 5, data)
+            return None
+        if rank == 5:
+            arr, status = world.recv(0, 5)
+            assert status.source == 0
+            assert status.count == 100
+            return arr
+        return None
+
+    results = run_ranks(mpi_cluster, fn)
+    np.testing.assert_array_equal(results[5], data)
+
+
+def test_sendrecv(mpi_cluster):
+    def fn(world, rank):
+        if rank not in (1, 2):
+            return None
+        other = 3 - rank
+        out = np.full(4, rank, dtype=np.int32)
+        arr, _ = world.sendrecv(out, rank, other, other, rank)
+        return arr
+
+    results = run_ranks(mpi_cluster, fn)
+    np.testing.assert_array_equal(results[1], np.full(4, 2, dtype=np.int32))
+    np.testing.assert_array_equal(results[2], np.full(4, 1, dtype=np.int32))
+
+
+def test_isend_irecv_wait(mpi_cluster):
+    payload = np.arange(10, dtype=np.int64)
+
+    def fn(world, rank):
+        if rank == 3:
+            rid = world.isend(3, 4, payload)
+            assert world.await_async(3, rid) is None
+            assert world.pending_requests(3) == 0
+            return None
+        if rank == 4:
+            rid = world.irecv(3, 4)
+            arr, status = world.await_async(4, rid)
+            assert status.count == 10
+            return arr
+        return None
+
+    results = run_ranks(mpi_cluster, fn)
+    np.testing.assert_array_equal(results[4], payload)
+
+
+def test_message_ordering_per_channel(mpi_cluster):
+    def fn(world, rank):
+        if rank == 0:
+            for i in range(50):
+                world.send(0, 1, np.array([i], dtype=np.int32))
+            return None
+        if rank == 1:
+            got = [int(world.recv(0, 1)[0][0]) for _ in range(50)]
+            return got
+        return None
+
+    results = run_ranks(mpi_cluster, fn)
+    assert results[1] == list(range(50))
+
+
+# ---------------------------------------------------------------------------
+# Collectives vs numpy
+# ---------------------------------------------------------------------------
+
+def per_rank_data(rank, n=8, dtype=np.float64):
+    rng = np.random.RandomState(rank)
+    return rng.rand(n).astype(dtype)
+
+
+def test_broadcast_leader_tree(mpi_cluster):
+    data = np.arange(16, dtype=np.float32)
+
+    def fn(world, rank):
+        return world.broadcast(2, rank, data if rank == 2 else np.empty(0))
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        np.testing.assert_array_equal(results[rank], data)
+
+
+@pytest.mark.parametrize("op,npop", [
+    (MpiOp.SUM, np.add),
+    (MpiOp.MAX, np.maximum),
+    (MpiOp.MIN, np.minimum),
+    (MpiOp.PROD, np.multiply),
+])
+def test_allreduce_matches_numpy(mpi_cluster, op, npop):
+    expected = per_rank_data(0)
+    for r in range(1, 6):
+        expected = npop(expected, per_rank_data(r))
+
+    def fn(world, rank):
+        return world.allreduce(rank, per_rank_data(rank), op)
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        np.testing.assert_allclose(results[rank], expected, rtol=1e-12)
+
+
+def test_reduce_to_nonzero_root(mpi_cluster):
+    expected = sum(per_rank_data(r) for r in range(6))
+
+    def fn(world, rank):
+        return world.reduce(rank, 4, per_rank_data(rank), MpiOp.SUM)
+
+    results = run_ranks(mpi_cluster, fn)
+    np.testing.assert_allclose(results[4], expected, rtol=1e-12)
+    assert all(results[r] is None for r in range(6) if r != 4)
+
+
+def test_gather_allgather(mpi_cluster):
+    expected = np.concatenate([per_rank_data(r, 4) for r in range(6)])
+
+    def gather_fn(world, rank):
+        return world.gather(rank, 0, per_rank_data(rank, 4))
+
+    results = run_ranks(mpi_cluster, gather_fn)
+    np.testing.assert_allclose(results[0], expected, rtol=1e-12)
+
+    def allgather_fn(world, rank):
+        return world.allgather(rank, per_rank_data(rank, 4))
+
+    results = run_ranks(mpi_cluster, allgather_fn)
+    for rank in range(6):
+        np.testing.assert_allclose(results[rank], expected, rtol=1e-12)
+
+
+def test_scatter(mpi_cluster):
+    root_data = np.arange(24, dtype=np.float64)
+
+    def fn(world, rank):
+        return world.scatter(1, rank, root_data if rank == 1 else np.empty(0), 4)
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        np.testing.assert_array_equal(results[rank],
+                                      root_data[rank * 4:(rank + 1) * 4])
+
+
+def test_scan(mpi_cluster):
+    datas = [per_rank_data(r, 5) for r in range(6)]
+    prefixes = np.cumsum(np.stack(datas), axis=0)
+
+    def fn(world, rank):
+        return world.scan(rank, datas[rank], MpiOp.SUM)
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        np.testing.assert_allclose(results[rank], prefixes[rank], rtol=1e-12)
+
+
+def test_alltoall(mpi_cluster):
+    # rank r sends row q of its matrix to rank q
+    mats = {r: np.arange(12, dtype=np.int32) + 100 * r for r in range(6)}
+
+    def fn(world, rank):
+        return world.alltoall(rank, mats[rank])
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        expected = np.concatenate([
+            mats[src].reshape(6, 2)[rank] for src in range(6)])
+        np.testing.assert_array_equal(results[rank], expected)
+
+
+def test_barrier(mpi_cluster):
+    hits = []
+    done = []
+
+    def fn(world, rank):
+        hits.append(rank)
+        world.barrier(rank)
+        done.append(rank)
+        return None
+
+    run_ranks(mpi_cluster, fn)
+    assert sorted(hits) == list(range(6))
+    assert sorted(done) == list(range(6))
+
+
+# ---------------------------------------------------------------------------
+# Topology helpers
+# ---------------------------------------------------------------------------
+
+def test_locality_helpers(mpi_cluster):
+    world = mpi_cluster(0)
+    assert world.ranks_on_host("mpiA") == [0, 1, 2]
+    assert world.ranks_on_host("mpiB") == [3, 4, 5]
+    assert world.local_leader("mpiA") == 0
+    assert world.local_leader("mpiB") == 3
+    assert world.hosts() == ["mpiA", "mpiB"]
+    assert world.device_for_rank(5) == 1
+
+
+def test_cartesian_topology(mpi_cluster):
+    world = mpi_cluster(0)
+    rows, cols = world.cart_dims()
+    assert rows * cols == 6
+    # round-trip coords
+    for r in range(6):
+        assert world.cart_rank(world.cart_coords(r)) == r
+    src, dst = world.cart_shift(0, 0, 1)
+    assert 0 <= src < 6 and 0 <= dst < 6
+
+
+def test_exec_graph_accounting(mpi_cluster):
+    def fn(world, rank):
+        world.record_exec_graph = True
+        if rank == 0:
+            world.send(0, 1, np.zeros(1))
+            world.send(0, 1, np.zeros(1))
+        elif rank == 1:
+            world.recv(0, 1)
+            world.recv(0, 1)
+        return None
+
+    run_ranks(mpi_cluster, fn)
+    details = mpi_cluster(0).exec_graph_details()
+    assert details.get("mpi-msgcount-torank-1") == 2
+
+
+def test_migration_blocked_with_pending_async(mpi_cluster):
+    world = mpi_cluster(0)
+    world.irecv(0, 0)
+    with pytest.raises(RuntimeError):
+        world.prepare_migration(0)
