@@ -1,0 +1,247 @@
+"""Benchmark harness — prints ONE JSON line to stdout.
+
+Reproduces the reference's benchmark shapes
+(/root/reference/tests/dist/mpi/benchmarks/mpi_bench.cpp:18-85): MPI
+allreduce effective rate using the same workload formula
+4·(np−1)·payload_bytes/s with the ResNet-50-scale payload, plus
+point-to-point dispatch latency — the BASELINE.md north-star metric
+(<1 ms p50) — measured over real loopback sockets between two aliased
+hosts. When a device is reachable it also times the flagship model's
+compiled train step.
+
+Headline metric: ptp_dispatch_p50_ms (vs_baseline = 1 ms target / actual,
+>1 is better than target). Secondary numbers ride in "extras".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+
+def bench_ptp_dispatch(iters: int = 400) -> dict:
+    """One-way PTP dispatch latency between two aliased hosts over real
+    loopback TCP (send → remote broker delivery → recv), measured as
+    ping-pong RTT/2."""
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.transport.common import (
+        clear_host_aliases,
+        register_host_alias,
+    )
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+    from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+    base = random.randint(100, 500) * 100
+    register_host_alias("benchA", "127.0.0.1", base)
+    register_host_alias("benchB", "127.0.0.1", base + 1000)
+    brokers = {h: PointToPointBroker(h) for h in ("benchA", "benchB")}
+    servers = [PointToPointServer(b) for b in brokers.values()]
+    for s in servers:
+        s.start()
+    try:
+        d = SchedulingDecision(app_id=1, group_id=1)
+        d.add_message("benchA", 1, 0, 0)
+        d.add_message("benchB", 2, 1, 1)
+        for b in brokers.values():
+            b.set_up_local_mappings_from_decision(d)
+
+        payload = b"x" * 64
+        errs = []
+
+        def echo():
+            try:
+                for _ in range(iters):
+                    brokers["benchB"].recv_message(1, 0, 1, timeout=30.0)
+                    brokers["benchB"].send_message(1, 1, 0, payload)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        warmup = 20
+        t = threading.Thread(target=echo)
+        t.start()
+        lat = []
+        a = brokers["benchA"]
+        for i in range(iters):
+            t0 = time.perf_counter()
+            a.send_message(1, 0, 1, payload)
+            a.recv_message(1, 1, 0, timeout=30.0)
+            if i >= warmup:  # exclude connection establishment / cold path
+                lat.append((time.perf_counter() - t0) / 2)
+        t.join(timeout=10.0)
+        if errs:
+            raise errs[0]
+        lat.sort()
+        return {
+            "p50_ms": 1000 * lat[len(lat) // 2],
+            "p99_ms": 1000 * lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+            "min_ms": 1000 * lat[0],
+        }
+    finally:
+        for s in servers:
+            s.stop()
+        for b in brokers.values():
+            b.clear()
+        clear_host_aliases()
+
+
+def bench_host_allreduce(n_ranks: int = 4, elems: int = 25_500_000,
+                         rounds: int = 3) -> dict:
+    """Host-path allreduce, reference workload formula: effective bytes =
+    4·(np−1)·payload per round (mpi_bench.cpp:60-85), ResNet-50-scale
+    payload (~97 MiB of int32)."""
+    import numpy as np
+
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.mpi import MpiOp, MpiWorld
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+
+    broker = PointToPointBroker("bench-host")
+    d = SchedulingDecision(app_id=2, group_id=2)
+    for r in range(n_ranks):
+        d.add_message("bench-host", 10 + r, r, r)
+    broker.set_up_local_mappings_from_decision(d)
+    world = MpiWorld(broker, 2, n_ranks, 2)
+
+    datas = [np.full(elems, r, dtype=np.int32) for r in range(n_ranks)]
+    expected_head = sum(range(n_ranks))
+
+    def rank_fn(rank, out):
+        res = None
+        for _ in range(rounds):
+            res = world.allreduce(rank, datas[rank], MpiOp.SUM)
+        out[rank] = res
+
+    out: dict = {}
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=rank_fn, args=(r, out))
+               for r in range(n_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    assert out[0][0] == expected_head
+
+    payload_bytes = elems * 4
+    effective = 4 * (n_ranks - 1) * payload_bytes * rounds
+    gibs = effective / elapsed / (1 << 30)
+    broker.clear()
+    return {"effective_gibs": gibs, "np": n_ranks,
+            "payload_mib": payload_bytes / (1 << 20), "rounds": rounds}
+
+
+def bench_device_step() -> dict:
+    """Flagship model compiled train step on the available device."""
+    from faabric_tpu.util.device_env import force_cpu_if_requested
+
+    force_cpu_if_requested()
+    import jax
+    import numpy as np
+
+    from faabric_tpu.models import (
+        ModelConfig,
+        data_sharding,
+        init_train_state,
+        make_train_step,
+    )
+    from faabric_tpu.parallel import MeshConfig, build_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    cfg = ModelConfig(vocab_size=8192, d_model=512, n_layers=4, n_heads=8,
+                      d_ff=2048, max_seq=512)
+    mesh = build_mesh(devices, MeshConfig())
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+
+    batch, seq = 8 * n, 512
+    rng = np.random.RandomState(0)
+    tokens = jax.device_put(
+        rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32),
+        data_sharding(mesh))
+    targets = jax.device_put(
+        rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32),
+        data_sharding(mesh))
+
+    # Compile + warmup
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * n_steps / elapsed
+    return {
+        "platform": devices[0].platform,
+        "n_devices": n,
+        "step_ms": 1000 * elapsed / n_steps,
+        "tokens_per_s": tokens_per_s,
+        "loss": float(loss),
+    }
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    quick = os.environ.get("BENCH_QUICK") == "1"
+
+    extras: dict = {}
+
+    ptp = bench_ptp_dispatch(iters=100 if quick else 400)
+    extras["ptp"] = ptp
+
+    try:
+        ar = bench_host_allreduce(
+            n_ranks=4, elems=1_000_000 if quick else 25_500_000,
+            rounds=1 if quick else 3)
+        extras["host_allreduce"] = ar
+    except Exception as e:  # noqa: BLE001
+        extras["host_allreduce_error"] = str(e)[:200]
+
+    if not quick or os.environ.get("BENCH_DEVICE") == "1":
+        # Device init on the remote-TPU tunnel can wedge for minutes; run
+        # the device phase under a watchdog subprocess so the harness
+        # always prints its line.
+        import subprocess
+
+        timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "360"))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--device-only"],
+                capture_output=True, text=True, timeout=timeout_s)
+            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            if proc.returncode == 0 and line.startswith("{"):
+                extras["device_step"] = json.loads(line)
+            else:
+                extras["device_step_error"] = (
+                    f"rc={proc.returncode}: {proc.stderr[-200:]}")
+        except subprocess.TimeoutExpired:
+            extras["device_step_error"] = f"timeout after {timeout_s}s"
+        except Exception as e:  # noqa: BLE001
+            extras["device_step_error"] = str(e)[:200]
+
+    p50 = ptp["p50_ms"]
+    result = {
+        "metric": "ptp_dispatch_p50_ms",
+        "value": round(p50, 4),
+        "unit": "ms",
+        # North star: <1 ms p50 (BASELINE.md); >1 here beats the target
+        "vs_baseline": round(1.0 / p50, 3) if p50 > 0 else None,
+        "extras": extras,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    if "--device-only" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        print(json.dumps(bench_device_step()))
+    else:
+        main()

@@ -1,0 +1,29 @@
+"""Flagship models exercising the mesh substrate."""
+
+from faabric_tpu.models.transformer import (
+    ModelConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_shardings,
+    shard_params,
+)
+from faabric_tpu.models.train import (
+    data_sharding,
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "ModelConfig",
+    "data_sharding",
+    "forward",
+    "init_params",
+    "init_train_state",
+    "loss_fn",
+    "make_optimizer",
+    "make_train_step",
+    "param_shardings",
+    "shard_params",
+]

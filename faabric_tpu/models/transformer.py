@@ -1,0 +1,189 @@
+"""Flagship model: decoder-only transformer, TPU-first.
+
+Pure-JAX pytree params (no framework indirection between the model and
+XLA), written for the MXU and the mesh:
+- matmuls stay large and batched, activations compute in bfloat16 while
+  params/optimizer stay float32 (classic mixed precision);
+- every weight has an explicit PartitionSpec: attention heads and MLP
+  hidden shard over ``tp``, batch over ``dp``, sequence over ``sp``
+  (Megatron-style sequence parallelism on the norm/MLP path — XLA inserts
+  the gathers around attention);
+- blocks are ``jax.checkpoint``-wrapped so long-context activations
+  rematerialise instead of living in HBM;
+- static shapes and a Python-unrolled layer loop: everything under jit
+  traces once.
+
+The reference is a serverless runtime with no models; this is the
+framework's own flagship workload (SURVEY §5.7: the deliverable substrate
+must carry DP/TP/SP strategies), exercised by __graft_entry__ and bench.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
+    keys = jax.random.split(key, cfg.n_layers + 2)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, cfg.param_dtype)
+                / np.sqrt(fan_in))
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        bk = jax.random.split(keys[i], 4)
+        blocks.append({
+            "ln1": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "wqkv": dense(bk[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim),
+                          cfg.d_model),
+            "wo": dense(bk[1], (cfg.n_heads, cfg.head_dim, cfg.d_model),
+                        cfg.d_model),
+            "ln2": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "w1": dense(bk[2], (cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w2": dense(bk[3], (cfg.d_ff, cfg.d_model), cfg.d_ff),
+        })
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "blocks": blocks,
+        "ln_f": jnp.ones((cfg.d_model,), cfg.param_dtype),
+        "lm_head": dense(keys[-1], (cfg.d_model, cfg.vocab_size), cfg.d_model),
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
+    """PartitionSpecs per weight: heads/hidden over tp, vocab over tp for
+    the embedding table halves (keeps the biggest tables sharded)."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    block = {
+        "ln1": ns(),
+        "wqkv": ns(None, None, "tp", None),
+        "wo": ns("tp", None, None),
+        "ln2": ns(),
+        "w1": ns(None, "tp"),
+        "w2": ns("tp", None),
+    }
+    return {
+        "embed": ns("tp", None),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+        "ln_f": ns(),
+        "lm_head": ns(None, "tp"),
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    return jax.device_put(params, param_shardings(mesh, cfg))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings over the head dim: x (B, S, H, D)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None, None].astype(jnp.float32) \
+        * freqs[None, None, None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal attention, (B, S, H, D); fp32 softmax accumulators."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x: jax.Array, blk: dict, positions: jax.Array,
+           cfg: ModelConfig) -> jax.Array:
+    h = _rms_norm(x, blk["ln1"])
+    qkv = jnp.einsum("bsd,dthe->tbshe", h,
+                     blk["wqkv"].astype(cfg.compute_dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v)
+    x = x + jnp.einsum("bshe,hed->bsd", attn,
+                       blk["wo"].astype(cfg.compute_dtype))
+
+    h = _rms_norm(x, blk["ln2"])
+    ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
+    return x + ff @ blk["w2"].astype(cfg.compute_dtype)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V)."""
+    def maybe_constrain(x, *spec):
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+        return x
+
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    x = params["embed"].astype(cfg.compute_dtype)[tokens]
+    x = maybe_constrain(x, "dp", "sp", None)
+
+    block_fn = _block
+    if cfg.remat:
+        block_fn = jax.checkpoint(_block, static_argnums=(3,))
+    for blk in params["blocks"]:
+        x = block_fn(x, blk, positions, cfg)
+        x = maybe_constrain(x, "dp", "sp", None)
+
+    x = _rms_norm(x, params["ln_f"])
+    logits = x @ params["lm_head"].astype(cfg.compute_dtype)
+    return maybe_constrain(logits.astype(jnp.float32), "dp", "sp", None)
+
+
+def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
+            cfg: ModelConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    logits = forward(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -30,18 +30,14 @@ from typing import Optional
 import numpy as np
 
 from faabric_tpu.mpi.types import (
-    MpiDataType,
     MpiMessageType,
     MpiOp,
     MpiStatus,
     apply_op,
     mpi_dtype_for,
-    np_dtype_for,
     pack_mpi_payload,
     unpack_mpi_payload,
 )
-from faabric_tpu.proto import BatchExecuteRequest, Message
-from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)

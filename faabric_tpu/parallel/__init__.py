@@ -7,3 +7,15 @@ from faabric_tpu.parallel.collectives import (
 )
 
 __all__ = ["DeviceCollectives", "local_devices_for_ids"]
+
+from faabric_tpu.parallel.mesh import (  # noqa: E402
+    MeshConfig,
+    build_mesh,
+    constraint,
+    mesh_from_group,
+    named,
+    replicated,
+)
+
+__all__ += ["MeshConfig", "build_mesh", "constraint", "mesh_from_group",
+            "named", "replicated"]

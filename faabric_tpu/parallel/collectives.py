@@ -34,8 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # JAX >= 0.4.35 exports shard_map at the top level
     from jax import shard_map  # type: ignore[attr-defined]
+
+    _SHARD_MAP_NO_CHECK_KW = "check_vma"
 except ImportError:  # pragma: no cover — older JAX
     from jax.experimental.shard_map import shard_map  # type: ignore
+
+    _SHARD_MAP_NO_CHECK_KW = "check_rep"
 
 from faabric_tpu.mpi.types import MpiOp
 
@@ -87,8 +91,9 @@ class DeviceCollectives:
         kwargs = {}
         if replicated_out:
             # all_gather/broadcast outputs ARE replicated, but the static
-            # varying-mesh-axes check cannot infer it
-            kwargs["check_vma"] = False
+            # replication check cannot infer it (kwarg name differs by
+            # JAX version: check_vma on current, check_rep on older)
+            kwargs[_SHARD_MAP_NO_CHECK_KW] = False
         return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_spec,
                                  out_specs=out_spec, **kwargs))
 
@@ -202,15 +207,17 @@ class DeviceCollectives:
 
 
 def local_devices_for_ids(device_ids: Sequence[int]) -> list:
-    """Resolve planner-assigned chip ids to jax devices on this host."""
-    devs = {d.id: d for d in jax.local_devices()}
-    out = []
-    for i in device_ids:
-        if i in devs:
-            out.append(devs[i])
-        else:
-            # Fall back round-robin when the host has fewer chips than the
-            # planner believed (e.g. CPU test mesh)
-            all_devs = jax.local_devices()
-            out.append(all_devs[i % len(all_devs)])
+    """Resolve planner-assigned chip ids to jax devices on this host.
+
+    Ids that don't exist locally (e.g. a CPU test mesh whose jax ids
+    differ from the planner's numbering) wrap modulo the local device
+    count — but a mesh needs unique devices, so a wrap that collides
+    raises instead of silently aliasing two ranks onto one chip."""
+    all_devs = jax.local_devices()
+    by_id = {d.id: d for d in all_devs}
+    out = [by_id.get(i, all_devs[i % len(all_devs)]) for i in device_ids]
+    if len({id(d) for d in out}) != len(out):
+        raise ValueError(
+            f"Device ids {list(device_ids)} do not map onto distinct local "
+            f"devices ({len(all_devs)} available)")
     return out

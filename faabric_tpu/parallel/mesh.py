@@ -1,0 +1,93 @@
+"""Device-mesh substrate: axis layout, shardings, gang scheduling glue.
+
+This is where the framework's scheduling layer meets XLA's compilation
+model. A gang-scheduled group (planner decision → device ids) becomes a
+``jax.sharding.Mesh`` whose axes carry the parallelism strategy:
+
+    dp — data parallel (batch)           → gradients allreduce over ICI
+    tp — tensor parallel (heads/hidden)  → activation collectives
+    sp — sequence parallel (long ctx)    → ring attention / all-to-all
+    pp — pipeline parallel (stages)      → ppermute between stages
+    ep — expert parallel (MoE)           → all_to_all token routing
+
+The reference has no mesh concept — its analog is the MPI world's rank↔
+host mapping (src/mpi/MpiWorld.cpp:318-366). Here the mesh IS the
+interconnect topology and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "tp", "sp", "pp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Axis sizes; -1 on dp means 'absorb remaining devices'."""
+
+    dp: int = -1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        fixed = self.tp * self.sp * self.pp * self.ep
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by tp*sp*pp*ep={fixed}")
+        dp = self.dp if self.dp > 0 else n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"dp*tp*sp*pp*ep={dp * fixed} != n_devices={n_devices}")
+        return {"dp": dp, "tp": self.tp, "sp": self.sp, "pp": self.pp,
+                "ep": self.ep}
+
+
+def build_mesh(devices: Optional[Sequence] = None,
+               config: MeshConfig | None = None) -> Mesh:
+    """Lay a (dp, tp, sp, pp, ep) mesh over the devices. Axis order puts tp
+    innermost-adjacent so tensor-parallel collectives ride the shortest ICI
+    hops (the scaling-book recipe: fastest-varying axis ↔ nearest
+    neighbours)."""
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    grid = np.array(devices).reshape(
+        sizes["dp"], sizes["sp"], sizes["pp"], sizes["ep"], sizes["tp"])
+    # Present axes in canonical (dp, tp, sp, pp, ep) name order
+    grid = np.moveaxis(grid, 4, 1)
+    return Mesh(grid, ("dp", "tp", "sp", "pp", "ep"))
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def constraint(x, mesh: Mesh, *spec):
+    """Activation sharding hint inside jit (XLA propagates the rest)."""
+    return jax.lax.with_sharding_constraint(x, named(mesh, *spec))
+
+
+def mesh_from_group(broker, group_id: int, ranks: Sequence[int],
+                    config: MeshConfig | None = None) -> Mesh:
+    """Build a mesh from a gang-scheduled group's chip placement: rank i's
+    planner-assigned device id (carried in the PTP mappings) becomes mesh
+    position i."""
+    from faabric_tpu.parallel.collectives import local_devices_for_ids
+
+    broker.wait_for_mappings(group_id)
+    device_ids = [broker.get_device_for_idx(group_id, r) for r in ranks]
+    devices = local_devices_for_ids(device_ids)
+    return build_mesh(devices, config)

@@ -1,0 +1,22 @@
+"""Device-platform environment handling.
+
+This image's sitecustomize registers the remote-TPU ("axon") PJRT plugin
+and explicitly sets ``jax_platforms="axon,cpu"`` via jax.config — which
+overrides the JAX_PLATFORMS env var. Initialising that backend dials the
+TPU tunnel (minutes-slow, single claimant), so CPU-targeted processes
+(tests, dryruns, benches) must re-assert the env var's choice explicitly
+before touching a device.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    """Honor JAX_PLATFORMS=cpu even when an explicit jax.config override
+    (e.g. from sitecustomize) would win over the env var."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").split(","):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
