@@ -55,6 +55,21 @@ class ExecutorTask:
         self.req = req
 
 
+def _merge_dirty_flags(acc, new):
+    """OR page-flag arrays that may differ in length (memory grown
+    mid-batch: unseen pages count as dirty for the thread that grew)."""
+    import numpy as np
+
+    if acc is None:
+        return new
+    if acc.size == new.size:
+        return acc | new
+    n, m = max(acc.size, new.size), min(acc.size, new.size)
+    out = np.ones(n, dtype=bool)  # grown pages are dirty by definition
+    out[:m] = acc[:m] | new[:m]
+    return out
+
+
 class Executor:
     """Base executor; subclasses implement ``execute_task`` and the memory
     hooks."""
@@ -87,6 +102,11 @@ class Executor:
         # results.
         self.scheduler: Optional["Scheduler"] = None
 
+        # THREADS batch snapshot state (set per batch in execute_tasks)
+        self._batch_snapshot_key = ""
+        self._batch_tracker = None
+        self._batch_dirty = None  # accumulated dirty page flags (OR)
+
     # ------------------------------------------------------------------
     # Virtual hooks (reference Executor.h:60-104)
     # ------------------------------------------------------------------
@@ -98,7 +118,17 @@ class Executor:
         """Return the executor to a clean state between batches."""
 
     def restore(self, snapshot_key: str) -> None:
-        """Map a snapshot onto this executor's memory (THREADS batches)."""
+        """Map a snapshot onto this executor's memory (THREADS batches).
+        Default: fetch from the host's registry, size memory, copy in
+        (reference Executor.cpp:640-654)."""
+        registry = getattr(self.scheduler, "snapshot_registry", None)
+        if registry is None:
+            return
+        snap = registry.get_snapshot(snapshot_key)
+        self.set_memory_size(snap.size)
+        mem = self.get_memory_view()
+        if mem is not None:
+            snap.map_to_memory(mem)
 
     def get_memory_view(self) -> Optional[memoryview]:
         return None
@@ -138,10 +168,20 @@ class Executor:
         is_threads = req.type == int(BatchExecuteType.THREADS)
 
         # Multi-host THREADS batches restore from the main thread's snapshot
-        # before any task runs (reference Executor.cpp:137-160). The
-        # snapshot layer provides restore(); single-host batches skip this.
+        # before any task runs and start dirty tracking so each thread's
+        # writes can merge back as diffs (reference Executor.cpp:137-160).
+        self._batch_snapshot_key = ""
+        self._batch_tracker = None
+        self._batch_dirty = None
         if is_threads and not req.single_host and req.snapshot_key:
             self.restore(req.snapshot_key)
+            mem = self.get_memory_view()
+            if mem is not None:
+                from faabric_tpu.util.dirty import make_dirty_tracker
+
+                self._batch_snapshot_key = req.snapshot_key
+                self._batch_tracker = make_dirty_tracker()
+                self._batch_tracker.start_tracking(mem)
 
         with self._batch_lock:
             self._tasks_outstanding += len(msg_idxs)
@@ -168,13 +208,26 @@ class Executor:
             task = q.dequeue()
             if task is POOL_SHUTDOWN:
                 return
-            self._run_task(pool_idx, task)
+            try:
+                self._run_task(pool_idx, task)
+            except Exception:  # noqa: BLE001 — a reporting failure must not
+                # kill the pool thread; the task's own errors are already
+                # folded into its result inside _run_task
+                logger.exception("%s result handling failed for task %d",
+                                 self.id, task.msg_idx)
 
     def _run_task(self, pool_idx: int, task: ExecutorTask) -> None:
         req = task.req
         msg = req.messages[task.msg_idx]
         is_threads = req.type == int(BatchExecuteType.THREADS)
         msg.executed_host = self.scheduler.host if self.scheduler else ""
+
+        # Thread-local dirty tracking brackets the task so each thread
+        # reports only its own writes (reference Executor.cpp:464-476)
+        tracker = self._batch_tracker
+        mem = self.get_memory_view() if tracker is not None else None
+        if tracker is not None and mem is not None:
+            tracker.start_thread_local_tracking(mem)
 
         ExecutorContext.set(self, req, task.msg_idx)
         try:
@@ -196,16 +249,41 @@ class Executor:
         msg.finish_timestamp = time.time()
         self.last_exec = time.monotonic()
 
+        # Each thread contributes its dirty pages BEFORE the outstanding
+        # count drops: the decrement elects the last thread, and that
+        # thread must see every earlier thread's pages when it computes the
+        # batch diff (reference Executor.cpp:684-737 mergeDirtyRegions).
+        if is_threads and tracker is not None and mem is not None:
+            tracker.stop_thread_local_tracking(mem)
+            dirty = tracker.get_thread_local_dirty_pages(mem)
+            with self._batch_lock:
+                self._batch_dirty = _merge_dirty_flags(self._batch_dirty,
+                                                       dirty)
+
         with self._batch_lock:
             self._tasks_outstanding -= 1
             last_in_batch = self._tasks_outstanding == 0
 
-        # Report the result. THREADS results go through the thread-result
-        # path (snapshot diffs ride along once the snapshot layer is in);
-        # everything else reports to the planner.
+        # Report the result. THREADS results carry the batch's snapshot
+        # diffs back to the main host (computed once, by the last task);
+        # everything else reports straight to the planner.
         if self.scheduler is not None:
             if is_threads:
-                self.scheduler.set_thread_result(msg, ret)
+                diffs = None
+                if last_in_batch and mem is not None:
+                    registry = getattr(self.scheduler,
+                                       "snapshot_registry", None)
+                    if registry is not None and self._batch_snapshot_key:
+                        snap = registry.try_get_snapshot(
+                            self._batch_snapshot_key)
+                        if snap is not None:
+                            with self._batch_lock:
+                                batch_dirty = self._batch_dirty
+                            if batch_dirty is not None:
+                                diffs = snap.diff_with_dirty_regions(
+                                    mem, batch_dirty)
+                self.scheduler.report_thread_result(
+                    msg, ret, self._batch_snapshot_key, diffs)
             else:
                 self.scheduler.report_message_result(msg)
 

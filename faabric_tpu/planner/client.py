@@ -82,6 +82,10 @@ class PlannerClient(MessageEndpointClient):
         self.this_host = this_host
         self._keep_alive: Optional[KeepAliveThread] = None
 
+        # Set by the WorkerRuntime; used to push main-thread snapshots to
+        # the planner ahead of THREADS batches
+        self.snapshot_registry = None
+
         # Local result promises: msg_id → Event; results land either via the
         # planner's push to our FunctionCallServer or via a direct response.
         # The cache is bounded (oldest-first) — a long-lived worker must not
@@ -130,14 +134,29 @@ class PlannerClient(MessageEndpointClient):
                 _mock_batch_calls.append(req)
             return SchedulingDecision(req.app_id, req.group_id)
 
-        # THREADS batches set the main host and snapshot key before the
-        # planner sees them (reference PlannerClient.cpp:283-370); the
-        # actual snapshot push is wired by the snapshot layer.
+        # THREADS batches set the main host and push the main-thread
+        # snapshot to the planner once per key (reference
+        # PlannerClient.cpp:283-370 and its pushedSnapshots cache).
         if req.type == int(BatchExecuteType.THREADS) and req.messages:
             for m in req.messages:
                 m.main_host = self.this_host
             if not req.snapshot_key:
                 req.snapshot_key = get_main_thread_snapshot_key(req.messages[0])
+            if self.snapshot_registry is not None:
+                snap = self.snapshot_registry.try_get_snapshot(req.snapshot_key)
+                if snap is not None:
+                    # Always push the full current image: a repeated batch
+                    # on the same key must not leave the planner holding a
+                    # stale pre-merge copy. (The reference optimises the
+                    # repeat case with pushSnapshotUpdate diffs — a future
+                    # optimisation here; correctness first.)
+                    from faabric_tpu.snapshot.remote import SnapshotClient
+
+                    client = SnapshotClient(self.host)
+                    try:
+                        client.push_snapshot(req.snapshot_key, snap)
+                    finally:
+                        client.close()
 
         header, tail = ber_to_wire(req)
         resp = self.sync_send(int(PlannerCalls.CALL_BATCH), {"ber": header}, tail)

@@ -115,6 +115,13 @@ class Planner:
         self._clients: dict[str, "object"] = {}
         self._clients_lock = threading.Lock()
 
+        # Snapshots parked on the planner for THREADS distribution and
+        # frozen apps (reference planner-held SnapshotRegistry)
+        from faabric_tpu.snapshot.registry import SnapshotRegistry
+
+        self.snapshot_registry = SnapshotRegistry()
+        self._snapshot_clients: dict[str, "object"] = {}
+
     # ------------------------------------------------------------------
     # Host membership (reference Planner.cpp:267-392)
     # ------------------------------------------------------------------
@@ -426,20 +433,59 @@ class Planner:
         for ip, sub in dispatches:
             is_threads = sub.type == int(BatchExecuteType.THREADS)
             if is_threads and not sub.single_host:
-                self._push_snapshot_for_threads(sub, ip)
+                if not self._push_snapshot_for_threads(sub, ip):
+                    # Dispatching without the snapshot would hang the batch
+                    # in restore(); fail the messages so waiters unblock
+                    self._fail_dispatch(sub, ip, b"Snapshot push failed")
+                    continue
             try:
                 self._get_client(ip).execute_functions(sub)
             except Exception:  # noqa: BLE001 — a dead host must not stall others
                 logger.exception("Dispatch of app %d to %s failed",
                                  sub.app_id, ip)
+                self._fail_dispatch(sub, ip, b"Dispatch failed")
                 continue
             logger.debug("Dispatched %d msgs of app %d to %s",
                          sub.n_messages(), sub.app_id, ip)
 
+    def _fail_dispatch(self, sub: BatchExecuteRequest, ip: str,
+                       reason: bytes) -> None:
+        logger.warning("Failing %d msgs of app %d for %s: %s",
+                       sub.n_messages(), sub.app_id, ip, reason.decode())
+        for m in sub.messages:
+            m.return_value = int(ReturnValue.FAILED)
+            m.output_data = reason
+            self.set_message_result(m)
+
     def _push_snapshot_for_threads(self, req: BatchExecuteRequest,
-                                   host: str) -> None:
+                                   host: str) -> bool:
         """Push the main-thread snapshot ahead of remote THREADS dispatch
-        (reference Planner.cpp:1334-1360); wired by the snapshot layer."""
+        (reference Planner.cpp:1334-1360). Returns False when the target
+        host cannot be given the snapshot it needs to restore."""
+        key = req.snapshot_key
+        if not key:
+            return True  # nothing to restore from
+        main_host = req.messages[0].main_host if req.messages else ""
+        if host == main_host:
+            return True  # the main host already owns the snapshot
+        snap = self.snapshot_registry.try_get_snapshot(key)
+        if snap is None:
+            logger.warning("No snapshot %s on planner for THREADS dispatch",
+                           key)
+            return False
+        from faabric_tpu.snapshot.remote import SnapshotClient
+
+        with self._clients_lock:
+            client = self._snapshot_clients.get(host)
+            if client is None:
+                client = SnapshotClient(host)
+                self._snapshot_clients[host] = client
+        try:
+            client.push_snapshot(key, snap)
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception("Failed pushing snapshot %s to %s", key, host)
+            return False
 
     def _send_mappings(self, decision: SchedulingDecision) -> None:
         """Distribute group mappings to every involved host's PTP server

@@ -39,6 +39,9 @@ class PlannerCalls(enum.IntEnum):
 
 
 class PlannerServer(MessageEndpointServer):
+    """Planner RPC + the planner's SnapshotServer (the reference
+    planner_server binary runs both, src/planner/planner_server.cpp:9-43)."""
+
     def __init__(self, port_offset: int = 0, n_threads: int = 4) -> None:
         super().__init__(
             PLANNER_ASYNC_PORT + port_offset,
@@ -47,6 +50,20 @@ class PlannerServer(MessageEndpointServer):
             n_threads=n_threads,
         )
         self.planner = get_planner()
+
+        from faabric_tpu.snapshot.remote import SnapshotServer
+
+        self.snapshot_server = SnapshotServer(
+            self.planner.snapshot_registry, host="planner",
+            port_offset=port_offset)
+
+    def start(self) -> None:
+        super().start()
+        self.snapshot_server.start()
+
+    def stop(self) -> None:
+        self.snapshot_server.stop()
+        super().stop()
 
     # ------------------------------------------------------------------
     def do_async_recv(self, msg: TransportMessage) -> None:

@@ -54,8 +54,20 @@ class WorkerRuntime:
                                              self.planner_client)
         self.scheduler.mpi_registry = self.mpi_registry
 
-        # Started by later layers: snapshot server, state server
-        self.extra_servers: list = [PointToPointServer(self.ptp_broker)]
+        # Snapshots (reference FaabricMain starts a SnapshotServer)
+        from faabric_tpu.snapshot.registry import SnapshotRegistry
+        from faabric_tpu.snapshot.remote import SnapshotServer
+
+        self.snapshot_registry = SnapshotRegistry()
+        self.scheduler.snapshot_registry = self.snapshot_registry
+        self.planner_client.snapshot_registry = self.snapshot_registry
+
+        # Started by later layers: state server
+        self.extra_servers: list = [
+            PointToPointServer(self.ptp_broker),
+            SnapshotServer(self.snapshot_registry, self.host,
+                           scheduler=self.scheduler),
+        ]
 
         self._started = False
 

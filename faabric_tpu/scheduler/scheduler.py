@@ -61,9 +61,13 @@ class Scheduler:
         self._reaper = ReaperThread(self)
         self._started = False
 
-        # Set by the WorkerRuntime: this host's PTP broker, reachable from
-        # guest code via ExecutorContext → executor → scheduler
+        # Set by the WorkerRuntime: this host's PTP broker / MPI registry /
+        # snapshot registry, reachable from guest code via
+        # ExecutorContext → executor → scheduler
         self.ptp_broker = None
+        self.mpi_registry = None
+        self.snapshot_registry = None
+        self._snapshot_clients: dict[str, object] = {}
 
         # Thread results cache for THREADS batches (msg id → (ret, msg))
         self._thread_results: dict[int, tuple[int, Message]] = {}
@@ -201,14 +205,49 @@ class Scheduler:
     def report_message_result(self, msg: Message) -> None:
         self.planner_client.set_message_result(msg)
 
-    def set_thread_result(self, msg: Message, return_value: int) -> None:
-        """THREADS results stay host-local until the batch's diffs merge
-        (reference setThreadResultLocally); the planner still learns the
-        message result so waiters unblock."""
+    def set_thread_result_locally(self, msg: Message,
+                                  return_value: int) -> None:
+        """Cache a thread result on this host and wake waiters (reference
+        setThreadResultLocally; also invoked by the SnapshotServer when a
+        remote thread's result arrives)."""
         with self._thread_result_cv:
             self._thread_results[msg.id] = (return_value, msg)
             self._thread_result_cv.notify_all()
+
+    def report_thread_result(self, msg: Message, return_value: int,
+                             snapshot_key: str = "",
+                             diffs=None) -> None:
+        """THREADS result: diffs queue on the main host's snapshot — local
+        queue when we are the main host, SnapshotClient push otherwise
+        (reference Executor::setThreadResult :271-305). The planner still
+        learns the message result so slots release and waiters unblock."""
+        main_host = msg.main_host or self.host
+        if main_host == self.host:
+            self.set_thread_result_locally(msg, return_value)
+            if diffs and snapshot_key and self.snapshot_registry is not None:
+                snap = self.snapshot_registry.try_get_snapshot(snapshot_key)
+                if snap is not None:
+                    snap.queue_diffs(diffs)
+        else:
+            try:
+                client = self._get_snapshot_client(main_host)
+                client.push_thread_result(msg.app_id, msg.id, return_value,
+                                          snapshot_key, diffs or [])
+            except Exception:  # noqa: BLE001 — the planner must still learn
+                # the result even if the main host is unreachable
+                logger.exception(
+                    "Failed pushing thread result %d to %s", msg.id, main_host)
         self.planner_client.set_message_result(msg)
+
+    def _get_snapshot_client(self, host: str):
+        from faabric_tpu.snapshot.remote import SnapshotClient
+
+        with self._lock:
+            client = self._snapshot_clients.get(host)
+            if client is None:
+                client = SnapshotClient(host)
+                self._snapshot_clients[host] = client
+            return client
 
     def await_thread_result(self, msg_id: int, timeout: float | None = None) -> int:
         conf = get_system_config()

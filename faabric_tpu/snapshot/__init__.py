@@ -1,0 +1,38 @@
+"""Snapshots: typed merge regions, dirty diffs, registry, RPC
+(reference src/snapshot + src/util/snapshot.cpp)."""
+
+from faabric_tpu.snapshot.snapshot import (
+    DIFF_CHUNK,
+    MergeRegion,
+    SnapshotData,
+    SnapshotDataType,
+    SnapshotDiff,
+    SnapshotMergeOperation,
+)
+from faabric_tpu.snapshot.registry import SnapshotRegistry
+from faabric_tpu.snapshot.remote import (
+    SnapshotCalls,
+    SnapshotClient,
+    SnapshotServer,
+    clear_mock_snapshot_requests,
+    get_mock_thread_results,
+    get_snapshot_diff_pushes,
+    get_snapshot_pushes,
+)
+
+__all__ = [
+    "DIFF_CHUNK",
+    "MergeRegion",
+    "SnapshotCalls",
+    "SnapshotClient",
+    "SnapshotData",
+    "SnapshotDataType",
+    "SnapshotDiff",
+    "SnapshotMergeOperation",
+    "SnapshotRegistry",
+    "SnapshotServer",
+    "clear_mock_snapshot_requests",
+    "get_mock_thread_results",
+    "get_snapshot_diff_pushes",
+    "get_snapshot_pushes",
+]

@@ -1,0 +1,120 @@
+"""Standalone snapshot delta encoding.
+
+Reference analog: include/faabric/util/delta.h:10-52 and
+src/util/delta.cpp (272 lines): page-granular compare, optional
+XOR-with-old, optional compression, command-stream format. The reference
+uses zstd; zlib is what this image bakes in, and the config string keeps
+the same shape (``pages=4096;xor;zlib=1``).
+
+Commands: TOTAL_SIZE, ZLIB_COMPRESSED_COMMANDS, DELTA_OVERWRITE,
+DELTA_XOR, END — one byte each, lengths/offsets u64 little-endian.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+
+import numpy as np
+
+CMD_TOTAL_SIZE = 1
+CMD_ZLIB_COMMANDS = 2
+CMD_DELTA_OVERWRITE = 3
+CMD_DELTA_XOR = 4
+CMD_END = 5
+
+
+@dataclasses.dataclass
+class DeltaSettings:
+    """Parsed from e.g. "pages=4096;xor;zlib=1"."""
+
+    page_size: int = 4096
+    use_xor: bool = False
+    zlib_level: int = 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "DeltaSettings":
+        out = cls()
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            if part.startswith("pages="):
+                out.page_size = int(part.split("=", 1)[1])
+            elif part == "xor":
+                out.use_xor = True
+            elif part.startswith("zlib="):
+                out.zlib_level = int(part.split("=", 1)[1])
+        return out
+
+
+def serialize_delta(settings: DeltaSettings, old: bytes, new: bytes) -> bytes:
+    """Encode new relative to old."""
+    old_arr = np.frombuffer(old, dtype=np.uint8)
+    new_arr = np.frombuffer(new, dtype=np.uint8)
+    ps = settings.page_size
+
+    body = bytearray()
+    n = len(new)
+    for off in range(0, n, ps):
+        end = min(off + ps, n)
+        new_page = new_arr[off:end]
+        old_page = old_arr[off:min(end, old_arr.size)]
+        if old_page.size == new_page.size and np.array_equal(old_page, new_page):
+            continue
+        if settings.use_xor and old_page.size == new_page.size:
+            payload = np.bitwise_xor(new_page, old_page).tobytes()
+            cmd = CMD_DELTA_XOR
+        else:
+            payload = new_page.tobytes()
+            cmd = CMD_DELTA_OVERWRITE
+        body += struct.pack("<BQQ", cmd, off, len(payload))
+        body += payload
+    body += struct.pack("<B", CMD_END)
+
+    out = bytearray()
+    out += struct.pack("<BQ", CMD_TOTAL_SIZE, n)
+    if settings.zlib_level > 0:
+        compressed = zlib.compress(bytes(body), settings.zlib_level)
+        out += struct.pack("<BQ", CMD_ZLIB_COMMANDS, len(compressed))
+        out += compressed
+    else:
+        out += body
+    return bytes(out)
+
+
+def apply_delta(delta: bytes, old: bytes) -> bytes:
+    """Reconstruct new from old + delta."""
+    pos = 0
+    cmd, total = struct.unpack_from("<BQ", delta, pos)
+    if cmd != CMD_TOTAL_SIZE:
+        raise ValueError("Delta stream must start with TOTAL_SIZE")
+    pos += struct.calcsize("<BQ")
+
+    cmd = delta[pos]
+    if cmd == CMD_ZLIB_COMMANDS:
+        (_, comp_len) = struct.unpack_from("<BQ", delta, pos)
+        pos += struct.calcsize("<BQ")
+        body = zlib.decompress(delta[pos:pos + comp_len])
+    else:
+        body = delta[pos:]
+
+    out = np.zeros(total, dtype=np.uint8)
+    old_arr = np.frombuffer(old, dtype=np.uint8)
+    out[:min(total, old_arr.size)] = old_arr[:min(total, old_arr.size)]
+
+    pos = 0
+    while True:
+        cmd = body[pos]
+        if cmd == CMD_END:
+            break
+        _, off, length = struct.unpack_from("<BQQ", body, pos)
+        pos += struct.calcsize("<BQQ")
+        payload = np.frombuffer(body[pos:pos + length], dtype=np.uint8)
+        pos += length
+        if cmd == CMD_DELTA_OVERWRITE:
+            out[off:off + length] = payload
+        elif cmd == CMD_DELTA_XOR:
+            out[off:off + length] = np.bitwise_xor(out[off:off + length],
+                                                   payload)
+        else:
+            raise ValueError(f"Unknown delta command {cmd}")
+    return out.tobytes()

@@ -1,0 +1,203 @@
+"""Dirty-page tracking over executor memory.
+
+Reference analog: include/faabric/util/dirty.h:24-52 and
+src/util/dirty.cpp (917 lines) — there mprotect/SIGSEGV, soft-dirty PTEs
+or userfaultfd over mmap'd guest memory. Executor memory here is host
+numpy buffers (HBM state is snapshotted via device→host transfer), so
+tracking is comparison-based:
+
+- ``compare``: keep a baseline copy, vectorised page compare (numpy).
+- ``native``: same baseline, memcmp per page in C++ (util/native.py).
+- ``hash``: per-page crc32 baseline — half the memory of a full copy,
+  per-page Python loop on stop (fine for MiB-scale executors).
+- ``none``: every page reported dirty (the reference's fallback).
+
+Same interface as the reference: global + thread-local start/stop, page
+flags out. Thread-local tracking lets each executor thread report only
+ITS writes (reference threadLocalDirtyRegions).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+PAGE_SIZE = 4096
+
+
+def n_pages(size: int) -> int:
+    return (size + PAGE_SIZE - 1) // PAGE_SIZE
+
+
+def _as_array(mem) -> np.ndarray:
+    return np.frombuffer(mem, dtype=np.uint8)
+
+
+class DirtyTracker:
+    mode = "base"
+
+    def start_tracking(self, mem) -> None:
+        raise NotImplementedError
+
+    def stop_tracking(self, mem) -> None:
+        pass
+
+    def get_dirty_pages(self, mem) -> np.ndarray:
+        """Bool flags per page since start_tracking."""
+        raise NotImplementedError
+
+    def start_thread_local_tracking(self, mem) -> None:
+        pass
+
+    def stop_thread_local_tracking(self, mem) -> None:
+        pass
+
+    def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
+        return self.get_dirty_pages(mem)
+
+
+class CompareTracker(DirtyTracker):
+    """Baseline copy + vectorised compare."""
+
+    mode = "compare"
+
+    def __init__(self) -> None:
+        self._baseline: Optional[np.ndarray] = None
+        self._tls = threading.local()
+
+    def start_tracking(self, mem) -> None:
+        self._baseline = _as_array(mem).copy()
+
+    def _diff(self, baseline: np.ndarray, mem) -> np.ndarray:
+        cur = _as_array(mem)
+        size = cur.size
+        # Memory may have grown since the baseline was taken: pages beyond
+        # the baseline are dirty by definition
+        flags = np.zeros(n_pages(size), dtype=bool)
+        cmp_size = min(size, baseline.size)
+        cmp_pages = cmp_size // PAGE_SIZE
+        if cmp_pages:
+            flags[:cmp_pages] = (
+                cur[:cmp_pages * PAGE_SIZE].reshape(-1, PAGE_SIZE)
+                != baseline[:cmp_pages * PAGE_SIZE].reshape(-1, PAGE_SIZE)
+            ).any(axis=1)
+        # Trailing partial page plus anything beyond the baseline
+        if cmp_pages * PAGE_SIZE < cmp_size:
+            flags[cmp_pages] = not np.array_equal(
+                cur[cmp_pages * PAGE_SIZE:cmp_size],
+                baseline[cmp_pages * PAGE_SIZE:cmp_size])
+        if size > baseline.size:
+            flags[baseline.size // PAGE_SIZE:] = True
+        return flags
+
+    def get_dirty_pages(self, mem) -> np.ndarray:
+        if self._baseline is None:
+            return np.zeros(0, dtype=bool)
+        return self._diff(self._baseline, mem)
+
+    def start_thread_local_tracking(self, mem) -> None:
+        self._tls.baseline = _as_array(mem).copy()
+
+    def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
+        baseline = getattr(self._tls, "baseline", None)
+        if baseline is None:
+            return np.zeros(0, dtype=bool)
+        return self._diff(baseline, mem)
+
+
+class NativeCompareTracker(CompareTracker):
+    """Baseline copy + C++ memcmp per page; falls back to numpy."""
+
+    mode = "native"
+
+    def _diff(self, baseline: np.ndarray, mem) -> np.ndarray:
+        from faabric_tpu.util.native import get_pagediff_lib
+
+        lib = get_pagediff_lib()
+        cur = _as_array(mem)
+        if lib is None:
+            return super()._diff(baseline, mem)
+        size = min(cur.size, baseline.size)
+        flags = np.zeros(n_pages(size), dtype=np.uint8)
+        cur_c = np.ascontiguousarray(cur[:size])
+        base_c = np.ascontiguousarray(baseline[:size])
+        lib.diff_pages(base_c.ctypes.data, cur_c.ctypes.data, size,
+                       PAGE_SIZE, flags.ctypes.data)
+        return flags.astype(bool)
+
+
+class HashTracker(DirtyTracker):
+    """Per-page crc32 baseline."""
+
+    mode = "hash"
+
+    def __init__(self) -> None:
+        self._hashes: Optional[list[int]] = None
+        self._tls = threading.local()
+
+    @staticmethod
+    def _page_hashes(mem) -> list[int]:
+        arr = _as_array(mem)
+        return [zlib.crc32(arr[i:i + PAGE_SIZE].tobytes())
+                for i in range(0, arr.size, PAGE_SIZE)]
+
+    def start_tracking(self, mem) -> None:
+        self._hashes = self._page_hashes(mem)
+
+    def get_dirty_pages(self, mem) -> np.ndarray:
+        if self._hashes is None:
+            return np.zeros(0, dtype=bool)
+        cur = self._page_hashes(mem)
+        old = self._hashes
+        return np.array([i >= len(old) or cur[i] != old[i]
+                         for i in range(len(cur))], dtype=bool)
+
+    def start_thread_local_tracking(self, mem) -> None:
+        self._tls.hashes = self._page_hashes(mem)
+
+    def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
+        old = getattr(self._tls, "hashes", None)
+        if old is None:
+            return np.zeros(0, dtype=bool)
+        cur = self._page_hashes(mem)
+        return np.array([i >= len(old) or cur[i] != old[i]
+                         for i in range(len(cur))], dtype=bool)
+
+
+class NoneTracker(DirtyTracker):
+    """Everything dirty (reference dirty.h:194-225)."""
+
+    mode = "none"
+
+    def __init__(self) -> None:
+        self._size = 0
+
+    def start_tracking(self, mem) -> None:
+        self._size = _as_array(mem).size
+
+    def get_dirty_pages(self, mem) -> np.ndarray:
+        return np.ones(n_pages(_as_array(mem).size), dtype=bool)
+
+
+_TRACKERS = {
+    "compare": CompareTracker,
+    "native": NativeCompareTracker,
+    "hash": HashTracker,
+    "none": NoneTracker,
+}
+
+
+def make_dirty_tracker(mode: str | None = None) -> DirtyTracker:
+    mode = mode or get_system_config().dirty_tracking_mode
+    cls = _TRACKERS.get(mode)
+    if cls is None:
+        raise ValueError(f"Unknown dirty tracking mode: {mode}")
+    return cls()

@@ -1,0 +1,80 @@
+"""Loader for the native C++ helpers (native/pagediff.cpp).
+
+Compiles the shared library on first use (g++ is baked into the image;
+pybind11 is not, so the binding is ctypes over an extern-C surface) and
+caches it next to the source. Falls back cleanly: callers check
+``get_pagediff_lib() is not None`` and use the numpy path otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "pagediff.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "build", "libpagediff.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("Native pagediff build failed (%s); using numpy path", e)
+        return False
+
+
+def get_pagediff_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if not os.path.exists(_SO) or (os.path.getmtime(_SO)
+                                       < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            logger.warning("Could not load %s: %s", _SO, e)
+            return None
+        # void* arguments: callers pass numpy buffer addresses
+        lib.diff_pages.restype = ctypes.c_size_t
+        lib.diff_pages.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_size_t, ctypes.c_size_t,
+                                   ctypes.c_void_p]
+        lib.diff_ranges.restype = ctypes.c_size_t
+        lib.diff_ranges.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_size_t, ctypes.c_size_t,
+                                    ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_size_t]
+        lib.xor_buffers.restype = None
+        lib.xor_buffers.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_size_t]
+        _lib = lib
+        return _lib
+
+
+def reset_for_tests() -> None:
+    global _lib, _tried
+    with _lock:
+        _lib = None
+        _tried = False

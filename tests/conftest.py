@@ -24,7 +24,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import itertools  # noqa: E402
+import random  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Port-range allocator for fixtures that stand up aliased hosts: bases are
+# session-monotonic so no two fixtures ever share a range (random bases
+# collided ~1/150 runs). Each fixture may use base .. base+2999.
+_port_bases = itertools.count(random.randint(60, 180) * 100, 3000)
+
+
+def next_port_base() -> int:
+    base = next(_port_bases)
+    # Keep every port (canonical 8003-8012 + offset) within 16-bit range
+    if base + 8012 + 2999 > 65000:
+        globals()["_port_bases"] = itertools.count(6000, 3000)
+        base = next(_port_bases)
+    return base
 
 
 @pytest.fixture(autouse=True)

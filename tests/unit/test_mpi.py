@@ -21,7 +21,9 @@ GROUP_ID = 4242
 @pytest.fixture
 def mpi_cluster():
     """Two logical hosts, 6 ranks split 3+3, live PTP servers."""
-    base = random.randint(100, 500) * 100
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
     register_host_alias("mpiA", "127.0.0.1", base)
     register_host_alias("mpiB", "127.0.0.1", base + 1000)
     brokers = {h: PointToPointBroker(h) for h in ("mpiA", "mpiB")}

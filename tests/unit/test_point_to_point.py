@@ -36,7 +36,9 @@ def make_decision(group_id, placements):
 @pytest.fixture
 def two_host_ptp():
     """Two brokers with live PTP servers on aliased ports."""
-    base = random.randint(100, 500) * 100
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
     register_host_alias("ptpA", "127.0.0.1", base)
     register_host_alias("ptpB", "127.0.0.1", base + 1000)
     brokers = {h: PointToPointBroker(h) for h in ("ptpA", "ptpB")}

@@ -1,0 +1,216 @@
+"""Snapshot stack tests (reference: tests/test/snapshot/, test_dirty.cpp,
+test_delta.cpp)."""
+
+import numpy as np
+import pytest
+
+from faabric_tpu.snapshot import (
+    MergeRegion,
+    SnapshotData,
+    SnapshotDataType,
+    SnapshotDiff,
+    SnapshotMergeOperation,
+    SnapshotRegistry,
+)
+from faabric_tpu.util.delta import DeltaSettings, apply_delta, serialize_delta
+from faabric_tpu.util.dirty import PAGE_SIZE, make_dirty_tracker
+
+
+# ---------------------------------------------------------------------------
+# Dirty tracking
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["compare", "native", "hash", "none"])
+def test_dirty_tracker_modes(mode):
+    mem = np.zeros(PAGE_SIZE * 4 + 100, dtype=np.uint8)
+    tracker = make_dirty_tracker(mode)
+    tracker.start_tracking(mem)
+    mem[10] = 1                     # page 0
+    mem[PAGE_SIZE * 2 + 5] = 2      # page 2
+    mem[PAGE_SIZE * 4 + 50] = 3     # partial page 4
+    flags = tracker.get_dirty_pages(mem)
+    assert flags.size == 5
+    if mode == "none":
+        assert flags.all()
+    else:
+        assert list(np.where(flags)[0]) == [0, 2, 4]
+
+
+@pytest.mark.parametrize("mode", ["compare", "native", "hash"])
+def test_thread_local_tracking_isolated(mode):
+    mem = np.zeros(PAGE_SIZE * 2, dtype=np.uint8)
+    tracker = make_dirty_tracker(mode)
+    tracker.start_tracking(mem)
+    mem[0] = 1
+    # Thread-local baseline taken AFTER the first write
+    tracker.start_thread_local_tracking(mem)
+    mem[PAGE_SIZE] = 2
+    local = tracker.get_thread_local_dirty_pages(mem)
+    assert list(np.where(local)[0]) == [1]
+    global_flags = tracker.get_dirty_pages(mem)
+    assert list(np.where(global_flags)[0]) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Snapshot diffs + merge regions
+# ---------------------------------------------------------------------------
+
+def make_mem(size=PAGE_SIZE * 4):
+    return np.zeros(size, dtype=np.uint8)
+
+
+def all_dirty(mem):
+    return np.ones((mem.size + PAGE_SIZE - 1) // PAGE_SIZE, dtype=bool)
+
+
+def test_bytewise_diff_chunks():
+    mem = make_mem()
+    snap = SnapshotData(mem.tobytes())
+    mem[100:110] = 42
+    mem[PAGE_SIZE + 500] = 7
+    diffs = snap.diff_with_dirty_regions(mem, all_dirty(mem))
+    # Changed byte ranges only, at 128B chunk granularity
+    assert all(d.operation == SnapshotMergeOperation.BYTEWISE for d in diffs)
+    covered = [(d.offset, d.offset + len(d.data)) for d in diffs]
+    assert any(lo <= 100 and hi >= 110 for lo, hi in covered)
+    assert any(lo <= PAGE_SIZE + 500 < hi for lo, hi in covered)
+    total = sum(len(d.data) for d in diffs)
+    assert total <= 3 * 128  # ranges stay chunk-sized, not page-sized
+
+    # Applying the diffs to the snapshot reproduces the memory
+    for d in diffs:
+        snap.apply_diff(d)
+    np.testing.assert_array_equal(snap.data, mem)
+
+
+@pytest.mark.parametrize("dtype,np_dtype,op,a,b,expected", [
+    # Single writer: diff carries the writer's delta, so applying onto the
+    # unchanged original reproduces the writer's value
+    (SnapshotDataType.INT, np.int32, SnapshotMergeOperation.SUM, 10, 25, 25),
+    (SnapshotDataType.INT, np.int32, SnapshotMergeOperation.SUBTRACT, 100, 70, 70),
+    (SnapshotDataType.DOUBLE, np.float64, SnapshotMergeOperation.PRODUCT, 4.0, 8.0, 8.0),
+    (SnapshotDataType.LONG, np.int64, SnapshotMergeOperation.MAX, 50, 90, 90),
+    (SnapshotDataType.LONG, np.int64, SnapshotMergeOperation.MIN, 50, 20, 20),
+])
+def test_arithmetic_merge_ops(dtype, np_dtype, op, a, b, expected):
+    """Diff = f(original, updated); applying onto the original yields the
+    writer's result (reference calculateDiffValue/applyDiffValue)."""
+    mem = make_mem()
+    width = np.dtype(np_dtype).itemsize
+    mem[:width].view(np_dtype)[0] = a
+    snap = SnapshotData(mem.tobytes())
+    snap.add_merge_region(0, width, dtype, op)
+
+    mem[:width].view(np_dtype)[0] = b
+    diffs = snap.diff_with_dirty_regions(mem, all_dirty(mem))
+    assert len(diffs) == 1
+    snap.apply_diff(diffs[0])
+    assert snap.data[:width].view(np_dtype)[0] == expected
+
+
+def test_sum_region_merges_concurrent_writers():
+    """Two writers add to the same counter; both contributions land."""
+    base = make_mem()
+    base[:4].view(np.int32)[0] = 1000
+    snap = SnapshotData(base.tobytes())
+    snap.add_merge_region(0, 4, SnapshotDataType.INT,
+                          SnapshotMergeOperation.SUM)
+
+    mem_a = base.copy()
+    mem_a[:4].view(np.int32)[0] = 1010  # +10
+    mem_b = base.copy()
+    mem_b[:4].view(np.int32)[0] = 1007  # +7
+
+    diffs_a = snap.diff_with_dirty_regions(mem_a, all_dirty(mem_a))
+    diffs_b = snap.diff_with_dirty_regions(mem_b, all_dirty(mem_b))
+    snap.queue_diffs(diffs_a)
+    snap.queue_diffs(diffs_b)
+    assert snap.write_queued_diffs() == 2
+    assert snap.data[:4].view(np.int32)[0] == 1017
+
+
+def test_ignore_and_xor_regions():
+    mem = make_mem()
+    snap = SnapshotData(mem.tobytes())
+    snap.add_merge_region(0, 64, operation=SnapshotMergeOperation.IGNORE)
+    snap.add_merge_region(64, 64, operation=SnapshotMergeOperation.XOR)
+    mem[0:4] = 9     # ignored
+    mem[64:68] = 5   # xor
+    diffs = snap.diff_with_dirty_regions(mem, all_dirty(mem))
+    xor_diffs = [d for d in diffs
+                 if d.operation == SnapshotMergeOperation.XOR]
+    assert len(xor_diffs) == 1
+    assert not any(d.offset < 64 for d in diffs)
+    snap.apply_diff(xor_diffs[0])
+    np.testing.assert_array_equal(snap.data[64:68],
+                                  np.full(4, 5, dtype=np.uint8))
+
+
+def test_fill_gaps_with_bytewise_regions():
+    snap = SnapshotData(1024)
+    snap.add_merge_region(100, 48, SnapshotDataType.INT,
+                          SnapshotMergeOperation.SUM)
+    with pytest.raises(ValueError):
+        snap.add_merge_region(0, 3, SnapshotDataType.INT,
+                              SnapshotMergeOperation.SUM)
+    snap.fill_gaps_with_bytewise_regions()
+    regions = snap.get_merge_regions()
+    covered = sorted((r.offset, r.end) for r in regions)
+    assert covered[0][0] == 0
+    assert covered[-1][1] == 1024
+    # No gaps
+    for (a, b), (c, d) in zip(covered, covered[1:]):
+        assert b >= c
+
+
+def test_map_to_memory_restore():
+    content = np.random.RandomState(0).randint(
+        0, 255, PAGE_SIZE, dtype=np.uint8)
+    snap = SnapshotData(content.tobytes())
+    target = np.full(PAGE_SIZE * 2, 0xFF, dtype=np.uint8)
+    snap.map_to_memory(target)
+    np.testing.assert_array_equal(target[:PAGE_SIZE], content)
+    assert (target[PAGE_SIZE:] == 0).all()
+
+
+def test_registry():
+    reg = SnapshotRegistry()
+    snap = SnapshotData(64)
+    reg.register_snapshot("k", snap)
+    assert reg.snapshot_exists("k")
+    assert reg.get_snapshot("k") is snap
+    assert reg.get_snapshot_count() == 1
+    reg.delete_snapshot("k")
+    with pytest.raises(KeyError):
+        reg.get_snapshot("k")
+    with pytest.raises(ValueError):
+        reg.register_snapshot("", snap)
+
+
+# ---------------------------------------------------------------------------
+# Delta encoding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", ["pages=4096", "pages=4096;xor",
+                                  "pages=4096;xor;zlib=6",
+                                  "pages=1024;zlib=1"])
+def test_delta_roundtrip(spec):
+    rng = np.random.RandomState(1)
+    old = rng.randint(0, 255, 3 * PAGE_SIZE + 77, dtype=np.uint8)
+    new = old.copy()
+    new[100:200] = 1
+    new[PAGE_SIZE * 2:PAGE_SIZE * 2 + 50] = 2
+    settings = DeltaSettings.parse(spec)
+    delta = serialize_delta(settings, old.tobytes(), new.tobytes())
+    out = apply_delta(delta, old.tobytes())
+    assert out == new.tobytes()
+    # Unchanged pages are never encoded
+    assert len(delta) < new.size
+
+
+def test_delta_grows_and_shrinks():
+    old = np.zeros(PAGE_SIZE, dtype=np.uint8)
+    new = np.ones(PAGE_SIZE * 2, dtype=np.uint8)
+    settings = DeltaSettings.parse("pages=4096;zlib=1")
+    delta = serialize_delta(settings, old.tobytes(), new.tobytes())
+    assert apply_delta(delta, old.tobytes()) == new.tobytes()
