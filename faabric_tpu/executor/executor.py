@@ -280,6 +280,9 @@ class Executor:
                             with self._batch_lock:
                                 batch_dirty = self._batch_dirty
                             if batch_dirty is not None:
+                                # Writes outside declared merge regions must
+                                # not vanish (reference Executor.cpp:713)
+                                snap.fill_gaps_with_bytewise_regions()
                                 diffs = snap.diff_with_dirty_regions(
                                     mem, batch_dirty)
                 self.scheduler.report_thread_result(

@@ -85,6 +85,7 @@ class PlannerClient(MessageEndpointClient):
         # Set by the WorkerRuntime; used to push main-thread snapshots to
         # the planner ahead of THREADS batches
         self.snapshot_registry = None
+        self._planner_snapshot_client = None
 
         # Local result promises: msg_id → Event; results land either via the
         # planner's push to our FunctionCallServer or via a direct response.
@@ -152,11 +153,10 @@ class PlannerClient(MessageEndpointClient):
                     # optimisation here; correctness first.)
                     from faabric_tpu.snapshot.remote import SnapshotClient
 
-                    client = SnapshotClient(self.host)
-                    try:
-                        client.push_snapshot(req.snapshot_key, snap)
-                    finally:
-                        client.close()
+                    if self._planner_snapshot_client is None:
+                        self._planner_snapshot_client = SnapshotClient(self.host)
+                    self._planner_snapshot_client.push_snapshot(
+                        req.snapshot_key, snap)
 
         header, tail = ber_to_wire(req)
         resp = self.sync_send(int(PlannerCalls.CALL_BATCH), {"ber": header}, tail)
@@ -239,6 +239,16 @@ class PlannerClient(MessageEndpointClient):
                               idempotent=True)
         return int(resp.header["num_migrations"])
 
+    def claim_state_master(self, user: str, key: str) -> str:
+        resp = self.sync_send(int(PlannerCalls.CLAIM_STATE_MASTER), {
+            "user": user, "key": key, "host": self.this_host,
+        }, idempotent=True)
+        return resp.header["master"]
+
+    def drop_state_master(self, user: str, key: str) -> None:
+        self.sync_send(int(PlannerCalls.DROP_STATE_MASTER),
+                       {"user": user, "key": key}, idempotent=True)
+
     def preload_scheduling_decision(self, decision: SchedulingDecision) -> None:
         self.sync_send(int(PlannerCalls.PRELOAD_SCHEDULING_DECISION),
                        {"decision": decision.to_dict()}, idempotent=True)
@@ -254,4 +264,7 @@ class PlannerClient(MessageEndpointClient):
         if self._keep_alive is not None:
             self._keep_alive.stop()
             self._keep_alive = None
+        if self._planner_snapshot_client is not None:
+            self._planner_snapshot_client.close()
+            self._planner_snapshot_client = None
         super().close()

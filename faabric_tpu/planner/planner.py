@@ -112,15 +112,25 @@ class Planner:
         # ones — and all hosts ever involved) for group cleanup
         self._group_hosts: dict[int, tuple[set[int], set[str]]] = {}
         self._num_migrations = 0
-        self._clients: dict[str, "object"] = {}
-        self._clients_lock = threading.Lock()
+
+        from faabric_tpu.scheduler.function_call import FunctionCallClient
+        from faabric_tpu.transport.client_pool import ClientPool
+
+        self._clients = ClientPool(FunctionCallClient)
 
         # Snapshots parked on the planner for THREADS distribution and
         # frozen apps (reference planner-held SnapshotRegistry)
         from faabric_tpu.snapshot.registry import SnapshotRegistry
+        from faabric_tpu.snapshot.remote import SnapshotClient
 
         self.snapshot_registry = SnapshotRegistry()
-        self._snapshot_clients: dict[str, "object"] = {}
+        self._snapshot_clients = ClientPool(SnapshotClient)
+
+        # State-KV master election: "user/key" → owning host. The
+        # reference elects masters through Redis (InMemoryStateRegistry
+        # getMasterIP(claim)); here the planner IS the cluster metadata
+        # service, so a claim is one RPC with no external dependency.
+        self._state_masters: dict[str, str] = {}
 
     # ------------------------------------------------------------------
     # Host membership (reference Planner.cpp:267-392)
@@ -473,15 +483,8 @@ class Planner:
             logger.warning("No snapshot %s on planner for THREADS dispatch",
                            key)
             return False
-        from faabric_tpu.snapshot.remote import SnapshotClient
-
-        with self._clients_lock:
-            client = self._snapshot_clients.get(host)
-            if client is None:
-                client = SnapshotClient(host)
-                self._snapshot_clients[host] = client
         try:
-            client.push_snapshot(key, snap)
+            self._snapshot_clients.get(host).push_snapshot(key, snap)
             return True
         except Exception:  # noqa: BLE001
             logger.exception("Failed pushing snapshot %s to %s", key, host)
@@ -496,12 +499,7 @@ class Planner:
         send_mappings_from_decision(decision)
 
     def _get_client(self, ip: str):
-        from faabric_tpu.scheduler.function_call import FunctionCallClient
-
-        with self._clients_lock:
-            if ip not in self._clients:
-                self._clients[ip] = FunctionCallClient(ip)
-            return self._clients[ip]
+        return self._clients.get(ip)
 
     # ------------------------------------------------------------------
     # Results (reference Planner::setMessageResult / getMessageResult)
@@ -591,6 +589,25 @@ class Planner:
             return in_flight[1] if in_flight else None
 
     # ------------------------------------------------------------------
+    # State master registry
+    # ------------------------------------------------------------------
+    def claim_state_master(self, user: str, key: str,
+                           claiming_host: str) -> str:
+        """Return the master host for a state key, claiming it for the
+        caller if unowned (the Redis getMasterIP(claim) analog)."""
+        full = f"{user}/{key}"
+        with self._lock:
+            master = self._state_masters.get(full)
+            if master is None:
+                master = claiming_host
+                self._state_masters[full] = master
+            return master
+
+    def drop_state_master(self, user: str, key: str) -> None:
+        with self._lock:
+            self._state_masters.pop(f"{user}/{key}", None)
+
+    # ------------------------------------------------------------------
     # Observability / reset
     # ------------------------------------------------------------------
     def get_num_migrations(self) -> int:
@@ -622,13 +639,10 @@ class Planner:
             self._evicted.clear()
             self._next_evicted_ips.clear()
             self._group_hosts.clear()
+            self._state_masters.clear()
             self._num_migrations = 0
-            for c in self._clients.values():
-                try:
-                    c.close()
-                except Exception:  # noqa: BLE001
-                    pass
-            self._clients.clear()
+            self._clients.close_all()
+            self._snapshot_clients.close_all()
         from faabric_tpu.transport.ptp_remote import close_mapping_clients
 
         close_mapping_clients()

@@ -36,6 +36,8 @@ class PlannerCalls(enum.IntEnum):
     GET_NUM_MIGRATIONS = 9
     CALL_BATCH = 10
     PRELOAD_SCHEDULING_DECISION = 11
+    CLAIM_STATE_MASTER = 12
+    DROP_STATE_MASTER = 13
 
 
 class PlannerServer(MessageEndpointServer):
@@ -131,6 +133,15 @@ class PlannerServer(MessageEndpointServer):
             req = ber_from_wire(msg.header["ber"], msg.payload)
             decision = self.planner.call_batch(req)
             return handler_response(header={"decision": decision.to_dict()})
+
+        if code == int(PlannerCalls.CLAIM_STATE_MASTER):
+            master = self.planner.claim_state_master(
+                h["user"], h["key"], h["host"])
+            return handler_response(header={"master": master})
+
+        if code == int(PlannerCalls.DROP_STATE_MASTER):
+            self.planner.drop_state_master(h["user"], h["key"])
+            return handler_response()
 
         if code == int(PlannerCalls.PRELOAD_SCHEDULING_DECISION):
             decision = SchedulingDecision.from_dict(h["decision"])

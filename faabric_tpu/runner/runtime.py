@@ -62,11 +62,18 @@ class WorkerRuntime:
         self.scheduler.snapshot_registry = self.snapshot_registry
         self.planner_client.snapshot_registry = self.snapshot_registry
 
-        # Started by later layers: state server
+        # State KV (reference FaabricMain starts a StateServer)
+        from faabric_tpu.state.state import State
+        from faabric_tpu.state.remote import StateServer
+
+        self.state = State(self.host, self.planner_client)
+        self.scheduler.state = self.state
+
         self.extra_servers: list = [
             PointToPointServer(self.ptp_broker),
             SnapshotServer(self.snapshot_registry, self.host,
                            scheduler=self.scheduler),
+            StateServer(self.state, self.host),
         ]
 
         self._started = False

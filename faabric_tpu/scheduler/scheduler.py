@@ -67,7 +67,11 @@ class Scheduler:
         self.ptp_broker = None
         self.mpi_registry = None
         self.snapshot_registry = None
-        self._snapshot_clients: dict[str, object] = {}
+
+        from faabric_tpu.snapshot.remote import SnapshotClient
+        from faabric_tpu.transport.client_pool import ClientPool
+
+        self._snapshot_clients = ClientPool(SnapshotClient)
 
         # Thread results cache for THREADS batches (msg id → (ret, msg))
         self._thread_results: dict[int, tuple[int, Message]] = {}
@@ -90,6 +94,7 @@ class Scheduler:
             self._executors.clear()
         for e in executors:
             e.shutdown()
+        self._snapshot_clients.close_all()
         self._started = False
 
     def reset(self) -> None:
@@ -230,7 +235,7 @@ class Scheduler:
                     snap.queue_diffs(diffs)
         else:
             try:
-                client = self._get_snapshot_client(main_host)
+                client = self._snapshot_clients.get(main_host)
                 client.push_thread_result(msg.app_id, msg.id, return_value,
                                           snapshot_key, diffs or [])
             except Exception:  # noqa: BLE001 — the planner must still learn
@@ -238,16 +243,6 @@ class Scheduler:
                 logger.exception(
                     "Failed pushing thread result %d to %s", msg.id, main_host)
         self.planner_client.set_message_result(msg)
-
-    def _get_snapshot_client(self, host: str):
-        from faabric_tpu.snapshot.remote import SnapshotClient
-
-        with self._lock:
-            client = self._snapshot_clients.get(host)
-            if client is None:
-                client = SnapshotClient(host)
-                self._snapshot_clients[host] = client
-            return client
 
     def await_thread_result(self, msg_id: int, timeout: float | None = None) -> int:
         conf = get_system_config()

@@ -1,0 +1,22 @@
+"""Distributed state KV (reference src/state)."""
+
+from faabric_tpu.state.kv import STATE_CHUNK_SIZE, StateKeyValue
+from faabric_tpu.state.state import State
+from faabric_tpu.state.remote import (
+    StateCalls,
+    StateClient,
+    StateServer,
+    clear_mock_state_requests,
+    get_mock_state_pushes,
+)
+
+__all__ = [
+    "STATE_CHUNK_SIZE",
+    "State",
+    "StateCalls",
+    "StateClient",
+    "StateServer",
+    "StateKeyValue",
+    "clear_mock_state_requests",
+    "get_mock_state_pushes",
+]

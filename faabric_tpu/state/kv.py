@@ -1,0 +1,251 @@
+"""Distributed state key-value.
+
+Reference analog: include/faabric/state/StateKeyValue.h:105-226 and
+src/state/InMemoryStateKeyValue.cpp:90-260. One master host per key;
+non-masters hold a local image with lazy **chunked pull** (pulled mask),
+a **dirty-chunk mask** with partial push (only dirty chunks travel),
+appends with remote retrieval, and read/write locks hosted by the master.
+
+TPU deltas from the reference: values are numpy byte buffers (the device
+round-trip is ``jax.device_put(kv.get_array(...))`` / ``kv.set(device_
+get(...))`` — state stays host-resident, chips pull what they need); no
+Redis backend — master election goes through the planner (the cluster
+metadata service) and all data movement is master↔replica RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+STATE_CHUNK_SIZE = 4096
+
+
+def n_chunks(size: int) -> int:
+    return max(1, (size + STATE_CHUNK_SIZE - 1) // STATE_CHUNK_SIZE)
+
+
+class StateKeyValue:
+    def __init__(self, user: str, key: str, size: int,
+                 is_master: bool, master_host: str,
+                 client_factory=None) -> None:
+        self.user = user
+        self.key = key
+        self.size = size
+        self.is_master = is_master
+        self.master_host = master_host
+        self._client_factory = client_factory
+
+        self._lock = threading.RLock()
+        self._data = np.zeros(size, dtype=np.uint8)
+        chunks = n_chunks(size)
+        # Masters own authoritative data: everything is "pulled"
+        self._pulled = np.full(chunks, is_master, dtype=bool)
+        self._dirty = np.zeros(chunks, dtype=bool)
+
+        self._appended: list[bytes] = []
+
+        # Master-side value lock (reference read/write locks; writers over
+        # RPC serialise on this)
+        self._value_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _client(self):
+        if self._client_factory is None:
+            raise RuntimeError(
+                f"No state client for non-master access to {self.user}/{self.key}")
+        return self._client_factory(self.master_host)
+
+    def _chunk_range(self, offset: int, length: int) -> tuple[int, int]:
+        first = offset // STATE_CHUNK_SIZE
+        last = (offset + max(1, length) - 1) // STATE_CHUNK_SIZE
+        return first, last + 1
+
+    def _ensure_pulled(self, offset: int, length: int) -> None:
+        if self.is_master:
+            return
+        first, last = self._chunk_range(offset, length)
+        with self._lock:
+            missing = [c for c in range(first, min(last, self._pulled.size))
+                       if not self._pulled[c]]
+        if not missing:
+            return
+        client = self._client()
+        for c in missing:
+            lo = c * STATE_CHUNK_SIZE
+            hi = min(self.size, lo + STATE_CHUNK_SIZE)
+            data = client.pull_chunk(self.user, self.key, lo, hi - lo)
+            with self._lock:
+                self._data[lo:lo + len(data)] = np.frombuffer(data, np.uint8)
+                self._pulled[c] = True
+
+    # ------------------------------------------------------------------
+    # Reads
+    # ------------------------------------------------------------------
+    def get(self) -> bytes:
+        self._ensure_pulled(0, self.size)
+        with self._lock:
+            return self._data.tobytes()
+
+    def get_array(self) -> np.ndarray:
+        self._ensure_pulled(0, self.size)
+        with self._lock:
+            return self._data.copy()
+
+    def get_chunk(self, offset: int, length: int) -> bytes:
+        if offset + length > self.size:
+            raise ValueError(
+                f"Chunk [{offset}, {offset + length}) out of bounds "
+                f"(size {self.size})")
+        self._ensure_pulled(offset, length)
+        with self._lock:
+            return self._data[offset:offset + length].tobytes()
+
+    # ------------------------------------------------------------------
+    # Writes
+    # ------------------------------------------------------------------
+    def set(self, data: bytes) -> None:
+        if len(data) != self.size:
+            raise ValueError(f"set() needs {self.size} bytes, got {len(data)}")
+        with self._lock:
+            self._data[:] = np.frombuffer(data, np.uint8)
+            self._pulled[:] = True
+            self._dirty[:] = True
+
+    def set_chunk(self, offset: int, data: bytes) -> None:
+        if offset + len(data) > self.size:
+            raise ValueError("Chunk write out of bounds")
+        first, last = self._chunk_range(offset, len(data))
+        with self._lock:
+            self._data[offset:offset + len(data)] = np.frombuffer(data,
+                                                                  np.uint8)
+            self._dirty[first:last] = True
+            self._pulled[first:last] = True
+
+    # ------------------------------------------------------------------
+    # Push / pull (non-master ↔ master)
+    # ------------------------------------------------------------------
+    def push_full(self) -> None:
+        if self.is_master:
+            with self._lock:
+                self._dirty[:] = False
+            return
+        self._client().push_chunk(self.user, self.key, 0, self.get())
+        with self._lock:
+            self._dirty[:] = False
+
+    def push_partial(self) -> None:
+        """Push only the dirty chunks (reference pushPartial)."""
+        if self.is_master:
+            with self._lock:
+                self._dirty[:] = False
+            return
+        with self._lock:
+            dirty = [int(c) for c in np.where(self._dirty)[0]]
+        if not dirty:
+            return
+        client = self._client()
+        for c in dirty:
+            lo = c * STATE_CHUNK_SIZE
+            hi = min(self.size, lo + STATE_CHUNK_SIZE)
+            with self._lock:
+                payload = self._data[lo:hi].tobytes()
+            client.push_chunk(self.user, self.key, lo, payload)
+            with self._lock:
+                self._dirty[c] = False
+
+    def pull(self) -> None:
+        """Re-pull the whole value from the master."""
+        if self.is_master:
+            return
+        with self._lock:
+            self._pulled[:] = False
+        self._ensure_pulled(0, self.size)
+
+    def n_dirty_chunks(self) -> int:
+        with self._lock:
+            return int(self._dirty.sum())
+
+    # ------------------------------------------------------------------
+    # Appends (reference append/getAppended/clearAppended)
+    # ------------------------------------------------------------------
+    def append(self, data: bytes) -> None:
+        if self.is_master:
+            with self._lock:
+                self._appended.append(bytes(data))
+        else:
+            self._client().append(self.user, self.key, data)
+
+    def get_appended(self, n_values: int) -> list[bytes]:
+        if self.is_master:
+            with self._lock:
+                if len(self._appended) < n_values:
+                    raise ValueError(
+                        f"Only {len(self._appended)} appended values")
+                return list(self._appended[:n_values])
+        return self._client().pull_appended(self.user, self.key, n_values)
+
+    def clear_appended(self) -> None:
+        if self.is_master:
+            with self._lock:
+                self._appended.clear()
+        else:
+            self._client().clear_appended(self.user, self.key)
+
+    # ------------------------------------------------------------------
+    # Locks (master-hosted)
+    # ------------------------------------------------------------------
+    # Master-side acquire bound: slightly under the client socket timeout,
+    # so a contended lock surfaces as an RPC error on the requester rather
+    # than an orphaned server thread that acquires for a dead client
+    LOCK_ACQUIRE_TIMEOUT = 30.0
+
+    def lock_global(self) -> None:
+        if self.is_master:
+            if not self._value_lock.acquire(timeout=self.LOCK_ACQUIRE_TIMEOUT):
+                raise TimeoutError(
+                    f"Timed out acquiring global lock on {self.user}/{self.key}")
+        else:
+            # Lock/unlock use one-shot connections: the shared cached
+            # client serialises its sync socket, so a blocked lock request
+            # would block the holder's unlock behind it (deadlock)
+            self._oneshot_lock_call("lock")
+
+    def unlock_global(self) -> None:
+        if self.is_master:
+            self._value_lock.release()
+        else:
+            self._oneshot_lock_call("unlock")
+
+    def _oneshot_lock_call(self, op: str) -> None:
+        from faabric_tpu.state.remote import StateClient
+
+        client = StateClient(self.master_host)
+        try:
+            getattr(client, op)(self.user, self.key)
+        finally:
+            client.close()
+
+    # -- master-side entry points used by the StateServer ---------------
+    def server_pull_chunk(self, offset: int, length: int) -> bytes:
+        with self._lock:
+            return self._data[offset:offset + length].tobytes()
+
+    def server_push_chunk(self, offset: int, data: bytes) -> None:
+        first, last = self._chunk_range(offset, len(data))
+        with self._lock:
+            if offset + len(data) > self.size:
+                raise ValueError("Pushed chunk out of bounds")
+            self._data[offset:offset + len(data)] = np.frombuffer(data,
+                                                                  np.uint8)
+            self._pulled[first:last] = True
+
+    def server_append(self, data: bytes) -> None:
+        with self._lock:
+            self._appended.append(bytes(data))

@@ -1,0 +1,178 @@
+"""State RPC: server (ports 8003/8004) + client with mock recording.
+
+Reference analog: src/state/StateServer.cpp (191 lines) with ops
+Pull/Push/Size/Append/PullAppended/ClearAppended/Delete/Lock/Unlock
+(include/faabric/state/State.h:11-21). Chunk bytes ride the binary tail.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import TYPE_CHECKING
+
+from faabric_tpu.transport.client import MessageEndpointClient
+from faabric_tpu.transport.common import (
+    STATE_ASYNC_PORT,
+    STATE_SYNC_PORT,
+    get_host_alias_offset,
+)
+from faabric_tpu.transport.message import TransportMessage
+from faabric_tpu.transport.server import MessageEndpointServer, handler_response
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.testing import is_mock_mode
+
+if TYPE_CHECKING:  # pragma: no cover
+    from faabric_tpu.state.state import State
+
+logger = get_logger(__name__)
+
+
+class StateCalls(enum.IntEnum):
+    PULL = 1
+    PUSH = 2
+    SIZE = 3
+    APPEND = 4
+    PULL_APPENDED = 5
+    CLEAR_APPENDED = 6
+    DELETE = 7
+    LOCK = 8
+    UNLOCK = 9
+
+
+_mock_lock = threading.Lock()
+# (host, user, key, offset, data)
+_mock_pushes: list[tuple[str, str, str, int, bytes]] = []
+
+
+def get_mock_state_pushes() -> list[tuple[str, str, str, int, bytes]]:
+    with _mock_lock:
+        return list(_mock_pushes)
+
+
+def clear_mock_state_requests() -> None:
+    with _mock_lock:
+        _mock_pushes.clear()
+
+
+class StateClient(MessageEndpointClient):
+    def __init__(self, host: str) -> None:
+        super().__init__(host, STATE_ASYNC_PORT, STATE_SYNC_PORT)
+
+    def pull_chunk(self, user: str, key: str, offset: int,
+                   length: int) -> bytes:
+        resp = self.sync_send(int(StateCalls.PULL), {
+            "user": user, "key": key, "offset": offset, "length": length,
+        }, idempotent=True)
+        return resp.payload
+
+    def push_chunk(self, user: str, key: str, offset: int,
+                   data: bytes) -> None:
+        if is_mock_mode():
+            with _mock_lock:
+                _mock_pushes.append((self.host, user, key, offset, data))
+            return
+        # Idempotent: pushing the same chunk bytes twice converges
+        self.sync_send(int(StateCalls.PUSH),
+                       {"user": user, "key": key, "offset": offset}, data,
+                       idempotent=True)
+
+    def state_size(self, user: str, key: str) -> int:
+        resp = self.sync_send(int(StateCalls.SIZE),
+                              {"user": user, "key": key}, idempotent=True)
+        return int(resp.header["size"])
+
+    def append(self, user: str, key: str, data: bytes) -> None:
+        self.sync_send(int(StateCalls.APPEND),
+                       {"user": user, "key": key}, data)
+
+    def pull_appended(self, user: str, key: str,
+                      n_values: int) -> list[bytes]:
+        resp = self.sync_send(int(StateCalls.PULL_APPENDED), {
+            "user": user, "key": key, "n_values": n_values,
+        }, idempotent=True)
+        lengths = resp.header.get("lengths", [])
+        out, off = [], 0
+        for n in lengths:
+            out.append(resp.payload[off:off + n])
+            off += n
+        return out
+
+    def clear_appended(self, user: str, key: str) -> None:
+        self.sync_send(int(StateCalls.CLEAR_APPENDED),
+                       {"user": user, "key": key}, idempotent=True)
+
+    def delete(self, user: str, key: str) -> None:
+        self.sync_send(int(StateCalls.DELETE),
+                       {"user": user, "key": key}, idempotent=True)
+
+    def lock(self, user: str, key: str) -> None:
+        self.sync_send(int(StateCalls.LOCK), {"user": user, "key": key})
+
+    def unlock(self, user: str, key: str) -> None:
+        self.sync_send(int(StateCalls.UNLOCK), {"user": user, "key": key})
+
+
+class StateServer(MessageEndpointServer):
+    def __init__(self, state: "State", host: str = "") -> None:
+        conf = get_system_config()
+        offset = get_host_alias_offset(host or state.host)
+        super().__init__(
+            STATE_ASYNC_PORT + offset,
+            STATE_SYNC_PORT + offset,
+            label=f"state-server-{host or state.host}",
+            n_threads=conf.state_server_threads,
+        )
+        self.state = state
+
+    def do_async_recv(self, msg: TransportMessage) -> None:
+        logger.warning("Unknown async state call %d", msg.code)
+
+    def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:
+        code = msg.code
+        h = msg.header
+        user, key = h["user"], h["key"]
+
+        kv = self.state.try_get_kv(user, key)
+        if kv is None or not kv.is_master:
+            raise KeyError(f"Host is not master for state {user}/{key}")
+
+        if code == int(StateCalls.PULL):
+            data = kv.server_pull_chunk(h["offset"], h["length"])
+            return handler_response(payload=data)
+
+        if code == int(StateCalls.PUSH):
+            kv.server_push_chunk(h["offset"], msg.payload)
+            return handler_response()
+
+        if code == int(StateCalls.SIZE):
+            return handler_response(header={"size": kv.size})
+
+        if code == int(StateCalls.APPEND):
+            kv.server_append(msg.payload)
+            return handler_response()
+
+        if code == int(StateCalls.PULL_APPENDED):
+            values = kv.get_appended(h["n_values"])
+            return handler_response(
+                header={"lengths": [len(v) for v in values]},
+                payload=b"".join(values))
+
+        if code == int(StateCalls.CLEAR_APPENDED):
+            kv.clear_appended()
+            return handler_response()
+
+        if code == int(StateCalls.DELETE):
+            self.state.delete_kv(user, key)
+            return handler_response()
+
+        if code == int(StateCalls.LOCK):
+            kv.lock_global()
+            return handler_response()
+
+        if code == int(StateCalls.UNLOCK):
+            kv.unlock_global()
+            return handler_response()
+
+        raise ValueError(f"Unknown sync state call {code}")

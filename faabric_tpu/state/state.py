@@ -1,0 +1,95 @@
+"""Host-wide state: user/key → StateKeyValue.
+
+Reference analog: include/faabric/state/State.h:23-59 and
+src/state/State.cpp:100-160. ``get_kv`` resolves the key's master through
+the planner (first caller claims mastership) and caches the KV locally.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from faabric_tpu.state.kv import StateKeyValue
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class State:
+    def __init__(self, host: str, planner_client=None) -> None:
+        self.host = host
+        self.planner_client = planner_client
+        self._lock = threading.Lock()
+        self._kvs: dict[str, StateKeyValue] = {}
+
+        from faabric_tpu.state.remote import StateClient
+        from faabric_tpu.transport.client_pool import ClientPool
+
+        self._state_clients = ClientPool(StateClient)
+
+    # ------------------------------------------------------------------
+    def _client_factory(self, master_host: str):
+        return self._state_clients.get(master_host)
+
+    def get_kv(self, user: str, key: str, size: int = 0) -> StateKeyValue:
+        full = f"{user}/{key}"
+        with self._lock:
+            kv = self._kvs.get(full)
+        if kv is not None:
+            return kv
+
+        if self.planner_client is not None:
+            master = self.planner_client.claim_state_master(user, key)
+        else:
+            master = self.host
+        is_master = master == self.host
+
+        if size <= 0:
+            if is_master:
+                # We just claimed a key we cannot create (no size): release
+                # the claim so the eventual creator can become master
+                # instead of the key being poisoned cluster-wide
+                if self.planner_client is not None:
+                    try:
+                        self.planner_client.drop_state_master(user, key)
+                    except Exception:  # noqa: BLE001
+                        logger.warning("Could not release claim on %s", full)
+                raise ValueError(
+                    f"Master creation of {full} needs an explicit size")
+            size = self._client_factory(master).state_size(user, key)
+
+        kv = StateKeyValue(user, key, size, is_master, master,
+                           client_factory=self._client_factory)
+        with self._lock:
+            # Another thread may have raced us; first one wins
+            existing = self._kvs.get(full)
+            if existing is not None:
+                return existing
+            self._kvs[full] = kv
+        logger.debug("%s created KV %s (master=%s size=%d)", self.host, full,
+                     master, size)
+        return kv
+
+    def try_get_kv(self, user: str, key: str) -> Optional[StateKeyValue]:
+        with self._lock:
+            return self._kvs.get(f"{user}/{key}")
+
+    def delete_kv(self, user: str, key: str) -> None:
+        with self._lock:
+            kv = self._kvs.pop(f"{user}/{key}", None)
+        if kv is not None and kv.is_master \
+                and self.planner_client is not None:
+            try:
+                self.planner_client.drop_state_master(user, key)
+            except Exception:  # noqa: BLE001
+                logger.debug("Could not drop master for %s/%s", user, key)
+
+    def get_kv_count(self) -> int:
+        with self._lock:
+            return len(self._kvs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._kvs.clear()
+        self._state_clients.close_all()

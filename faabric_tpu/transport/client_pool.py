@@ -1,0 +1,51 @@
+"""Cached per-host RPC clients with one lifecycle.
+
+Every layer that talks to peers (planner dispatch, snapshot pushes, state
+pulls, PTP mappings) needs the same host→client cache; this is the single
+implementation with a correct close/reset path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class ClientPool(Generic[T]):
+    def __init__(self, factory: Callable[[str], T]) -> None:
+        self._factory = factory
+        self._clients: dict[str, T] = {}
+        self._lock = threading.Lock()
+
+    def get(self, host: str) -> T:
+        with self._lock:
+            client = self._clients.get(host)
+            if client is None:
+                client = self._factory(host)
+                self._clients[host] = client
+            return client
+
+    def drop(self, host: str) -> None:
+        with self._lock:
+            client = self._clients.pop(host, None)
+        if client is not None:
+            try:
+                client.close()  # type: ignore[attr-defined]
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            try:
+                c.close()  # type: ignore[attr-defined]
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._clients)

@@ -29,19 +29,21 @@ import random  # noqa: E402
 
 import pytest  # noqa: E402
 
-# Port-range allocator for fixtures that stand up aliased hosts: bases are
-# session-monotonic so no two fixtures ever share a range (random bases
-# collided ~1/150 runs). Each fixture may use base .. base+2999.
-_port_bases = itertools.count(random.randint(60, 180) * 100, 3000)
+# Port-range allocator for fixtures that stand up aliased hosts. Two
+# constraints learned the hard way: (a) bases must be session-unique so
+# concurrent fixture ranges never overlap (random bases collided ~1/150
+# runs); (b) every listener port (canonical 8003-8012 + offset) must stay
+# BELOW the ephemeral range (32768+), where the kernel hands out client
+# ports — binding there intermittently hits EADDRINUSE against outgoing
+# connections from earlier tests. Bases cycle through 7 slots; sequential
+# fixtures reuse a slot only after its predecessor tore down
+# (SO_REUSEADDR covers TIME_WAIT).
+_BASES = [1000, 4000, 7000, 10000, 13000, 16000, 19000]
+_port_iter = itertools.count(random.randrange(len(_BASES)))
 
 
 def next_port_base() -> int:
-    base = next(_port_bases)
-    # Keep every port (canonical 8003-8012 + offset) within 16-bit range
-    if base + 8012 + 2999 > 65000:
-        globals()["_port_bases"] = itertools.count(6000, 3000)
-        base = next(_port_bases)
-    return base
+    return _BASES[next(_port_iter) % len(_BASES)]
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,149 @@
+"""State KV tests (reference: tests/test/state/). Two-host scenarios run
+through the e2e cluster: master on one runtime, replica on the other, with
+the planner electing masters."""
+
+import numpy as np
+import pytest
+
+from faabric_tpu.state import STATE_CHUNK_SIZE, State, StateKeyValue
+
+
+# ---------------------------------------------------------------------------
+# Local (master-only) behaviour
+# ---------------------------------------------------------------------------
+
+def test_master_kv_basic_roundtrip():
+    state = State("hostX")
+    kv = state.get_kv("demo", "k1", 256)
+    assert kv.is_master
+    data = bytes(range(256))
+    kv.set(data)
+    assert kv.get() == data
+    assert kv.get_chunk(10, 20) == data[10:30]
+    kv.set_chunk(0, b"\xff" * 4)
+    assert kv.get()[:4] == b"\xff" * 4
+    # Same key returns the same KV
+    assert state.get_kv("demo", "k1") is kv
+    assert state.get_kv_count() == 1
+
+
+def test_master_appends():
+    state = State("hostX")
+    kv = state.get_kv("demo", "app", 8)
+    kv.append(b"one")
+    kv.append(b"two")
+    assert kv.get_appended(2) == [b"one", b"two"]
+    with pytest.raises(ValueError):
+        kv.get_appended(3)
+    kv.clear_appended()
+    with pytest.raises(ValueError):
+        kv.get_appended(1)
+
+
+def test_chunk_bounds():
+    state = State("hostX")
+    kv = state.get_kv("demo", "b", 100)
+    with pytest.raises(ValueError):
+        kv.get_chunk(90, 20)
+    with pytest.raises(ValueError):
+        kv.set_chunk(99, b"1234")
+
+
+def test_master_needs_size():
+    state = State("hostX")
+    with pytest.raises(ValueError):
+        state.get_kv("demo", "nosize")
+
+
+# ---------------------------------------------------------------------------
+# Two-host: master + replica over real RPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cluster_states():
+    """PlannerServer + two worker runtimes; yields their State objects
+    (master side, replica side)."""
+    from faabric_tpu.planner import PlannerServer, get_planner
+    from faabric_tpu.runner import WorkerRuntime
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("planner", "127.0.0.1", base)
+    register_host_alias("stateA", "127.0.0.1", base + 1000)
+    register_host_alias("stateB", "127.0.0.1", base + 2000)
+
+    get_planner().reset()
+    planner_server = PlannerServer(port_offset=base)
+    planner_server.start()
+    workers = [WorkerRuntime(host=h, slots=1, planner_host="planner")
+               for h in ("stateA", "stateB")]
+    for w in workers:
+        w.start()
+    yield workers[0].state, workers[1].state
+    for w in workers:
+        w.shutdown()
+    planner_server.stop()
+    get_planner().reset()
+
+
+def test_two_host_pull_push(cluster_states):
+    master_state, replica_state = cluster_states
+    size = STATE_CHUNK_SIZE * 3 + 100
+
+    kv_m = master_state.get_kv("demo", "shared", size)
+    assert kv_m.is_master
+    content = np.arange(size, dtype=np.uint8)  # wraps mod 256
+    kv_m.set(content.tobytes())
+
+    # Replica discovers the master through the planner and pulls lazily
+    kv_r = replica_state.get_kv("demo", "shared")
+    assert not kv_r.is_master
+    assert kv_r.size == size
+    # Chunked partial read pulls only what it needs
+    assert kv_r.get_chunk(STATE_CHUNK_SIZE, 10) == content.tobytes()[
+        STATE_CHUNK_SIZE:STATE_CHUNK_SIZE + 10]
+    assert int(kv_r._pulled.sum()) == 1
+    # Full read pulls the rest
+    assert kv_r.get() == content.tobytes()
+
+    # Replica writes one chunk and pushes only dirty chunks
+    kv_r.set_chunk(STATE_CHUNK_SIZE * 2, b"\xab" * 16)
+    assert kv_r.n_dirty_chunks() == 1
+    kv_r.push_partial()
+    assert kv_r.n_dirty_chunks() == 0
+    # Master observes the write
+    assert kv_m.get_chunk(STATE_CHUNK_SIZE * 2, 16) == b"\xab" * 16
+
+
+def test_two_host_appends_and_locks(cluster_states):
+    master_state, replica_state = cluster_states
+    kv_m = master_state.get_kv("demo", "applog", 8)
+    kv_r = replica_state.get_kv("demo", "applog")
+
+    kv_r.append(b"from-replica")
+    kv_m.append(b"from-master")
+    got = kv_r.get_appended(2)
+    assert got == [b"from-replica", b"from-master"]
+    kv_r.clear_appended()
+    with pytest.raises(Exception):
+        kv_m.get_appended(1)
+
+    # Global lock round-trips through the master
+    kv_r.lock_global()
+    kv_r.unlock_global()
+
+
+def test_push_full_and_repull(cluster_states):
+    master_state, replica_state = cluster_states
+    kv_m = master_state.get_kv("demo", "full", 64)
+    kv_m.set(b"\x01" * 64)
+    kv_r = replica_state.get_kv("demo", "full")
+    assert kv_r.get() == b"\x01" * 64
+    kv_r.set(b"\x02" * 64)
+    kv_r.push_full()
+    assert kv_m.get() == b"\x02" * 64
+    # Master mutates; replica re-pulls
+    kv_m.set(b"\x03" * 64)
+    kv_r.pull()
+    assert kv_r.get() == b"\x03" * 64
