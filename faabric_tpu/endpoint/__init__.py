@@ -1,0 +1,5 @@
+"""Planner HTTP REST API (reference src/endpoint + PlannerEndpointHandler)."""
+
+from faabric_tpu.endpoint.http_server import HttpMessageType, PlannerHttpEndpoint
+
+__all__ = ["HttpMessageType", "PlannerHttpEndpoint"]

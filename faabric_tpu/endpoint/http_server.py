@@ -1,0 +1,226 @@
+"""Planner HTTP REST API.
+
+Reference analog: src/planner/PlannerEndpointHandler.cpp:15-422 and the
+HttpMessage schema (src/planner/planner.proto:33-66). POST a JSON body
+``{"http_type": <int>, "payload": <json string>}``; responses are JSON.
+
+The reference serves this from Boost.Beast inside the planner binary; the
+idiomatic Python analog is a stdlib ThreadingHTTPServer on a background
+thread — the REST plane is a control surface, not a data plane.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from faabric_tpu.batch_scheduler import reset_batch_scheduler
+from faabric_tpu.batch_scheduler.scheduler import get_batch_scheduler_mode
+from faabric_tpu.batch_scheduler.decision import (
+    MUST_FREEZE,
+    NOT_ENOUGH_SLOTS,
+    SchedulingDecision,
+)
+from faabric_tpu.planner.planner import Planner, get_planner
+from faabric_tpu.proto import (
+    BatchExecuteRequest,
+    is_batch_exec_request_valid,
+)
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.exec_graph import build_exec_graph
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class HttpMessageType(enum.IntEnum):
+    # mirror of planner.proto HttpMessage.Type
+    NO_TYPE = 0
+    RESET = 1
+    FLUSH_AVAILABLE_HOSTS = 2
+    FLUSH_EXECUTORS = 3
+    FLUSH_SCHEDULING_STATE = 4
+    GET_AVAILABLE_HOSTS = 5
+    GET_CONFIG = 6
+    GET_EXEC_GRAPH = 7
+    GET_IN_FLIGHT_APPS = 8
+    EXECUTE_BATCH = 10
+    EXECUTE_BATCH_STATUS = 11
+    PRELOAD_SCHEDULING_DECISION = 12
+    SET_POLICY = 13
+    GET_POLICY = 14
+    SET_NEXT_EVICTED_VM = 15
+
+
+class PlannerHttpEndpoint:
+    def __init__(self, port: int | None = None,
+                 planner: Optional[Planner] = None) -> None:
+        conf = get_system_config()
+        self.port = port if port is not None else conf.endpoint_port
+        self.planner = planner or get_planner()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        endpoint = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802 — stdlib API
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                status, payload = endpoint.handle(body)
+                data = payload.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:  # noqa: N802
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b'{"status": "running"}')
+
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="planner-http", daemon=True)
+        self._thread.start()
+        logger.debug("Planner HTTP endpoint on :%d", self.port)
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+
+    # ------------------------------------------------------------------
+    def handle(self, body: bytes) -> tuple[int, str]:
+        """(status_code, response_json) for one HttpMessage."""
+        try:
+            msg = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            return 400, json.dumps({"error": "Bad JSON in request"})
+        if not isinstance(msg, dict):
+            return 400, json.dumps({"error": "Request body must be an object"})
+        http_type = msg.get("http_type", int(HttpMessageType.NO_TYPE))
+        payload = msg.get("payload", "")
+        try:
+            return self._dispatch(http_type, payload)
+        except Exception as e:  # noqa: BLE001 — REST errors cross the wire
+            logger.exception("HTTP handler error (type %s)", http_type)
+            return 500, json.dumps({"error": str(e)})
+
+    def _dispatch(self, http_type: int, payload: str) -> tuple[int, str]:
+        planner = self.planner
+        t = HttpMessageType(http_type)
+
+        if t == HttpMessageType.RESET:
+            planner.reset()
+            return 200, json.dumps({"status": "reset"})
+
+        if t == HttpMessageType.FLUSH_AVAILABLE_HOSTS:
+            planner.flush_hosts()
+            return 200, json.dumps({"status": "flushed hosts"})
+
+        if t == HttpMessageType.FLUSH_EXECUTORS:
+            hosts = planner.flush_all_executors()
+            return 200, json.dumps({"status": "flushed executors",
+                                    "hosts": hosts})
+
+        if t == HttpMessageType.FLUSH_SCHEDULING_STATE:
+            planner.flush_scheduling_state()
+            return 200, json.dumps({"status": "flushed scheduling state"})
+
+        if t == HttpMessageType.GET_AVAILABLE_HOSTS:
+            hosts = [{"ip": h.ip, "slots": h.slots,
+                      "usedSlots": h.used_slots, "nDevices": h.n_devices}
+                     for h in planner.get_available_hosts()]
+            return 200, json.dumps({"hosts": hosts})
+
+        if t == HttpMessageType.GET_CONFIG:
+            conf = get_system_config()
+            return 200, json.dumps({
+                "ip": conf.planner_host,
+                "hostTimeout": conf.planner_host_timeout,
+                "policy": get_batch_scheduler_mode(),
+            })
+
+        if t == HttpMessageType.GET_EXEC_GRAPH:
+            req = json.loads(payload) if payload else {}
+            app_id = req.get("app_id", 0) or req.get("appId", 0)
+            msg_id = req.get("id", 0)
+
+            def get_result(aid, mid):
+                result = planner.get_message_result(aid, mid)
+                if result is None:
+                    raise KeyError(f"No result for msg {mid} (app {aid})")
+                return result
+
+            graph = build_exec_graph(get_result, msg_id, app_id)
+            return 200, graph.to_json()
+
+        if t == HttpMessageType.GET_IN_FLIGHT_APPS:
+            return 200, json.dumps(planner.in_flight_summary())
+
+        if t == HttpMessageType.EXECUTE_BATCH:
+            req = BatchExecuteRequest.from_dict(json.loads(payload))
+            if not is_batch_exec_request_valid(req):
+                return 400, json.dumps({"error": "Bad BatchExecRequest"})
+            decision = planner.call_batch(req)
+            if decision.app_id == NOT_ENOUGH_SLOTS:
+                return 500, json.dumps({"error": "No available hosts"})
+            if decision.app_id == MUST_FREEZE:
+                return 200, json.dumps({"appId": req.app_id,
+                                        "frozen": True})
+            return 200, json.dumps({"appId": req.app_id,
+                                    "groupId": decision.group_id,
+                                    "hosts": decision.hosts,
+                                    "messageIds": decision.message_ids})
+
+        if t == HttpMessageType.EXECUTE_BATCH_STATUS:
+            req = json.loads(payload) if payload else {}
+            app_id = req.get("app_id", 0) or req.get("appId", 0)
+            status = planner.get_batch_results(app_id)
+            return 200, json.dumps({
+                "appId": status.app_id,
+                "finished": status.finished,
+                "expectedNumMessages": status.expected_num_messages,
+                "messageResults": [m.to_dict()
+                                   for m in status.message_results],
+            })
+
+        if t == HttpMessageType.PRELOAD_SCHEDULING_DECISION:
+            decision = SchedulingDecision.from_dict(json.loads(payload))
+            planner.preload_scheduling_decision(decision)
+            return 200, json.dumps({"status": "preloaded",
+                                    "appId": decision.app_id})
+
+        if t == HttpMessageType.SET_POLICY:
+            policy = payload.strip().strip('"')
+            if policy not in ("bin-pack", "compact", "spot"):
+                return 400, json.dumps({"error": f"Unknown policy {policy}"})
+            reset_batch_scheduler(policy)
+            return 200, json.dumps({"policy": policy})
+
+        if t == HttpMessageType.GET_POLICY:
+            return 200, json.dumps({"policy": get_batch_scheduler_mode()})
+
+        if t == HttpMessageType.SET_NEXT_EVICTED_VM:
+            ip = payload.strip().strip('"')
+            planner.set_next_evicted_host_ips([ip] if ip else [])
+            return 200, json.dumps({"nextEvictedVmIps": [ip] if ip else []})
+
+        return 400, json.dumps({"error": f"Unsupported request type {t}"})

@@ -239,6 +239,15 @@ class PlannerClient(MessageEndpointClient):
                               idempotent=True)
         return int(resp.header["num_migrations"])
 
+    def check_migration(self, app_id: int) -> Optional[SchedulingDecision]:
+        """Ask the planner for a migration opportunity (reference
+        checkForMigrationOpportunities → DIST_CHANGE)."""
+        resp = self.sync_send(int(PlannerCalls.CHECK_MIGRATION),
+                              {"app_id": app_id})
+        if not resp.header.get("found"):
+            return None
+        return SchedulingDecision.from_dict(resp.header["decision"])
+
     def claim_state_master(self, user: str, key: str) -> str:
         resp = self.sync_send(int(PlannerCalls.CLAIM_STATE_MASTER), {
             "user": user, "key": key, "host": self.this_host,

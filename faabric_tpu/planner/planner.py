@@ -186,14 +186,41 @@ class Planner:
         """Schedule a batch. Accounting happens under the planner lock;
         network dispatch happens after it is released, so one unreachable
         worker cannot stall keep-alives and other apps' scheduling."""
+        from faabric_tpu.proto import update_batch_exec_app_id
+
+        # Messages must agree with their batch's app id — chained/scale
+        # requests built from factories otherwise report results into the
+        # wrong app bucket (reference updateBatchExecAppId)
+        update_batch_exec_app_id(req, req.app_id)
+
         with self._lock:
             scheduler = get_batch_scheduler()
             decision_type = scheduler.get_decision_type(self._in_flight, req)
+
+            # A MIGRATION request that no longer classifies as DIST_CHANGE
+            # raced completing results (check_migration snapshots outside
+            # this lock): treat it as no-opportunity rather than letting it
+            # masquerade as a scale-change or a fresh app
+            if (req.type == int(BatchExecuteType.MIGRATION)
+                    and decision_type != DecisionType.DIST_CHANGE):
+                from faabric_tpu.batch_scheduler.decision import (
+                    do_not_migrate_decision,
+                )
+
+                logger.debug("Migration request for app %d raced results; "
+                             "ignoring", req.app_id)
+                return do_not_migrate_decision()
 
             # Thaw: a NEW request for a frozen app resumes it
             if decision_type == DecisionType.NEW and req.app_id in self._evicted:
                 req = self._evicted.pop(req.app_id)
                 decision_type = DecisionType.NEW
+
+            # Elastic scale-up: an OpenMP-style fork with the hint grows to
+            # every free slot on its main host (reference Planner.cpp:833-893)
+            if (decision_type == DecisionType.SCALE_CHANGE
+                    and req.elastic_scale_hint and req.messages):
+                self._apply_elastic_scale(req)
 
             host_map = self._policy_host_map()
 
@@ -316,6 +343,54 @@ class Planner:
         # The migrating ranks re-dispatch themselves via the migration
         # exception + MIGRATION batch (reference §3.5); no dispatch here.
         return decision, decision, []
+
+    def _apply_elastic_scale(self, req: BatchExecuteRequest) -> None:
+        """Grow the scale-change request so the app fills every free slot
+        on its main host (called under the planner lock)."""
+        import copy
+
+        old_req, old_decision = self._in_flight[req.app_id]
+        main_host = (old_req.messages[0].main_host
+                     or old_decision.hosts[0]) if old_decision.hosts else ""
+        host = self._hosts.get(main_host)
+        if host is None:
+            return
+        extra = host.state.available - req.n_messages()
+        template = req.messages[0]
+        for _ in range(max(0, extra)):
+            clone = copy.deepcopy(template)
+            clone.id = generate_gid()
+            clone.app_idx = 0  # assigned monotonically by scale handling
+            clone.group_idx = 0
+            req.messages.append(clone)
+        if extra > 0:
+            logger.debug("Elastic scale: app %d grows by %d to fill %s",
+                         req.app_id, extra, main_host)
+
+    # -- migration (reference Scheduler::checkForMigrationOpportunities
+    # via the planner's DIST_CHANGE path, §3.5) --------------------------
+    def check_migration(self, app_id: int) -> Optional[SchedulingDecision]:
+        """Ask the policy whether the running app should move. Returns the
+        new decision (fresh group id, mappings already distributed) or
+        None when there is no improvement."""
+        from faabric_tpu.batch_scheduler.decision import is_sentinel_decision
+
+        with self._lock:
+            in_flight = self._in_flight.get(app_id)
+            if in_flight is None:
+                return None
+            cur_req, _ = in_flight
+            mig_req = BatchExecuteRequest(
+                app_id=app_id, user=cur_req.user, function=cur_req.function,
+                type=int(BatchExecuteType.MIGRATION), subtype=cur_req.subtype)
+            mig_req.messages = list(cur_req.messages)
+        decision = self.call_batch(mig_req)
+        if decision.app_id == MUST_FREEZE:
+            return decision  # callers freeze their app (spot eviction)
+        if is_sentinel_decision(decision):
+            return None
+        # Return a copy: the live decision keeps mutating as results drain
+        return SchedulingDecision.from_dict(decision.to_dict())
 
     def _freeze_app(self, req: BatchExecuteRequest) -> None:
         """Park a running app: release its resources and remember the
@@ -505,11 +580,17 @@ class Planner:
     # Results (reference Planner::setMessageResult / getMessageResult)
     # ------------------------------------------------------------------
     def set_message_result(self, msg: Message) -> None:
+        redispatch = None
         with self._lock:
             app_id, msg_id = msg.app_id, msg.id
 
             migrated = msg.return_value == int(ReturnValue.MIGRATED)
             frozen = msg.return_value == int(ReturnValue.FROZEN)
+            if migrated:
+                # The rank vacated its old host; its new placement is
+                # already in the post-migration decision — re-dispatch it
+                # there as a MIGRATION batch (reference §3.5)
+                redispatch = self._build_migration_redispatch(app_id, msg_id)
             if not migrated and not frozen:
                 self._release_message(app_id, msg_id)
                 self._results.setdefault(app_id, {})[msg_id] = msg
@@ -548,6 +629,37 @@ class Planner:
             gids, hosts = group_cleanup
             for gid in gids:
                 send_clear_group(gid, sorted(hosts))
+
+        if redispatch is not None:
+            self._do_dispatch([redispatch])
+
+    def _build_migration_redispatch(self, app_id: int, msg_id: int
+                                    ) -> Optional[tuple[str, BatchExecuteRequest]]:
+        """Under the lock: build the MIGRATION sub-batch that moves one
+        migrated rank to its post-migration host."""
+        in_flight = self._in_flight.get(app_id)
+        if in_flight is None:
+            return None
+        req, decision = in_flight
+        try:
+            i = decision.message_ids.index(msg_id)
+        except ValueError:
+            return None
+        target = decision.hosts[i]
+        for m in req.messages:
+            if m.id == msg_id:
+                m.return_value = 0
+                m.output_data = b""
+                sub = BatchExecuteRequest(
+                    app_id=req.app_id, group_id=req.group_id, user=req.user,
+                    function=req.function,
+                    type=int(BatchExecuteType.MIGRATION),
+                    subtype=req.subtype, snapshot_key=req.snapshot_key)
+                sub.messages = [m]
+                logger.debug("Re-dispatching migrated msg %d to %s",
+                             msg_id, target)
+                return (target, sub)
+        return None
 
     # The planner is cluster-singleton and long-lived: completed apps'
     # results are retained for late readers but bounded, oldest-first.
@@ -617,6 +729,43 @@ class Planner:
     def get_in_flight_apps(self) -> dict[int, SchedulingDecision]:
         with self._lock:
             return {app: d for app, (_, d) in self._in_flight.items()}
+
+    def in_flight_summary(self) -> dict:
+        """Observability snapshot for the REST surface (reference
+        GetInFlightAppsResponse, planner.proto:69-89)."""
+        with self._lock:
+            apps = [{
+                "appId": app_id,
+                "subType": req.subtype,
+                "size": decision.n_messages,
+                "hostIps": decision.unique_hosts(),
+            } for app_id, (req, decision) in self._in_flight.items()]
+            frozen = [{"appId": app_id, "subType": req.subtype,
+                       "size": req.n_messages()}
+                      for app_id, req in self._evicted.items()]
+            evicted_ips = sorted(self._next_evicted_ips)
+            n_migrations = self._num_migrations
+        return {
+            "apps": apps,
+            "numMigrations": n_migrations,
+            "nextEvictedVmIps": evicted_ips,
+            "frozenApps": frozen,
+        }
+
+    def flush_hosts(self) -> None:
+        with self._lock:
+            self._hosts.clear()
+
+    def flush_all_executors(self) -> list[str]:
+        """Broadcast a flush to every registered worker; returns the hosts
+        flushed."""
+        hosts = [h.ip for h in self.get_available_hosts()]
+        for ip in hosts:
+            try:
+                self._get_client(ip).send_flush()
+            except Exception:  # noqa: BLE001
+                logger.exception("Flush of %s failed", ip)
+        return hosts
 
     def get_frozen_apps(self) -> list[int]:
         with self._lock:

@@ -38,6 +38,7 @@ class PlannerCalls(enum.IntEnum):
     PRELOAD_SCHEDULING_DECISION = 11
     CLAIM_STATE_MASTER = 12
     DROP_STATE_MASTER = 13
+    CHECK_MIGRATION = 14
 
 
 class PlannerServer(MessageEndpointServer):
@@ -133,6 +134,13 @@ class PlannerServer(MessageEndpointServer):
             req = ber_from_wire(msg.header["ber"], msg.payload)
             decision = self.planner.call_batch(req)
             return handler_response(header={"decision": decision.to_dict()})
+
+        if code == int(PlannerCalls.CHECK_MIGRATION):
+            decision = self.planner.check_migration(h["app_id"])
+            if decision is None:
+                return handler_response(header={"found": False})
+            return handler_response(header={"found": True,
+                                            "decision": decision.to_dict()})
 
         if code == int(PlannerCalls.CLAIM_STATE_MASTER):
             master = self.planner.claim_state_master(

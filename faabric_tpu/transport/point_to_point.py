@@ -225,6 +225,13 @@ class PointToPointBroker:
                 for key in [k for k in d if k[0] == group_id]:
                     del d[key]
 
+    def post_migration_hook(self, group_id: int, group_idx: int) -> None:
+        """Re-sync a migrated group: every member barriers on the NEW group
+        id so no rank races ahead with stale mappings (reference
+        postMigrationHook :910-928; MPI worlds re-init on top of this)."""
+        self.wait_for_mappings(group_id)
+        self.get_group(group_id).barrier(group_idx)
+
     def clear(self) -> None:
         with self._lock:
             self._groups.clear()

@@ -1,0 +1,126 @@
+"""Worker/planner process bodies for the distributed tests.
+
+The reference runs dist tests as two containers + planner
+(tests/dist, dist-test/run.sh); here each logical host is a real OS
+process on aliased loopback ports, launched by the harness in
+test_multiprocess.py. Invoke as:
+
+    python procs.py planner
+    python procs.py worker <host> <behaviour>
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from faabric_tpu.executor import Executor, ExecutorFactory  # noqa: E402
+from faabric_tpu.proto import ReturnValue  # noqa: E402
+
+
+class DistExecutor(Executor):
+    """Behaviour registry keyed by function name — the reference's
+    DistTestExecutor callback pattern (tests/dist/DistTestExecutor.cpp)."""
+
+    MEM = 16384
+
+    def __init__(self, msg):
+        super().__init__(msg)
+        self.memory = np.zeros(self.MEM, dtype=np.uint8)
+
+    def get_memory_view(self):
+        return self.memory
+
+    def set_memory_size(self, size):
+        if size > self.memory.size:
+            self.memory = np.concatenate(
+                [self.memory, np.zeros(size - self.memory.size, np.uint8)])
+
+    def execute_task(self, pool_idx, msg_idx, req):
+        msg = req.messages[msg_idx]
+        fn = getattr(self, f"fn_{msg.function}", None)
+        if fn is None:
+            msg.output_data = f"unknown function {msg.function}".encode()
+            return int(ReturnValue.FAILED)
+        return fn(msg, req)
+
+    # ------------------------------------------------------------------
+    def fn_square(self, msg, req):
+        n = int(msg.input_data.decode())
+        msg.output_data = str(n * n).encode()
+        return int(ReturnValue.SUCCESS)
+
+    def fn_mpi(self, msg, req):
+        from faabric_tpu.mpi import MpiOp, get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7100
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        out = world.allreduce(rank, np.full(65536, float(rank),
+                                            dtype=np.float32), MpiOp.SUM)
+        world.barrier(rank)
+        msg.output_data = f"r{rank}:{int(out[0])}".encode()
+        return int(ReturnValue.SUCCESS)
+
+    def fn_threads(self, msg, req):
+        counter = self.memory[:8].view(np.int64)
+        # One executor runs all local threads; serialise the shared add
+        with self._batch_lock:
+            counter[0] += msg.group_idx + 1
+        self.memory[512 * (1 + msg.group_idx)] = 200 + msg.group_idx
+        return int(ReturnValue.SUCCESS)
+
+    def fn_state(self, msg, req):
+        """Non-master host pulls a shared value, doubles one chunk and
+        pushes it back."""
+        state = self.scheduler.state
+        kv = state.get_kv("dist", "shared")
+        data = np.frombuffer(kv.get_chunk(0, 1024), dtype=np.uint8)
+        kv.set_chunk(0, (data * 2).astype(np.uint8).tobytes())
+        kv.push_partial()
+        msg.output_data = b"state-ok"
+        return int(ReturnValue.SUCCESS)
+
+
+class DistFactory(ExecutorFactory):
+    def create_executor(self, msg):
+        return DistExecutor(msg)
+
+
+def run_planner() -> None:
+    from faabric_tpu.planner import PlannerServer
+
+    server = PlannerServer(port_offset=0)
+    server.start()
+    print("READY", flush=True)
+    time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
+    server.stop()
+
+
+def run_worker(host: str) -> None:
+    from faabric_tpu.runner import WorkerRuntime
+
+    w = WorkerRuntime(host=host, slots=4, n_devices=4, factory=DistFactory(),
+                      planner_host="127.0.0.1")
+    w.start()
+    print("READY", flush=True)
+    time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
+    w.shutdown()
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    if role == "planner":
+        run_planner()
+    else:
+        run_worker(sys.argv[2])

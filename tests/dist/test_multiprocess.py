@@ -1,0 +1,159 @@
+"""Distributed tests: planner + two workers as REAL OS processes.
+
+The reference's analog is its two-container compose cluster
+(tests/dist, dist-test/run.sh). Every RPC here crosses process
+boundaries over loopback TCP — nothing shares memory with the test.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from faabric_tpu.proto import BatchExecuteType, ReturnValue, batch_exec_factory
+
+PROCS = os.path.join(os.path.dirname(__file__), "procs.py")
+ALIASES = "w1=127.0.0.1+10000,w2=127.0.0.1+13000,cli=127.0.0.1+16000"
+
+
+@pytest.fixture(scope="module")
+def dist_cluster():
+    """Planner + two worker processes; this process is the client host."""
+    env = dict(os.environ, FAABRIC_HOST_ALIASES=ALIASES, JAX_PLATFORMS="cpu")
+    procs = []
+
+    def spawn(*args):
+        p = subprocess.Popen([sys.executable, PROCS, *args],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True, env=env)
+        procs.append(p)
+        return p
+
+    planner = spawn("planner")
+    assert planner.stdout.readline().strip() == "READY"
+    w1 = spawn("worker", "w1")
+    w2 = spawn("worker", "w2")
+    for p in (w1, w2):
+        assert p.stdout.readline().strip() == "READY"
+
+    # This test process acts as a (0-slot) worker so result pushes land
+    from faabric_tpu.executor import ExecutorFactory
+    from faabric_tpu.runner import WorkerRuntime
+    from faabric_tpu.transport.common import clear_host_aliases
+
+    os.environ["FAABRIC_HOST_ALIASES"] = ALIASES
+    clear_host_aliases()  # force re-read of the env aliases
+
+    class NullFactory(ExecutorFactory):
+        def create_executor(self, msg):
+            raise RuntimeError("client runs nothing")
+
+    me = WorkerRuntime(host="cli", slots=0, factory=NullFactory(),
+                       planner_host="127.0.0.1")
+    me.start()
+
+    yield me
+
+    me.shutdown()
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    os.environ.pop("FAABRIC_HOST_ALIASES", None)
+    clear_host_aliases()
+
+
+def test_dist_function_batch(dist_cluster):
+    me = dist_cluster
+    req = batch_exec_factory("dist", "square", 8)
+    for i, m in enumerate(req.messages):
+        m.input_data = str(i + 2).encode()
+    decision = me.planner_client.call_functions(req)
+    assert sorted(set(decision.hosts)) == ["w1", "w2"]
+    for i, m in enumerate(req.messages):
+        r = me.planner_client.get_message_result(req.app_id, m.id,
+                                                 timeout=20.0)
+        assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+        assert int(r.output_data.decode()) == (i + 2) ** 2
+
+
+def test_dist_mpi_allreduce(dist_cluster):
+    me = dist_cluster
+    req = batch_exec_factory("dist", "mpi", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=40.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+    assert r.output_data == b"r0:28"  # sum of ranks 0..7
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        status = me.planner_client.get_batch_results(req.app_id)
+        if status.finished:
+            break
+        time.sleep(0.2)
+    assert status.finished and status.expected_num_messages == 8
+    hosts = {m.executed_host for m in status.message_results}
+    assert hosts == {"w1", "w2"}
+
+
+def test_dist_threads_snapshot_merge(dist_cluster):
+    from faabric_tpu.snapshot import (
+        SnapshotData,
+        SnapshotDataType,
+        SnapshotMergeOperation,
+    )
+
+    me = dist_cluster
+    base = np.zeros(16384, dtype=np.uint8)
+    base[:8].view(np.int64)[0] = 9000
+    snap = SnapshotData(base.tobytes())
+    snap.add_merge_region(0, 8, SnapshotDataType.LONG,
+                          SnapshotMergeOperation.SUM)
+    snap.fill_gaps_with_bytewise_regions()
+
+    n = 8
+    req = batch_exec_factory("dist", "threads", n)
+    req.type = int(BatchExecuteType.THREADS)
+    for i, m in enumerate(req.messages):
+        m.group_idx = i
+    key = f"dist/threads_{req.app_id}"
+    req.snapshot_key = key
+    me.snapshot_registry.register_snapshot(key, snap)
+
+    me.planner_client.call_functions(req)
+    for m in req.messages:
+        r = me.planner_client.get_message_result(req.app_id, m.id,
+                                                 timeout=20.0)
+        assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+
+    applied = snap.write_queued_diffs()
+    assert applied >= 2
+    merged = snap.data
+    assert merged[:8].view(np.int64)[0] == 9000 + sum(
+        i + 1 for i in range(n))
+    for i in range(n):
+        assert merged[512 * (1 + i)] == 200 + i
+
+
+def test_dist_state_pull_push(dist_cluster):
+    me = dist_cluster
+    # This (client) process is the state master
+    kv = me.state.get_kv("dist", "shared", 4096)
+    assert kv.is_master
+    kv.set(bytes([7]) * 4096)
+
+    req = batch_exec_factory("dist", "state", 1)
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=20.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+    # The remote worker pulled, doubled one chunk and pushed back
+    assert kv.get_chunk(0, 4) == bytes([14] * 4)

@@ -1,0 +1,363 @@
+"""Planner REST endpoint + migration / freeze / elasticity tests
+(reference: tests/test/planner/test_planner_endpoint.cpp and the §3.5
+migration flow)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from faabric_tpu.batch_scheduler import reset_batch_scheduler
+from faabric_tpu.endpoint import HttpMessageType, PlannerHttpEndpoint
+from faabric_tpu.executor import (
+    Executor,
+    ExecutorContext,
+    ExecutorFactory,
+    set_executor_factory,
+)
+from faabric_tpu.planner import PlannerServer, get_planner
+from faabric_tpu.proto import (
+    BatchExecuteType,
+    ReturnValue,
+    batch_exec_factory,
+)
+from faabric_tpu.runner import WorkerRuntime
+from faabric_tpu.transport.common import register_host_alias
+from faabric_tpu.util.network import get_free_port
+
+
+class GateExecutor(Executor):
+    """echo completes instantly; "gated" blocks on a class event, then
+    checks the planner's current decision for its idx — if its placement
+    moved, it raises the migration exception (reference §3.5 guests)."""
+
+    gate = threading.Event()
+    blocker_gate = threading.Event()
+    runs: list = []
+    _runs_lock = threading.Lock()
+
+    def execute_task(self, pool_idx, msg_idx, req):
+        from faabric_tpu.executor.executor import FunctionMigratedException
+
+        msg = req.messages[msg_idx]
+        if msg.function == "echo":
+            msg.output_data = msg.input_data[::-1]
+            return int(ReturnValue.SUCCESS)
+        if msg.function == "blocker":
+            # Holds its slot until the test releases it
+            assert type(self).blocker_gate.wait(20.0)
+            return int(ReturnValue.SUCCESS)
+
+        # "gated"
+        my_host = self.scheduler.host
+        if req.type == int(BatchExecuteType.MIGRATION):
+            # Post-migration re-sync: barrier on the NEW group with the
+            # rest of the gang (reference postMigrationHook §3.5)
+            self.scheduler.ptp_broker.post_migration_hook(msg.group_id,
+                                                          msg.group_idx)
+            with self._runs_lock:
+                type(self).runs.append(("migrated-run", msg.app_idx, my_host))
+            msg.output_data = f"migrated:{my_host}".encode()
+            return int(ReturnValue.SUCCESS)
+
+        with self._runs_lock:
+            type(self).runs.append(("first-run", msg.app_idx, my_host))
+        assert type(self).gate.wait(20.0)
+        decision = self.scheduler.planner_client.get_scheduling_decision(
+            msg.app_id)
+        if decision is None:
+            # App no longer in flight while we still run: spot-frozen —
+            # vacate (reference FunctionFrozenException flow, §3.5)
+            from faabric_tpu.executor.executor import FunctionFrozenException
+
+            with self._runs_lock:
+                type(self).runs.append(("frozen", msg.app_idx, my_host))
+            raise FunctionFrozenException()
+        if msg.app_idx in decision.app_idxs:
+            target = decision.hosts[decision.app_idxs.index(msg.app_idx)]
+            if target != my_host:
+                raise FunctionMigratedException()
+            if decision.group_id != msg.group_id:
+                # The app migrated around us: re-sync on the new group
+                idx = decision.group_idxs[decision.app_idxs.index(msg.app_idx)]
+                self.scheduler.ptp_broker.post_migration_hook(
+                    decision.group_id, idx)
+        msg.output_data = f"stayed:{my_host}".encode()
+        return int(ReturnValue.SUCCESS)
+
+
+class GateFactory(ExecutorFactory):
+    def create_executor(self, msg):
+        return GateExecutor(msg)
+
+
+@pytest.fixture
+def cluster():
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("planner", "127.0.0.1", base)
+    register_host_alias("hostA", "127.0.0.1", base + 1000)
+    register_host_alias("hostB", "127.0.0.1", base + 2000)
+
+    get_planner().reset()
+    reset_batch_scheduler("bin-pack")
+    planner_server = PlannerServer(port_offset=base)
+    planner_server.start()
+    set_executor_factory(GateFactory())
+    GateExecutor.gate.clear()
+    GateExecutor.blocker_gate.clear()
+    GateExecutor.runs = []
+
+    workers = {}
+    for name in ("hostA", "hostB"):
+        w = WorkerRuntime(host=name, slots=4, n_devices=4,
+                          planner_host="planner")
+        w.start()
+        workers[name] = w
+
+    yield workers
+
+    GateExecutor.gate.set()
+    GateExecutor.blocker_gate.set()
+    for w in workers.values():
+        w.shutdown()
+    planner_server.stop()
+    get_planner().reset()
+    reset_batch_scheduler()
+    set_executor_factory(None)
+
+
+# ---------------------------------------------------------------------------
+# Migration (reference §3.5)
+# ---------------------------------------------------------------------------
+
+def test_live_migration_improves_locality(cluster):
+    w = cluster["hostA"]
+    planner = get_planner()
+
+    # Blockers HOLD slots so the gated app must spread over both hosts:
+    # 2 msgs → hostB (tie broken ip-desc), then 3 msgs → hostA
+    blocker1 = batch_exec_factory("demo", "blocker", 2)
+    w.planner_client.call_functions(blocker1)
+    blocker2 = batch_exec_factory("demo", "blocker", 3)
+    w.planner_client.call_functions(blocker2)
+
+    # Gated app: 3 msgs on what's left → spread over both hosts
+    gated = batch_exec_factory("demo", "gated", 3)
+    d1 = w.planner_client.call_functions(gated)
+    assert len(set(d1.hosts)) == 2, d1.hosts
+    old_group = d1.group_id
+
+    # Wait until all first-runs started, then free the blockers' slots
+    deadline = time.time() + 10
+    while time.time() < deadline and sum(
+            1 for r in GateExecutor.runs if r[0] == "first-run") < 3:
+        time.sleep(0.05)
+    GateExecutor.blocker_gate.set()
+    for req in (blocker1, blocker2):
+        for m in req.messages:
+            w.planner_client.get_message_result(req.app_id, m.id, timeout=10.0)
+
+    # Blockers are gone: a migration check finds a single-host layout
+    decision = planner.check_migration(gated.app_id)
+    assert decision is not None
+    assert len(set(decision.hosts)) == 1
+    assert decision.group_id != old_group
+    assert planner.get_num_migrations() == 1
+
+    # Release the guests: moved ranks raise, get re-dispatched, and finish
+    # on the new host
+    GateExecutor.gate.set()
+    final_hosts = set()
+    for m in gated.messages:
+        result = w.planner_client.get_message_result(gated.app_id, m.id,
+                                                     timeout=15.0)
+        assert result.return_value == int(ReturnValue.SUCCESS), \
+            result.output_data
+        final_hosts.add(result.output_data.decode().split(":")[1])
+    # Everyone ended on the consolidated host
+    assert final_hosts == set(decision.hosts)
+    assert any(r[0] == "migrated-run" for r in GateExecutor.runs)
+
+    # No second migration opportunity
+    assert planner.check_migration(gated.app_id) is None
+
+
+def test_check_migration_no_op_when_placement_optimal(cluster):
+    w = cluster["hostA"]
+    req = batch_exec_factory("demo", "echo", 2)
+    w.planner_client.call_functions(req)
+    # Single-host placement: nothing to improve while in flight
+    assert get_planner().check_migration(req.app_id) in (None,)
+    for m in req.messages:
+        w.planner_client.get_message_result(req.app_id, m.id, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# Elastic scale-up (reference Planner.cpp:833-893)
+# ---------------------------------------------------------------------------
+
+def test_elastic_scale_hint_fills_main_host(cluster):
+    w = cluster["hostA"]
+    # The parent stays in flight (gated) while it forks
+    req = batch_exec_factory("demo", "gated", 1)
+    req.messages[0].main_host = "hostB"
+    d1 = w.planner_client.call_functions(req)
+    main_host = d1.hosts[0]
+    req.messages[0].main_host = main_host
+
+    # OpenMP-style fork: ask for 1, hint elastic → grows to the main
+    # host's free slots
+    scale = batch_exec_factory("demo", "echo", 1)
+    scale.app_id = req.app_id
+    scale.elastic_scale_hint = True
+    scale.messages[0].main_host = main_host
+    d = w.planner_client.call_functions(scale)
+    assert d.n_messages >= 3  # grew beyond the single requested message
+    GateExecutor.gate.set()
+    for m in scale.messages:
+        w.planner_client.get_message_result(req.app_id, m.id, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# REST endpoint
+# ---------------------------------------------------------------------------
+
+def post(port, http_type, payload=""):
+    body = json.dumps({"http_type": int(http_type),
+                       "payload": payload}).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/", data=body,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture
+def endpoint(cluster):
+    port = get_free_port()
+    ep = PlannerHttpEndpoint(port=port)
+    ep.start()
+    yield port
+    ep.stop()
+
+
+def test_rest_hosts_config_policy(cluster, endpoint):
+    status, out = post(endpoint, HttpMessageType.GET_AVAILABLE_HOSTS)
+    assert status == 200
+    assert {h["ip"] for h in out["hosts"]} == {"hostA", "hostB"}
+
+    status, out = post(endpoint, HttpMessageType.GET_CONFIG)
+    assert status == 200 and "hostTimeout" in out
+
+    status, out = post(endpoint, HttpMessageType.GET_POLICY)
+    assert out["policy"] == "bin-pack"
+    status, out = post(endpoint, HttpMessageType.SET_POLICY, "compact")
+    assert status == 200 and out["policy"] == "compact"
+    status, _ = post(endpoint, HttpMessageType.SET_POLICY, "nonsense")
+    assert status == 400
+    post(endpoint, HttpMessageType.SET_POLICY, "bin-pack")
+
+
+def test_rest_execute_batch_and_status(cluster, endpoint):
+    req = batch_exec_factory("demo", "echo", 4)
+    for m in req.messages:
+        m.input_data = b"abc"
+    status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH,
+                       json.dumps(req.to_dict()))
+    assert status == 200
+    assert out["appId"] == req.app_id
+    assert len(out["hosts"]) == 4
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH_STATUS,
+                           json.dumps({"app_id": req.app_id}))
+        if out.get("finished"):
+            break
+        time.sleep(0.1)
+    assert out["finished"]
+    assert len(out["messageResults"]) == 4
+    assert all(m["return_value"] == 0 for m in out["messageResults"])
+
+    # Exec graph for the first message
+    status, graph = post(
+        endpoint, HttpMessageType.GET_EXEC_GRAPH,
+        json.dumps({"app_id": req.app_id, "id": req.messages[0].id}))
+    assert status == 200
+    assert graph["root"]["msg"]["id"] == req.messages[0].id
+
+
+def test_rest_in_flight_and_evict(cluster, endpoint):
+    status, out = post(endpoint, HttpMessageType.GET_IN_FLIGHT_APPS)
+    assert status == 200
+    assert out["numMigrations"] == 0
+
+    status, out = post(endpoint, HttpMessageType.SET_NEXT_EVICTED_VM, "hostB")
+    assert status == 200 and out["nextEvictedVmIps"] == ["hostB"]
+    status, out = post(endpoint, HttpMessageType.GET_IN_FLIGHT_APPS)
+    assert out["nextEvictedVmIps"] == ["hostB"]
+
+    status, out = post(endpoint, HttpMessageType.FLUSH_SCHEDULING_STATE)
+    assert status == 200
+
+
+def test_rest_bad_requests(cluster, endpoint):
+    status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH, "{}")
+    assert status == 400
+    status, out = post(endpoint, 99)
+    assert status == 500 or status == 400
+
+
+# ---------------------------------------------------------------------------
+# Spot freeze / thaw through the policy
+# ---------------------------------------------------------------------------
+
+def test_spot_freeze_and_thaw(cluster):
+    w = cluster["hostA"]
+    planner = get_planner()
+    reset_batch_scheduler("spot")
+    try:
+        # Fill BOTH hosts so an eviction has nowhere to move the app
+        gated = batch_exec_factory("demo", "gated", 8)
+        d = w.planner_client.call_functions(gated)
+        assert len(set(d.hosts)) == 2
+
+        planner.set_next_evicted_host_ips(["hostB"])
+        decision = planner.check_migration(gated.app_id)
+        from faabric_tpu.batch_scheduler.decision import MUST_FREEZE
+
+        assert decision is not None and decision.app_id == MUST_FREEZE
+        assert gated.app_id in planner.get_frozen_apps()
+        # Resources released
+        assert all(h.used_slots == 0
+                   for h in planner.get_available_hosts())
+
+        # Release the original guests: they observe the app is gone from
+        # the in-flight set and vacate with the frozen exception
+        GateExecutor.gate.set()
+        deadline = time.time() + 10
+        while time.time() < deadline and sum(
+                1 for r in GateExecutor.runs if r[0] == "frozen") < 8:
+            time.sleep(0.05)
+        assert sum(1 for r in GateExecutor.runs if r[0] == "frozen") == 8
+
+        # Thaw: eviction cleared, a NEW request for the app resumes it
+        # whole; re-dispatched guests see the app in flight and complete
+        planner.set_next_evicted_host_ips([])
+        thaw = batch_exec_factory("demo", "gated", 1)
+        thaw.app_id = gated.app_id
+        d2 = w.planner_client.call_functions(thaw)
+        assert d2.n_messages == 8  # the parked request came back whole
+        assert gated.app_id not in planner.get_frozen_apps()
+        for mid in d2.message_ids:
+            result = w.planner_client.get_message_result(gated.app_id, mid,
+                                                         timeout=15.0)
+            assert result.return_value == int(ReturnValue.SUCCESS)
+    finally:
+        reset_batch_scheduler("bin-pack")
