@@ -42,6 +42,12 @@ class ModelConfig:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "reference" = plain jnp attention; "flash" = the Pallas fused kernel
+    # (ops/flash_attention.py) — identical numerics, no (S, S) scores in HBM
+    attention_impl: str = "reference"
+    # "reference" = inline jnp RMS norm; "fused" = the Pallas kernel
+    # (ops/rms_norm.py)
+    norm_impl: str = "reference"
 
     @property
     def head_dim(self) -> int:
@@ -138,19 +144,32 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _norm(x: jax.Array, scale: jax.Array, cfg: ModelConfig) -> jax.Array:
+    if cfg.norm_impl == "fused":
+        from faabric_tpu.ops.rms_norm import rms_norm
+
+        return rms_norm(x, scale)
+    return _rms_norm(x, scale)
+
+
 def _block(x: jax.Array, blk: dict, positions: jax.Array,
            cfg: ModelConfig) -> jax.Array:
-    h = _rms_norm(x, blk["ln1"])
+    h = _norm(x, blk["ln1"], cfg)
     qkv = jnp.einsum("bsd,dthe->tbshe", h,
                      blk["wqkv"].astype(cfg.compute_dtype))
     q, k, v = qkv[0], qkv[1], qkv[2]
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v)
+    if cfg.attention_impl == "flash":
+        from faabric_tpu.ops.flash_attention import flash_attention
+
+        attn = flash_attention(q, k, v, True)
+    else:
+        attn = _attention(q, k, v)
     x = x + jnp.einsum("bshe,hed->bsd", attn,
                        blk["wo"].astype(cfg.compute_dtype))
 
-    h = _rms_norm(x, blk["ln2"])
+    h = _norm(x, blk["ln2"], cfg)
     ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
     return x + ff @ blk["w2"].astype(cfg.compute_dtype)
 
@@ -164,6 +183,15 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
                 x, NamedSharding(mesh, P(*spec)))
         return x
 
+    # The Pallas flash path is single-stream: under a mesh the activations
+    # arrive tp/sp-sharded and a bare pallas_call has no partitioning rule,
+    # so sharded runs keep the reference attention (XLA shards its einsums
+    # natively; a shard_mapped flash kernel is a later optimisation).
+    if mesh is not None and (cfg.attention_impl == "flash"
+                             or cfg.norm_impl == "fused"):
+        cfg = dataclasses.replace(cfg, attention_impl="reference",
+                                  norm_impl="reference")
+
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
@@ -176,7 +204,7 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
         x = block_fn(x, blk, positions, cfg)
         x = maybe_constrain(x, "dp", "sp", None)
 
-    x = _rms_norm(x, params["ln_f"])
+    x = _norm(x, params["ln_f"], cfg)
     logits = x @ params["lm_head"].astype(cfg.compute_dtype)
     return maybe_constrain(logits.astype(jnp.float32), "dp", "sp", None)
 

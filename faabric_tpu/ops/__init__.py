@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the hot device ops."""
+
+from faabric_tpu.ops.flash_attention import flash_attention
+from faabric_tpu.ops.rms_norm import rms_norm
+
+__all__ = ["flash_attention", "rms_norm"]

@@ -1,0 +1,158 @@
+"""Fused causal flash attention as a Pallas TPU kernel.
+
+The hot op of the flagship model, written for the memory hierarchy: per
+(batch·head, q-block) grid step the Q tile sits in VMEM while the kernel
+streams K/V blocks with the online-softmax recurrence — no (S, S) score
+matrix ever materialises in HBM. fp32 running max/sum/accumulator, compute
+in the input dtype on the MXU.
+
+Training support comes from a custom VJP whose backward recomputes through
+the reference jnp attention (flash-backward kernels are a later
+optimisation); forward inference/benchmarks run the kernel.
+
+On CPU (tests) the kernel runs in interpreter mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, causal: bool = True):
+    """Plain jnp attention (the model's _attention twin) — used for the
+    backward pass and for numerics tests."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  causal_offset: int):
+    """One grid step: one (batch·head, q-block). Refs (leading singleton is
+    the folded batch·head block): q (1, block_q, d), k/v (1, s_k, d).
+    ``causal_offset`` end-aligns the mask when s_k > s_q (query row i may
+    see keys up to i + offset) — matching the reference's tril(k=s_k-s_q).
+
+    Matmul operands stay in the input dtype (bf16 rides the MXU at full
+    rate, accumulating in fp32 via preferred_element_type); only the
+    softmax statistics and the accumulator live in fp32."""
+    _, block_q, d = q_ref.shape
+    s_k = k_ref.shape[1]
+    n_k_blocks = s_k // block_k
+
+    q_idx = pl.program_id(1)
+    q_off = q_idx * block_q
+
+    q = q_ref[0]
+    scale = 1.0 / np.sqrt(d)
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
+
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = q_off + causal_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new[:, None])
+        l_new = l_prev * correction + jnp.sum(p, axis=1)
+        acc = acc * correction[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    if causal:
+        # Blocks strictly above the (offset) diagonal contribute nothing
+        n_blocks = jnp.minimum(
+            n_k_blocks,
+            (q_off + causal_offset + block_q + block_k - 1) // block_k)
+    else:
+        n_blocks = n_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Causal attention, (B, S, H, D) → (B, S, H, D)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        # Ragged shapes fall back to the reference path
+        return _reference_attention(q, k, v, causal)
+
+    # Fold (B, H) into the grid's first axis; kernel sees 2-D tiles
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+
+    interpret = jax.default_backend() == "cpu"
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                               causal_offset=s_k - s_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    out = _flash_forward(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    # Recompute-through-reference backward: numerically matches the
+    # kernel's forward (same softmax), costs one extra forward
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,76 @@
+"""Fused RMS-norm Pallas kernel.
+
+One VMEM pass: mean-square, rsqrt and scale fuse into a single kernel
+instead of the separate reductions + elementwise XLA would otherwise
+schedule through HBM for large rows. fp32 statistics regardless of input
+dtype (matches the model's _rms_norm semantics). Differentiable via
+recompute-through-reference VJP.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_ROWS = 128
+
+
+def _reference_rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _rms_kernel(x_ref, scale_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    normed = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (normed * scale_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, scale, eps: float = 1e-6):
+    """x (..., D), scale (D,) → same shape as x."""
+    return _rms_forward(x, scale, eps)
+
+
+def _rms_forward(x, scale, eps):
+    import math
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = math.prod(orig_shape[:-1]) if len(orig_shape) > 1 else 1
+    flat = x.reshape(rows, d)
+
+    block = min(DEFAULT_BLOCK_ROWS, rows)
+    if rows % block:
+        return _reference_rms_norm(x, scale, eps)
+
+    interpret = jax.default_backend() == "cpu"
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(flat, scale)
+    return out.reshape(orig_shape)
+
+
+def _rms_fwd(x, scale, eps):
+    return _rms_forward(x, scale, eps), (x, scale)
+
+
+def _rms_bwd(eps, res, g):
+    x, scale = res
+    _, vjp = jax.vjp(lambda x, s: _reference_rms_norm(x, s, eps), x, scale)
+    return vjp(g)
+
+
+rms_norm.defvjp(_rms_fwd, _rms_bwd)

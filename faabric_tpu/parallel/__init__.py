@@ -19,3 +19,10 @@ from faabric_tpu.parallel.mesh import (  # noqa: E402
 
 __all__ += ["MeshConfig", "build_mesh", "constraint", "mesh_from_group",
             "named", "replicated"]
+
+from faabric_tpu.parallel.ring_attention import (  # noqa: E402
+    ring_attention,
+    shard_sequence,
+)
+
+__all__ += ["ring_attention", "shard_sequence"]

@@ -1,0 +1,127 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+Long-context first-class: for sequences too large for one chip's HBM, Q/K/V
+shard along the sequence over the ``sp`` mesh axis. Each device keeps its Q
+shard resident and the K/V shards rotate around the ring with
+``jax.lax.ppermute`` — ICI neighbour hops — while an online-softmax
+accumulator (running max / sum / weighted values, all fp32) folds each
+block in. Communication overlaps compute in XLA's pipeline; the full
+(S, S) score matrix never exists anywhere.
+
+This is the sequence-parallel analog of the reference's "scale memory
+beyond one host" capability (SURVEY §5.7); same recurrence as the Pallas
+flash kernel (ops/flash_attention.py), one level up the hierarchy.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _mark_varying(x, axis: str):
+    """Tag a locally-built array as device-varying over the ring axis (the
+    fori_loop carry types must match its ppermute'd outputs). API moved
+    pvary → pcast(to='varying') across JAX versions."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover — older JAX
+        return jax.lax.pvary(x, (axis,))
+    return x  # pragma: no cover — oldest JAX has no varying check
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = True):
+    """q/k/v (B, S, H, D) sharded (B, S/axis, H, D); returns same sharding.
+
+    Within each rotation step, device i holds Q block i and K/V block
+    ((i - step) mod n); causal masking uses the blocks' global positions,
+    so fully-masked future blocks contribute nothing.
+    """
+    n = mesh.shape[axis]
+    if n == 1:
+        from faabric_tpu.ops.flash_attention import _reference_attention
+
+        return _reference_attention(q, k, v, causal)
+    return _compiled_ring(mesh, axis, causal)(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_ring(mesh: Mesh, axis: str, causal: bool):
+    """One jitted shard_map per (mesh, axis, causal) — eager callers must
+    hit the jit cache, not retrace per invocation."""
+    n = mesh.shape[axis]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # shapes (B, S_l, H, D)
+        s_l = q_blk.shape[1]
+        my_idx = jax.lax.axis_index(axis)
+        scale = 1.0 / np.sqrt(q_blk.shape[-1])
+        qf = q_blk.astype(jnp.float32) * scale
+
+        b, _, h, d = q_blk.shape
+        m0 = jnp.full((b, h, s_l), NEG_INF, dtype=jnp.float32)
+        l0 = jnp.zeros((b, h, s_l), dtype=jnp.float32)
+        acc0 = jnp.zeros((b, s_l, h, d), dtype=jnp.float32)
+        m0, l0, acc0 = (_mark_varying(x, axis) for x in (m0, l0, acc0))
+
+        def step(i, carry):
+            m_prev, l_prev, acc, k_cur, v_cur = carry
+            kv_idx = (my_idx - i) % n
+
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                                k_cur.astype(jnp.float32))
+            if causal:
+                q_pos = my_idx * s_l + jax.lax.broadcasted_iota(
+                    jnp.int32, (s_l, s_l), 0)
+                k_pos = kv_idx * s_l + jax.lax.broadcasted_iota(
+                    jnp.int32, (s_l, s_l), 1)
+                mask = q_pos >= k_pos
+                scores = jnp.where(mask[None, None], scores, NEG_INF)
+
+            m_cur = jnp.max(scores, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            correction = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new[..., None])
+            l_new = l_prev * correction + jnp.sum(p, axis=-1)
+            acc_new = acc * correction.transpose(0, 2, 1)[..., None] \
+                + jnp.einsum("bhqk,bkhd->bqhd", p,
+                             v_cur.astype(jnp.float32))
+
+            # Rotate K/V to the next ring neighbour (ICI hop)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return m_new, l_new, acc_new, k_nxt, v_nxt
+
+        m, l, acc, _, _ = jax.lax.fori_loop(
+            0, n, step, (m0, l0, acc0, k_blk, v_blk))
+        # Guard fully-masked rows (l == 0 cannot happen causally for row 0
+        # of block 0 since the diagonal is unmasked, but stay safe)
+        l = jnp.maximum(l, 1e-30)
+        out = acc / l.transpose(0, 2, 1)[..., None]
+        return out.astype(q_blk.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.jit(shard_map(local_fn, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec))
+
+
+def shard_sequence(x, mesh: Mesh, axis: str = "sp"):
+    """Place (B, S, ...) with S sharded over the axis."""
+    spec = [None] * x.ndim
+    spec[1] = axis
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

@@ -1,0 +1,175 @@
+"""Pallas kernels + ring attention, all checked against reference
+numerics. Kernels run in interpreter mode on the CPU test mesh; on real
+hardware the identical code compiles for the MXU/VMEM."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from faabric_tpu.ops import flash_attention, rms_norm
+from faabric_tpu.ops.flash_attention import _reference_attention
+from faabric_tpu.ops.rms_norm import _reference_rms_norm
+from faabric_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    ring_attention,
+    shard_sequence,
+)
+
+
+def qkv(b=2, s=256, h=4, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+                 for _ in range(3))
+
+
+# ---------------------------------------------------------------------------
+# Flash attention
+# ---------------------------------------------------------------------------
+
+def test_flash_attention_matches_reference_causal():
+    q, k, v = qkv()
+    out = flash_attention(q, k, v)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_non_causal():
+    q, k, v = qkv(s=128)
+    out = flash_attention(q, k, v, False)
+    ref = _reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_gradients():
+    q, k, v = qkv(b=1, s=128, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_flash_attention_ragged_shape_falls_back():
+    q, k, v = qkv(s=100)  # not divisible by any block size
+    out = flash_attention(q, k, v)
+    ref = _reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_model_flash_attention_impl_matches_reference():
+    from faabric_tpu.models import ModelConfig, forward, init_params
+
+    cfg_ref = ModelConfig(vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                          d_ff=128, max_seq=128,
+                          compute_dtype=jnp.float32)
+    cfg_flash = ModelConfig(vocab_size=128, d_model=64, n_layers=2,
+                            n_heads=4, d_ff=128, max_seq=128,
+                            compute_dtype=jnp.float32,
+                            attention_impl="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg_ref)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 128)), dtype=jnp.int32)
+    ref = forward(params, tokens, cfg_ref)
+    out = forward(params, tokens, cfg_flash)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# RMS norm
+# ---------------------------------------------------------------------------
+
+def test_rms_norm_matches_reference():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 128, 64), dtype=jnp.float32)
+    scale = jnp.asarray(rng.rand(64), dtype=jnp.float32)
+    out = rms_norm(x, scale)
+    ref = _reference_rms_norm(x, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_rms_norm_gradients():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 128, 32), dtype=jnp.float32)
+    scale = jnp.asarray(rng.rand(32), dtype=jnp.float32)
+    g1 = jax.grad(lambda x, s: jnp.sum(rms_norm(x, s) ** 2),
+                  argnums=(0, 1))(x, scale)
+    g2 = jax.grad(lambda x, s: jnp.sum(_reference_rms_norm(x, s) ** 2),
+                  argnums=(0, 1))(x, scale)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over the sp axis)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_attention_matches_reference(sp):
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=8 // sp, sp=sp))
+    q, k, v = qkv(b=2, s=512, h=4, d=32)
+    qs, ks, vs = (shard_sequence(x, mesh) for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_non_causal():
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=2, sp=4))
+    q, k, v = qkv(b=1, s=256, h=2, d=16, seed=3)
+    qs, ks, vs = (shard_sequence(x, mesh) for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, causal=False)
+    ref = _reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_single_device_axis():
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=8, sp=1))
+    q, k, v = qkv(b=1, s=64, h=2, d=16)
+    out = ring_attention(q, k, v, mesh)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_model_fused_norm_matches_reference():
+    from faabric_tpu.models import ModelConfig, forward, init_params
+
+    kw = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+              max_seq=128, compute_dtype=jnp.float32)
+    cfg_ref = ModelConfig(**kw)
+    cfg_fused = ModelConfig(**kw, norm_impl="fused")
+    params = init_params(jax.random.PRNGKey(0), cfg_ref)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 128)), dtype=jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(forward(params, tokens, cfg_fused)),
+        np.asarray(forward(params, tokens, cfg_ref)), atol=2e-3)
+
+
+def test_flash_cross_length_causal():
+    """s_k > s_q end-aligns the causal mask (tril k=s_k-s_q), matching the
+    reference and the recompute backward."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 128, 2, 32), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(1, 256, 2, 32), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(1, 256, 2, 32), dtype=jnp.float32)
+    out = flash_attention(q, k, v)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_cached_compilation():
+    from faabric_tpu.parallel.ring_attention import _compiled_ring
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=2, sp=4))
+    f1 = _compiled_ring(mesh, "sp", True)
+    f2 = _compiled_ring(mesh, "sp", True)
+    assert f1 is f2  # eager callers hit the jit cache
