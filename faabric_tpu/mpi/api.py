@@ -1,0 +1,226 @@
+"""Guest-facing MPI API.
+
+Reference analog: the extern-C MPI subset in include/faabric/mpi/mpi.h
+(597 lines) and the mpi_native shim that implements it over MpiWorld for
+native runs (tests/dist/mpi/native/mpi_native.cpp) — the same shim pattern
+Faasm uses from WASM. Guest code written against this module runs unchanged
+whether its world spans threads, hosts, or (via device_collectives) chips.
+
+Thread-local binding: ``mpi_init()`` inside an executor task creates or
+joins the task's world from its message; every call after that uses the
+calling thread's (world, rank).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from faabric_tpu.mpi.types import MpiOp, MpiStatus
+from faabric_tpu.mpi.world import MpiWorld
+
+MPI_COMM_WORLD = "MPI_COMM_WORLD"
+MPI_SUCCESS = 0
+
+# Re-exported op constants (reference faabric_op_t singletons)
+MPI_MAX = MpiOp.MAX
+MPI_MIN = MpiOp.MIN
+MPI_SUM = MpiOp.SUM
+MPI_PROD = MpiOp.PROD
+MPI_LAND = MpiOp.LAND
+MPI_LOR = MpiOp.LOR
+MPI_BAND = MpiOp.BAND
+MPI_BOR = MpiOp.BOR
+
+_tls = threading.local()
+
+
+class MpiError(Exception):
+    pass
+
+
+def _bind(world: MpiWorld, rank: int) -> None:
+    _tls.world = world
+    _tls.rank = rank
+    _tls.start_time = time.monotonic()
+
+
+def _current() -> tuple[MpiWorld, int]:
+    world = getattr(_tls, "world", None)
+    if world is None:
+        raise MpiError("MPI not initialised on this thread (call mpi_init)")
+    return world, _tls.rank
+
+
+def mpi_init(world_size: int | None = None, world_id: int | None = None) -> int:
+    """MPI_Init: bind this thread to its task's world — rank 0 creates it
+    (chaining the other ranks through the planner), others join."""
+    from faabric_tpu.mpi.registry import get_mpi_context
+
+    ctx = get_mpi_context()
+    from faabric_tpu.executor.context import ExecutorContext
+
+    msg = ExecutorContext.get().msg
+    if msg.mpi_rank == 0 and not msg.is_mpi:
+        msg.is_mpi = True
+        if world_id is not None:
+            msg.mpi_world_id = world_id
+        if world_size is not None:
+            msg.mpi_world_size = world_size
+        world = ctx.create_world(msg)
+    else:
+        world = ctx.join_world(msg)
+    world.refresh_rank_hosts()
+    _bind(world, msg.mpi_rank)
+    return MPI_SUCCESS
+
+
+def mpi_initialized() -> bool:
+    return getattr(_tls, "world", None) is not None
+
+
+def mpi_finalize() -> int:
+    _tls.world = None
+    return MPI_SUCCESS
+
+
+def mpi_abort(comm=MPI_COMM_WORLD, errorcode: int = 1) -> None:
+    raise MpiError(f"MPI_Abort with code {errorcode}")
+
+
+# ---------------------------------------------------------------------------
+# Introspection
+# ---------------------------------------------------------------------------
+
+def mpi_comm_rank(comm=MPI_COMM_WORLD) -> int:
+    return _current()[1]
+
+
+def mpi_comm_size(comm=MPI_COMM_WORLD) -> int:
+    return _current()[0].size
+
+
+def mpi_wtime() -> float:
+    return time.monotonic()
+
+
+def mpi_get_processor_name() -> str:
+    world, rank = _current()
+    return world.host_for_rank(rank)
+
+
+# ---------------------------------------------------------------------------
+# Point-to-point
+# ---------------------------------------------------------------------------
+
+def mpi_send(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
+    world, rank = _current()
+    world.send(rank, dest, np.asarray(buf))
+    return MPI_SUCCESS
+
+
+def mpi_recv(source: int, comm=MPI_COMM_WORLD
+             ) -> tuple[np.ndarray, MpiStatus]:
+    world, rank = _current()
+    return world.recv(source, rank)
+
+
+def mpi_sendrecv(sendbuf, dest: int, source: int, comm=MPI_COMM_WORLD
+                 ) -> tuple[np.ndarray, MpiStatus]:
+    world, rank = _current()
+    return world.sendrecv(np.asarray(sendbuf), rank, dest, source, rank)
+
+
+def mpi_isend(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
+    world, rank = _current()
+    return world.isend(rank, dest, np.asarray(buf))
+
+
+def mpi_irecv(source: int, comm=MPI_COMM_WORLD) -> int:
+    world, rank = _current()
+    return world.irecv(source, rank)
+
+
+def mpi_wait(request: int) -> Optional[tuple[np.ndarray, MpiStatus]]:
+    world, rank = _current()
+    return world.await_async(rank, request)
+
+
+# ---------------------------------------------------------------------------
+# Collectives
+# ---------------------------------------------------------------------------
+
+def mpi_barrier(comm=MPI_COMM_WORLD) -> int:
+    world, rank = _current()
+    world.barrier(rank)
+    return MPI_SUCCESS
+
+
+def mpi_bcast(buf, root: int, comm=MPI_COMM_WORLD) -> np.ndarray:
+    world, rank = _current()
+    return world.broadcast(root, rank,
+                           np.asarray(buf) if buf is not None else np.empty(0))
+
+
+def mpi_scatter(sendbuf, recv_count: int, root: int,
+                comm=MPI_COMM_WORLD) -> np.ndarray:
+    world, rank = _current()
+    return world.scatter(root, rank,
+                         np.asarray(sendbuf) if sendbuf is not None
+                         else np.empty(0), recv_count)
+
+
+def mpi_gather(sendbuf, root: int, comm=MPI_COMM_WORLD
+               ) -> Optional[np.ndarray]:
+    world, rank = _current()
+    return world.gather(rank, root, np.asarray(sendbuf))
+
+
+def mpi_allgather(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
+    world, rank = _current()
+    return world.allgather(rank, np.asarray(sendbuf))
+
+
+def mpi_reduce(sendbuf, op: MpiOp, root: int, comm=MPI_COMM_WORLD
+               ) -> Optional[np.ndarray]:
+    world, rank = _current()
+    return world.reduce(rank, root, np.asarray(sendbuf), op)
+
+
+def mpi_allreduce(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD) -> np.ndarray:
+    world, rank = _current()
+    return world.allreduce(rank, np.asarray(sendbuf), op)
+
+
+def mpi_scan(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD) -> np.ndarray:
+    world, rank = _current()
+    return world.scan(rank, np.asarray(sendbuf), op)
+
+
+def mpi_alltoall(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
+    world, rank = _current()
+    return world.alltoall(rank, np.asarray(sendbuf))
+
+
+# ---------------------------------------------------------------------------
+# Cartesian topology (reference MPI_Cart_*)
+# ---------------------------------------------------------------------------
+
+def mpi_cart_get(comm=MPI_COMM_WORLD) -> tuple[tuple[int, int],
+                                               tuple[int, int]]:
+    world, rank = _current()
+    return world.cart_dims(), world.cart_coords(rank)
+
+
+def mpi_cart_rank(coords: tuple[int, int], comm=MPI_COMM_WORLD) -> int:
+    world, _ = _current()
+    return world.cart_rank(coords)
+
+
+def mpi_cart_shift(direction: int, disp: int, comm=MPI_COMM_WORLD
+                   ) -> tuple[int, int]:
+    world, rank = _current()
+    return world.cart_shift(rank, direction, disp)

@@ -45,6 +45,25 @@ logger = get_logger(__name__)
 MAIN_RANK = 0
 
 
+class _LocalMpiPayload:
+    """Same-host MPI message: the array object itself rides the queue.
+    ``shared`` marks fan-out buffers delivered to several receivers (a
+    consumer must copy before exposing them writable)."""
+
+    __slots__ = ("msg_type", "data", "shared")
+
+    def __init__(self, msg_type: MpiMessageType, data: np.ndarray,
+                 shared: bool = False) -> None:
+        self.msg_type = msg_type
+        self.data = data
+        self.shared = shared
+
+    def to_bytes(self) -> bytes:
+        """Late wire conversion if routing sends this remote after all
+        (e.g. a live-migration remap between send and delivery)."""
+        return pack_mpi_payload(self.msg_type, self.data)
+
+
 class MpiWorld:
     def __init__(self, broker, world_id: int, size: int, group_id: int,
                  user: str = "", function: str = "") -> None:
@@ -135,22 +154,70 @@ class MpiWorld:
     # ------------------------------------------------------------------
     def send(self, send_rank: int, recv_rank: int, data: np.ndarray,
              msg_type: MpiMessageType = MpiMessageType.NORMAL,
-             request_id: int = 0) -> None:
-        payload = pack_mpi_payload(msg_type, np.asarray(data), request_id)
+             request_id: int = 0, _copy: bool = True) -> None:
+        """``_copy=False`` is for fan-out callers that already hold an
+        immutable private buffer (broadcast trees) — skips the per-receiver
+        defensive copy."""
         if self.record_exec_graph:
             with self._lock:
                 self._msg_count_to_rank[recv_rank] = \
                     self._msg_count_to_rank.get(recv_rank, 0) + 1
                 key = (int(msg_type), recv_rank)
                 self._msg_type_count[key] = self._msg_type_count.get(key, 0) + 1
+
+        # Same-host ranks skip serialization entirely: one defensive copy
+        # (MPI semantics: the sender may reuse its buffer immediately) rides
+        # the in-process queue as an array object — the analog of the
+        # reference's malloc+memcpy onto the InMemoryMpiQueue
+        # (MpiWorld.cpp:620-634), minus the wire pack/unpack copies.
+        self.broker.wait_for_mappings(self.group_id)
+        if self.broker.get_host_for_receiver(self.group_id, recv_rank) \
+                == self.broker.host:
+            arr = np.asarray(data)
+            if _copy:
+                arr = arr.copy()
+            arr.flags.writeable = False
+            payload = _LocalMpiPayload(msg_type, arr, shared=not _copy)
+        else:
+            payload = pack_mpi_payload(msg_type, np.asarray(data), request_id)
         self.broker.send_message(self.group_id, send_rank, recv_rank,
                                  payload, must_order=True)
 
-    def recv(self, send_rank: int, recv_rank: int,
-             timeout: float | None = None) -> tuple[np.ndarray, MpiStatus]:
+    def _recv_raw(self, send_rank: int, recv_rank: int,
+                  timeout: float | None = None
+                  ) -> tuple[np.ndarray, MpiStatus]:
+        """Internal receive: the array may be read-only / shared (zero-copy
+        local path). Collectives use this — they never mutate received
+        buffers in place."""
         raw = self.broker.recv_message(self.group_id, send_rank, recv_rank,
                                        must_order=True, timeout=timeout)
-        msg_type, arr, _req = unpack_mpi_payload(raw)
+        if isinstance(raw, _LocalMpiPayload):
+            arr = raw.data
+        else:
+            _, arr, _req = unpack_mpi_payload(raw)
+        status = MpiStatus(source=send_rank, count=arr.size,
+                           dtype=int(mpi_dtype_for(arr.dtype)))
+        return arr, status
+
+    def recv(self, send_rank: int, recv_rank: int,
+             timeout: float | None = None) -> tuple[np.ndarray, MpiStatus]:
+        """Public receive: the returned buffer is caller-owned and
+        writable (MPI semantics)."""
+        raw = self.broker.recv_message(self.group_id, send_rank, recv_rank,
+                                       must_order=True, timeout=timeout)
+        if isinstance(raw, _LocalMpiPayload):
+            arr = raw.data
+            if raw.shared:
+                arr = arr.copy()  # several receivers hold this buffer
+            elif not arr.flags.writeable:
+                try:
+                    # Exclusively ours (the sender's private copy): flip the
+                    # owning array back to writable, no copy
+                    arr.flags.writeable = True
+                except ValueError:
+                    arr = arr.copy()
+        else:
+            _, arr, _req = unpack_mpi_payload(raw)
         status = MpiStatus(source=send_rank, count=arr.size,
                            dtype=int(mpi_dtype_for(arr.dtype)))
         return arr, status
@@ -218,24 +285,28 @@ class MpiWorld:
         root_host = self.host_for_rank(send_rank)
 
         if recv_rank == send_rank:
+            shared = np.array(data, copy=True)  # one copy for the fan-out
             for host in self.hosts():
                 if host == root_host:
                     for r in self.ranks_on_host(host):
                         if r != send_rank:
-                            self.send(send_rank, r, data,
-                                      MpiMessageType.BROADCAST)
+                            self.send(send_rank, r, shared,
+                                      MpiMessageType.BROADCAST, _copy=False)
                 else:
-                    self.send(send_rank, self.local_leader(host), data,
-                              MpiMessageType.BROADCAST)
+                    self.send(send_rank, self.local_leader(host), shared,
+                              MpiMessageType.BROADCAST, _copy=False)
             return np.asarray(data)
 
         leader = self.local_leader(my_host)
         if my_host != root_host and recv_rank == leader:
-            arr, _ = self.recv(send_rank, recv_rank)
+            arr, _ = self._recv_raw(send_rank, recv_rank)
+            # Fan the (read-only) buffer out zero-copy, but hand the caller
+            # its own writable copy — the fan-out shares this memory
             for r in self.ranks_on_host(my_host):
                 if r != recv_rank:
-                    self.send(recv_rank, r, arr, MpiMessageType.BROADCAST)
-            return arr
+                    self.send(recv_rank, r, arr, MpiMessageType.BROADCAST,
+                              _copy=False)
+            return arr.copy()
         src = send_rank if my_host == root_host else leader
         arr, _ = self.recv(src, recv_rank)
         return arr
@@ -254,12 +325,12 @@ class MpiWorld:
             # Local ranks send directly (root acts as its host's sink)
             for r in self.ranks_on_host(root_host):
                 if r != root:
-                    arr, _ = self.recv(r, root)
+                    arr, _ = self._recv_raw(r, root)
                     acc = apply_op(op, acc, arr)
             # One partial result per remote host
             for host in self.hosts():
                 if host != root_host:
-                    arr, _ = self.recv(self.local_leader(host), root)
+                    arr, _ = self._recv_raw(self.local_leader(host), root)
                     acc = apply_op(op, acc, arr)
             return acc
 
@@ -272,7 +343,7 @@ class MpiWorld:
             acc = data.copy()
             for r in self.ranks_on_host(my_host):
                 if r != rank:
-                    arr, _ = self.recv(r, rank)
+                    arr, _ = self._recv_raw(r, rank)
                     acc = apply_op(op, acc, arr)
             self.send(rank, root, acc, MpiMessageType.REDUCE)
             return None

@@ -114,8 +114,10 @@ def _flash_forward(q, k, v, causal, block_q, block_k):
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
-    if s_q % block_q or s_k % block_k:
-        # Ragged shapes fall back to the reference path
+    if s_q % block_q or s_k % block_k or (causal and s_q > s_k):
+        # Ragged shapes — and the degenerate causal s_q > s_k case, where
+        # fully-masked query rows need the reference's uniform-softmax
+        # treatment rather than a 0/0 accumulator — use the reference path
         return _reference_attention(q, k, v, causal)
 
     # Fold (B, H) into the grid's first axis; kernel sees 2-D tiles

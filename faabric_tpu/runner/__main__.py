@@ -1,0 +1,76 @@
+"""Deployment entry points — the planner_server / worker binaries analog
+(reference src/planner/planner_server.cpp:9-43, src/runner/FaabricMain.cpp).
+
+    python -m faabric_tpu.runner planner [--port-offset N] [--http-port P]
+    python -m faabric_tpu.runner worker --host IP [--slots N] [--devices N]
+
+The planner role serves RPC + its snapshot server + the REST endpoint; the
+worker boots a full WorkerRuntime (function/PTP/snapshot/state servers,
+keep-alive registration). Both run until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from faabric_tpu.util.crash import install_crash_handler
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger("faabric_tpu.runner")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="faabric_tpu.runner")
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    p_planner = sub.add_parser("planner")
+    p_planner.add_argument("--port-offset", type=int, default=0)
+    p_planner.add_argument("--http-port", type=int, default=0,
+                           help="REST endpoint port (0 = config default)")
+
+    p_worker = sub.add_parser("worker")
+    p_worker.add_argument("--host", default="",
+                          help="this worker's identity (default: primary IP)")
+    p_worker.add_argument("--slots", type=int, default=0)
+    p_worker.add_argument("--devices", type=int, default=0)
+    p_worker.add_argument("--planner-host", default=None)
+
+    args = parser.parse_args(argv)
+    install_crash_handler()
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+
+    if args.role == "planner":
+        from faabric_tpu.endpoint import PlannerHttpEndpoint
+        from faabric_tpu.planner import PlannerServer
+
+        server = PlannerServer(port_offset=args.port_offset)
+        server.start()
+        endpoint = PlannerHttpEndpoint(
+            port=args.http_port or None)
+        endpoint.start()
+        logger.info("Planner up (rpc offset %d, http :%d)", args.port_offset,
+                    endpoint.port)
+        stop.wait()
+        endpoint.stop()
+        server.stop()
+    else:
+        from faabric_tpu.runner import WorkerRuntime
+
+        runtime = WorkerRuntime(host=args.host, slots=args.slots,
+                                n_devices=args.devices,
+                                planner_host=args.planner_host)
+        runtime.start()
+        logger.info("Worker %s up", runtime.host)
+        stop.wait()
+        runtime.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

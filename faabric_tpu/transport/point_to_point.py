@@ -142,6 +142,11 @@ class PointToPointBroker:
         if dst_host == self.host:
             self.deliver(group_id, send_idx, recv_idx, data, seq, channel)
         else:
+            # A zero-copy local payload re-routed remote (e.g. the mapping
+            # moved under live migration) converts to wire bytes late
+            if not isinstance(data, (bytes, bytearray, memoryview)) \
+                    and hasattr(data, "to_bytes"):
+                data = data.to_bytes()
             self._get_client(dst_host).send_message(
                 group_id, send_idx, recv_idx, data, seq, channel)
 

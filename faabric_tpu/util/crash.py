@@ -1,0 +1,26 @@
+"""Fatal-signal crash handler (reference include/faabric/util/crash.h:10-16
+— there a native stack-trace printer; here faulthandler, which dumps every
+thread's Python stack on SIGSEGV/SIGFPE/SIGABRT/SIGBUS and on demand via
+SIGUSR1)."""
+
+from __future__ import annotations
+
+import faulthandler
+import signal
+import sys
+
+_installed = False
+
+
+def install_crash_handler() -> None:
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    faulthandler.enable(file=sys.stderr, all_threads=True)
+    try:
+        # Live-dump without dying: kill -USR1 <pid> prints all stacks
+        faulthandler.register(signal.SIGUSR1, file=sys.stderr,
+                              all_threads=True)
+    except (AttributeError, ValueError):  # pragma: no cover — non-POSIX
+        pass

@@ -1,0 +1,72 @@
+"""CPU pinning (reference include/faabric/util/hwloc.h:11-31 — there an
+hwloc-based global free-CPU allocator used to pin MPI rank threads; here
+``os.sched_setaffinity`` with the same claim/release slot discipline and
+the OVERRIDE_FREE_CPU_START escape hatch for colocated test processes)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_lock = threading.Lock()
+_claimed: set[int] = set()
+
+
+def _cpu_pool() -> list[int]:
+    conf = get_system_config()
+    start = conf.override_free_cpu_start
+    try:
+        cpus = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover — non-Linux
+        cpus = list(range(os.cpu_count() or 1))
+    return cpus[start:] or cpus
+
+
+def pin_thread_to_free_cpu() -> Optional[int]:
+    """Claim the lowest unclaimed CPU and pin the calling thread to it.
+    Returns the CPU id, or None when the pool is exhausted or pinning is
+    unsupported."""
+    with _lock:
+        for cpu in _cpu_pool():
+            if cpu not in _claimed:
+                _claimed.add(cpu)
+                chosen = cpu
+                break
+        else:
+            return None
+    try:
+        os.sched_setaffinity(0, {chosen})
+        return chosen
+    except (AttributeError, OSError):  # pragma: no cover
+        with _lock:
+            _claimed.discard(chosen)
+        return None
+
+
+def unpin_cpu(cpu: int) -> None:
+    """Release a claimed CPU slot. Does NOT touch any thread's affinity —
+    the releasing thread is often not the pinned one (pool cleanup), and
+    widening its mask would clobber its own pin. A pinned thread that
+    wants its affinity back calls unpin_current_thread()."""
+    with _lock:
+        _claimed.discard(cpu)
+
+
+def unpin_current_thread(cpu: int) -> None:
+    """Release the slot AND restore this thread's affinity to the pool."""
+    unpin_cpu(cpu)
+    try:
+        os.sched_setaffinity(0, set(_cpu_pool()))
+    except (AttributeError, OSError):  # pragma: no cover
+        pass
+
+
+def reset_pins_for_tests() -> None:
+    with _lock:
+        _claimed.clear()

@@ -60,3 +60,17 @@ def _reset_globals():
     set_test_mode(False)
     clear_host_aliases()
     get_system_config().reset()
+
+    # Drain every mock-recording queue (the reference's fixture reset
+    # discipline — stale recordings otherwise leak across tests)
+    from faabric_tpu.planner.client import clear_mock_planner_calls
+    from faabric_tpu.scheduler.function_call import clear_mock_requests
+    from faabric_tpu.snapshot.remote import clear_mock_snapshot_requests
+    from faabric_tpu.state.remote import clear_mock_state_requests
+    from faabric_tpu.transport.ptp_remote import clear_sent_ptp
+
+    clear_mock_planner_calls()
+    clear_mock_requests()
+    clear_mock_snapshot_requests()
+    clear_mock_state_requests()
+    clear_sent_ptp()
