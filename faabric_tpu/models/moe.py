@@ -1,0 +1,208 @@
+"""Mixture-of-Experts transformer: the second model family, exercising
+expert parallelism over the ``ep`` mesh axis.
+
+Top-1 (switch-style) routing with fixed expert capacity, in the
+einsum-dispatch formulation: a one-hot dispatch tensor scatters tokens
+into per-expert buffers, experts run as one batched matmul pair, and the
+combine einsum gathers results weighted by the router gate. Experts shard
+over ``ep``; with the dispatch/combine sharding constraints XLA inserts
+the token all_to_alls over ICI — the MoE analog of the MPI world's
+alltoall (SURVEY §2.4), expressed entirely through shardings.
+
+Static shapes throughout: capacity is fixed, overflow tokens drop (their
+residual passes through), standard for TPU switch routing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from faabric_tpu.models.transformer import (
+    ModelConfig,
+    _rms_norm,
+    attention_sublayer,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(ModelConfig):
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    # Auxiliary load-balancing loss weight (switch transformer)
+    aux_loss_weight: float = 0.01
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
+    keys = jax.random.split(key, cfg.n_layers + 2)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, cfg.param_dtype)
+                / np.sqrt(fan_in))
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        bk = jax.random.split(keys[i], 5)
+        blocks.append({
+            "ln1": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "wqkv": dense(bk[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim),
+                          cfg.d_model),
+            "wo": dense(bk[1], (cfg.n_heads, cfg.head_dim, cfg.d_model),
+                        cfg.d_model),
+            "ln2": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "router": dense(bk[2], (cfg.d_model, cfg.n_experts), cfg.d_model),
+            "w1": dense(bk[3], (cfg.n_experts, cfg.d_model, cfg.d_ff),
+                        cfg.d_model),
+            "w2": dense(bk[4], (cfg.n_experts, cfg.d_ff, cfg.d_model),
+                        cfg.d_ff),
+        })
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "blocks": blocks,
+        "ln_f": jnp.ones((cfg.d_model,), cfg.param_dtype),
+        "lm_head": dense(keys[-1], (cfg.d_model, cfg.vocab_size), cfg.d_model),
+    }
+
+
+def moe_param_shardings(mesh: Mesh, cfg: MoEConfig) -> dict:
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    block = {
+        "ln1": ns(),
+        "wqkv": ns(None, None, "tp", None),
+        "wo": ns("tp", None, None),
+        "ln2": ns(),
+        "router": ns(),
+        # Experts shard over ep; each expert's hidden over tp
+        "w1": ns("ep", None, "tp"),
+        "w2": ns("ep", "tp", None),
+    }
+    return {
+        "embed": ns("tp", None),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+        "ln_f": ns(),
+        "lm_head": ns(None, "tp"),
+    }
+
+
+def _capacity(cfg: MoEConfig, seq: int) -> int:
+    return max(1, int(np.ceil(seq * cfg.capacity_factor / cfg.n_experts)))
+
+
+def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
+               mesh: Optional[Mesh]) -> tuple[jax.Array, jax.Array]:
+    """x (B, S, D) → (out, aux_loss)."""
+    b, s, d = x.shape
+    e = cfg.n_experts
+    c = _capacity(cfg, s)
+
+    logits = (x.astype(jnp.float32)
+              @ blk["router"].astype(jnp.float32))  # (B, S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                  # (B, S)
+    expert = jnp.argmax(probs, axis=-1)             # (B, S)
+
+    # Switch load-balancing aux loss: E · Σ_e f_e · p_e
+    one_hot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (B, S, E)
+    density = one_hot.mean(axis=1)                  # fraction per expert
+    density_proxy = probs.mean(axis=1)
+    aux = (density * density_proxy).sum(axis=-1).mean() * e
+
+    # Position of each token within its expert's capacity buffer
+    pos = (jnp.cumsum(one_hot, axis=1) - 1.0) * one_hot  # (B, S, E)
+    pos = pos.sum(axis=-1)                               # (B, S)
+    keep = pos < c
+    dispatch = (one_hot * keep[..., None].astype(jnp.float32))[..., None] \
+        * jax.nn.one_hot(pos.astype(jnp.int32), c,
+                         dtype=jnp.float32)[:, :, None, :]
+    # dispatch: (B, S, E, C)
+
+    def constrain(arr, *spec):
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(
+                arr, NamedSharding(mesh, P(*spec)))
+        return arr
+
+    xf = x.astype(jnp.float32)
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xf)
+    # Token buffers shard over ep with the experts → XLA all_to_alls the
+    # tokens to their expert's chips
+    expert_in = constrain(expert_in, "ep", "dp", None, None)
+
+    w1 = blk["w1"].astype(jnp.float32)
+    w2 = blk["w2"].astype(jnp.float32)
+    h = jax.nn.gelu(jnp.einsum("ebcd,edf->ebcf", expert_in, w1))
+    out_e = jnp.einsum("ebcf,efd->ebcd", h, w2)
+    out_e = constrain(out_e, "ep", "dp", None, None)
+
+    combine = dispatch * gate[..., None, None]
+    out = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
+    return out.astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
+                mesh: Optional[Mesh] = None
+                ) -> tuple[jax.Array, jax.Array]:
+    """tokens (B, S) → (logits (B, S, V), aux_loss scalar)."""
+    def constrain(arr, *spec):
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(
+                arr, NamedSharding(mesh, P(*spec)))
+        return arr
+
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    x = params["embed"].astype(cfg.compute_dtype)[tokens]
+    x = constrain(x, "dp", None, None)
+
+    # The Pallas paths are single-stream (see transformer.forward)
+    if mesh is not None and (cfg.attention_impl == "flash"
+                             or cfg.norm_impl == "fused"):
+        cfg = dataclasses.replace(cfg, attention_impl="reference",
+                                  norm_impl="reference")
+
+    aux_total = jnp.zeros((), jnp.float32)
+    for blk in params["blocks"]:
+        x = attention_sublayer(x, blk, positions, cfg)
+        h = _rms_norm(x, blk["ln2"])
+        moe_out, aux = _moe_layer(h, blk, cfg, mesh)
+        aux_total = aux_total + aux
+        x = x + moe_out
+        x = constrain(x, "dp", None, None)
+
+    x = _rms_norm(x, params["ln_f"])
+    logits = (x @ params["lm_head"].astype(cfg.compute_dtype)
+              ).astype(jnp.float32)
+    return logits, aux_total / max(1, cfg.n_layers)
+
+
+def moe_loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
+                cfg: MoEConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    logits, aux = moe_forward(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + cfg.aux_loss_weight * aux
+
+
+def make_moe_train_step(cfg: MoEConfig, mesh: Optional[Mesh] = None,
+                        optimizer=None):
+    import optax
+
+    from faabric_tpu.models.train import make_optimizer
+
+    optimizer = optimizer or make_optimizer()
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(moe_loss_fn)(params, tokens,
+                                                      targets, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

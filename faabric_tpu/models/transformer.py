@@ -152,8 +152,10 @@ def _norm(x: jax.Array, scale: jax.Array, cfg: ModelConfig) -> jax.Array:
     return _rms_norm(x, scale)
 
 
-def _block(x: jax.Array, blk: dict, positions: jax.Array,
-           cfg: ModelConfig) -> jax.Array:
+def attention_sublayer(x: jax.Array, blk: dict, positions: jax.Array,
+                       cfg: ModelConfig) -> jax.Array:
+    """Pre-norm attention + residual — shared by the dense and MoE
+    families (honours cfg.attention_impl / norm_impl)."""
     h = _norm(x, blk["ln1"], cfg)
     qkv = jnp.einsum("bsd,dthe->tbshe", h,
                      blk["wqkv"].astype(cfg.compute_dtype))
@@ -166,9 +168,13 @@ def _block(x: jax.Array, blk: dict, positions: jax.Array,
         attn = flash_attention(q, k, v, True)
     else:
         attn = _attention(q, k, v)
-    x = x + jnp.einsum("bshe,hed->bsd", attn,
-                       blk["wo"].astype(cfg.compute_dtype))
+    return x + jnp.einsum("bshe,hed->bsd", attn,
+                          blk["wo"].astype(cfg.compute_dtype))
 
+
+def _block(x: jax.Array, blk: dict, positions: jax.Array,
+           cfg: ModelConfig) -> jax.Array:
+    x = attention_sublayer(x, blk, positions, cfg)
     h = _norm(x, blk["ln2"], cfg)
     ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
     return x + ff @ blk["w2"].astype(cfg.compute_dtype)

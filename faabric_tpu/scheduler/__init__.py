@@ -21,3 +21,7 @@ __all__ = [
     "get_flush_calls",
     "get_message_results",
 ]
+
+from faabric_tpu.scheduler.chain import await_chained, chain_function  # noqa: E402
+
+__all__ += ["await_chained", "chain_function"]

@@ -1,0 +1,65 @@
+"""Function chaining: guests invoking further functions.
+
+Reference analog: the chained-call capability (README capability list;
+Faasm's chainedCall via PlannerClient::callFunctions with the parent's app
+id, plus util/ExecGraph logChainedFunction). A chained call is a
+SCALE_CHANGE on the running app; the child's message id is recorded on the
+parent so exec graphs reconstruct the call tree
+(reference include/faabric/util/ExecGraph.h:19-48).
+"""
+
+from __future__ import annotations
+
+from faabric_tpu.batch_scheduler.decision import is_sentinel_decision
+from faabric_tpu.proto import BatchExecuteRequest, Message, message_factory
+from faabric_tpu.util.exec_graph import log_chained_function
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def chain_function(function: str, input_data: bytes = b"",
+                   user: str = "") -> int:
+    """Invoke ``function`` as a chained call of the currently executing
+    task. Returns the chained message id (await it with
+    ``await_chained``)."""
+    from faabric_tpu.executor.context import ExecutorContext
+
+    ctx = ExecutorContext.get()
+    parent = ctx.msg
+    executor = ctx.executor
+    planner_client = executor.scheduler.planner_client
+
+    req = BatchExecuteRequest(
+        app_id=parent.app_id, user=user or parent.user, function=function)
+    child = message_factory(user or parent.user, function)
+    child.app_id = parent.app_id
+    child.input_data = input_data
+    child.record_exec_graph = parent.record_exec_graph
+    req.messages = [child]
+
+    decision = planner_client.call_functions(req)
+    if is_sentinel_decision(decision):
+        # The child was never dispatched (no slots / frozen): fail fast
+        # instead of letting await_chained time out on a ghost id
+        raise RuntimeError(
+            f"Chained call {function} could not be scheduled "
+            f"(decision {decision.app_id})")
+
+    # Record the chain on the parent for exec-graph reconstruction
+    log_chained_function(parent, child.id)
+    executor.add_chained_message(child)
+    logger.debug("Chained %s/%s (%d) from parent %d", child.user,
+                 child.function, child.id, parent.id)
+    return child.id
+
+
+def await_chained(msg_id: int, timeout: float | None = None) -> Message:
+    """Block on a chained call's result (the guest-side analog of
+    awaitChainedCall)."""
+    from faabric_tpu.executor.context import ExecutorContext
+
+    ctx = ExecutorContext.get()
+    planner_client = ctx.executor.scheduler.planner_client
+    return planner_client.get_message_result(ctx.msg.app_id, msg_id,
+                                             timeout=timeout)

@@ -407,3 +407,53 @@ def test_threads_batch_two_hosts_snapshot_merge(cluster):
         i + 1 for i in range(n_threads))
     for i in range(n_threads):
         assert merged[128 * (1 + i)] == 100 + i
+
+
+class ChainExecutor(Executor):
+    """'parent' chains two 'child' calls and combines their results; the
+    exec graph reconstructs the tree (reference chained-call capability +
+    util/ExecGraph)."""
+
+    def execute_task(self, thread_pool_idx, msg_idx, req):
+        from faabric_tpu.scheduler.chain import await_chained, chain_function
+
+        msg = req.messages[msg_idx]
+        if msg.function == "child":
+            n = int(msg.input_data.decode())
+            msg.output_data = str(n * 10).encode()
+            return int(ReturnValue.SUCCESS)
+
+        msg.record_exec_graph = True
+        ids = [chain_function("child", str(i).encode()) for i in (1, 2)]
+        total = sum(int(await_chained(i, timeout=10.0).output_data.decode())
+                    for i in ids)
+        msg.output_data = str(total).encode()
+        return int(ReturnValue.SUCCESS)
+
+
+def test_chained_functions_and_exec_graph(cluster):
+    from faabric_tpu.util.exec_graph import build_exec_graph
+
+    class ChainFactory(ExecutorFactory):
+        def create_executor(self, msg):
+            return ChainExecutor(msg)
+
+    set_executor_factory(ChainFactory())
+    w = cluster["workers"]["hostA"]
+    req = batch_exec_factory("demo", "parent", 1)
+    req.messages[0].record_exec_graph = True
+    w.planner_client.call_functions(req)
+    result = w.planner_client.get_message_result(
+        req.app_id, req.messages[0].id, timeout=15.0)
+    assert result.return_value == int(ReturnValue.SUCCESS), result.output_data
+    assert result.output_data == b"30"  # 1*10 + 2*10
+    assert len(result.chained_msg_ids) == 2
+
+    # The planner can reconstruct the call tree
+    planner = get_planner()
+    graph = build_exec_graph(
+        lambda aid, mid: planner.get_message_result(aid, mid),
+        result.id, req.app_id)
+    assert graph.count_nodes() == 3
+    child_outputs = sorted(c.msg.output_data for c in graph.root.children)
+    assert child_outputs == [b"10", b"20"]

@@ -1,0 +1,82 @@
+"""MoE model family: routing semantics + expert parallelism over ep."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from faabric_tpu.models.moe import (
+    MoEConfig,
+    init_moe_params,
+    make_moe_train_step,
+    moe_forward,
+    moe_loss_fn,
+    moe_param_shardings,
+)
+from faabric_tpu.models.train import make_optimizer
+from faabric_tpu.parallel import MeshConfig, build_mesh
+
+CFG = MoEConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                max_seq=64, n_experts=4, compute_dtype=jnp.float32)
+
+
+def batch(b=4, s=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randint(0, CFG.vocab_size, (b, s)), jnp.int32),
+            jnp.asarray(rng.randint(0, CFG.vocab_size, (b, s)), jnp.int32))
+
+
+def test_moe_forward_shapes_and_aux():
+    params = init_moe_params(jax.random.PRNGKey(0), CFG)
+    tokens, _ = batch()
+    logits, aux = moe_forward(params, tokens, CFG)
+    assert logits.shape == (4, 32, CFG.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    # Switch aux loss is ~1 for a balanced router, bounded below by 1
+    assert 0.9 < float(aux) < float(CFG.n_experts)
+
+
+def test_moe_sharded_matches_single_device():
+    """dp+ep+tp sharded MoE equals the unsharded computation."""
+    params = init_moe_params(jax.random.PRNGKey(1), CFG)
+    tokens, _ = batch()
+    ref, aux_ref = moe_forward(params, tokens, CFG)
+
+    mesh = build_mesh(config=MeshConfig(dp=2, tp=2, ep=2))
+    sharded = jax.device_put(params, moe_param_shardings(mesh, CFG))
+    out, aux = jax.jit(
+        lambda p, t: moe_forward(p, t, CFG, mesh))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+    np.testing.assert_allclose(float(aux), float(aux_ref), atol=1e-5)
+
+
+def test_moe_train_step_reduces_loss_on_ep_mesh():
+    mesh = build_mesh(config=MeshConfig(dp=2, tp=1, ep=4))
+    opt = make_optimizer()
+    params = jax.device_put(init_moe_params(jax.random.PRNGKey(0), CFG),
+                            moe_param_shardings(mesh, CFG))
+    opt_state = opt.init(params)
+    step = make_moe_train_step(CFG, mesh, opt)
+    tokens, targets = batch()
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """With capacity factor << 1 most tokens drop to the residual path —
+    forward stays finite and differentiable."""
+    cfg = MoEConfig(vocab_size=128, d_model=32, n_layers=1, n_heads=4,
+                    d_ff=64, max_seq=64, n_experts=4, capacity_factor=0.25,
+                    compute_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    tokens, targets = batch()
+    loss = moe_loss_fn(params, tokens, targets, cfg)
+    assert np.isfinite(float(loss))
+    grads = jax.grad(moe_loss_fn)(params, tokens, targets, cfg)
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree.leaves(grads))
