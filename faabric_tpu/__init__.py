@@ -13,21 +13,26 @@ v0.22.0) re-designed TPU-first.
     reference's process topology (``src/runner/FaabricMain.cpp``) with a
     framed-TCP transport in place of nng.
 
-Layer map (== SURVEY.md §1):
+Layer map (== SURVEY.md §1; every layer is implemented — see README.md):
 
     endpoint/        HTTP REST API (planner controller)
-    planner/         cluster-singleton control plane
+    planner/         cluster-singleton control plane + state-master registry
     batch_scheduler/ pluggable scheduling policies (bin-pack/compact/spot)
-    scheduler/       per-host scheduler + function-call RPC
+    scheduler/       per-host scheduler, function-call RPC, chaining
     executor/        pluggable executor w/ thread pool, snapshot restore
-    mpi/             MPI-semantics world: host PTP path + XLA device path
+    mpi/             MPI-semantics world: host PTP path + XLA device path,
+                     guest mpi_* API
     transport/       framed TCP endpoints, RPC servers/clients, PTP broker
+                     with ordered delivery + group locks/barriers
     snapshot/        memory snapshots, typed merge regions, diffs, deltas
     state/           distributed KV (master-per-key, chunked pull/push)
     parallel/        TPU mesh substrate: axes, collectives, ring attention
-    models/          flagship models exercising dp/tp/pp/sp/ep shardings
-    ops/             Pallas kernels for hot device ops
-    util/            config, gids, queues, latches, dirty tracking, graphs
+    models/          dense + MoE families over dp/tp/sp/ep, checkpointing
+    ops/             Pallas kernels (flash attention, fused RMS norm)
+    runner/          worker runtime assembly + deployment CLI
+    util/            config, gids, queues, latches, dirty tracking, graphs,
+                     CPU pinning, crash handler, native-lib loader
+    native/          C++ page-diff/XOR kernels (repo root, ctypes-bound)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
