@@ -27,3 +27,30 @@ __all__ = [
     "param_shardings",
     "shard_params",
 ]
+
+from faabric_tpu.models.checkpoint import (  # noqa: E402
+    restore_train_state,
+    save_train_state,
+)
+from faabric_tpu.models.generate import generate, init_kv_cache  # noqa: E402
+from faabric_tpu.models.moe import (  # noqa: E402
+    MoEConfig,
+    init_moe_params,
+    make_moe_train_step,
+    moe_forward,
+    moe_loss_fn,
+    moe_param_shardings,
+)
+
+__all__ += [
+    "MoEConfig",
+    "generate",
+    "init_kv_cache",
+    "init_moe_params",
+    "make_moe_train_step",
+    "moe_forward",
+    "moe_loss_fn",
+    "moe_param_shardings",
+    "restore_train_state",
+    "save_train_state",
+]

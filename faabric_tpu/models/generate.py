@@ -1,0 +1,112 @@
+"""Autoregressive decoding with a KV cache.
+
+Static shapes end-to-end: the cache is pre-allocated at ``max_seq`` and
+filled with ``lax.dynamic_update_slice``; attention masks by position, so
+prefill and every decode step compile once each. The whole greedy loop is
+one ``lax.scan`` under jit — no host round-trips between tokens, which is
+what keeps a TPU busy at small batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from faabric_tpu.models.transformer import (
+    ModelConfig,
+    _norm,
+    _rope,
+)
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int) -> list[dict]:
+    return [{
+        "k": jnp.zeros((batch, cfg.max_seq, cfg.n_heads, cfg.head_dim),
+                       cfg.compute_dtype),
+        "v": jnp.zeros((batch, cfg.max_seq, cfg.n_heads, cfg.head_dim),
+                       cfg.compute_dtype),
+    } for _ in range(cfg.n_layers)]
+
+
+def _cached_attention(q, cache_k, cache_v, length):
+    """q (B, S_q, H, D) against the cache's first ``length`` positions
+    (q's last position is length-1)."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k
+                        ).astype(jnp.float32) * scale
+    s_q = q.shape[1]
+    max_seq = cache_k.shape[1]
+    q_pos = (length - s_q) + jnp.arange(s_q)
+    k_pos = jnp.arange(max_seq)
+    mask = q_pos[:, None] >= k_pos[None, :]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v)
+
+
+def _block_with_cache(x, blk, cache, start, length, cfg: ModelConfig):
+    """One transformer block over tokens at positions [start, start+S);
+    updates the cache in place (functionally) and attends over
+    [0, length)."""
+    b, s, _ = x.shape
+    h = _norm(x, blk["ln1"], cfg)
+    qkv = jnp.einsum("bsd,dthe->tbshe", h,
+                     blk["wqkv"].astype(cfg.compute_dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    positions = jnp.broadcast_to(start + jnp.arange(s)[None], (b, s))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+
+    cache_k = jax.lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0))
+
+    attn = _cached_attention(q, cache_k, cache_v, length)
+    x = x + jnp.einsum("bshe,hed->bsd", attn,
+                       blk["wo"].astype(cfg.compute_dtype))
+    h = _norm(x, blk["ln2"], cfg)
+    ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
+    x = x + ff @ blk["w2"].astype(cfg.compute_dtype)
+    return x, {"k": cache_k, "v": cache_v}
+
+
+def forward_with_cache(params, tokens, cache, start, cfg: ModelConfig):
+    """tokens (B, S) entering at position ``start`` → (logits (B, S, V),
+    new cache). length = start + S."""
+    b, s = tokens.shape
+    length = start + s
+    x = params["embed"].astype(cfg.compute_dtype)[tokens]
+    new_cache = []
+    for blk, layer_cache in zip(params["blocks"], cache):
+        x, updated = _block_with_cache(x, blk, layer_cache, start, length,
+                                       cfg)
+        new_cache.append(updated)
+    x = _norm(x, params["ln_f"], cfg)
+    logits = (x @ params["lm_head"].astype(cfg.compute_dtype)
+              ).astype(jnp.float32)
+    return logits, new_cache
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def generate(params, prompt, cfg: ModelConfig, n_tokens: int):
+    """Greedy decode: prompt (B, S_p) int32 → (B, n_tokens) int32.
+    Prefill + a scanned single-token decode loop, all one program."""
+    b, s_p = prompt.shape
+    cache = init_kv_cache(cfg, b)
+
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, pos, cache = carry
+        logits, cache = forward_with_cache(params, tok[:, None], cache,
+                                           pos, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (nxt, pos + 1, cache), tok
+
+    (_, _, _), toks = jax.lax.scan(step, (next_tok, s_p, cache), None,
+                                   length=n_tokens)
+    return toks.T  # (B, n_tokens)
