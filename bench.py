@@ -212,20 +212,33 @@ def main() -> None:
         import subprocess
 
         timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "360"))
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--device-only"],
-                capture_output=True, text=True, timeout=timeout_s)
-            line = (proc.stdout.strip().splitlines() or [""])[-1]
-            if proc.returncode == 0 and line.startswith("{"):
-                extras["device_step"] = json.loads(line)
-            else:
-                extras["device_step_error"] = (
-                    f"rc={proc.returncode}: {proc.stderr[-200:]}")
-        except subprocess.TimeoutExpired:
-            extras["device_step_error"] = f"timeout after {timeout_s}s"
-        except Exception as e:  # noqa: BLE001
-            extras["device_step_error"] = str(e)[:200]
+
+        def run_device(env_extra: dict) -> tuple[dict | None, str]:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--device-only"],
+                    capture_output=True, text=True, timeout=timeout_s,
+                    env={**os.environ, **env_extra})
+                line = (proc.stdout.strip().splitlines() or [""])[-1]
+                if proc.returncode == 0 and line.startswith("{"):
+                    return json.loads(line), ""
+                return None, f"rc={proc.returncode}: {proc.stderr[-200:]}"
+            except subprocess.TimeoutExpired:
+                return None, f"timeout after {timeout_s}s"
+            except Exception as e:  # noqa: BLE001
+                return None, str(e)[:200]
+
+        result_d, err = run_device({})
+        if result_d is None:
+            # TPU tunnel down/wedged: record why, then still produce a
+            # labeled CPU number rather than nothing
+            extras["device_step_error"] = err
+            result_d, err2 = run_device({"JAX_PLATFORMS": "cpu"})
+            if result_d is None:
+                extras["device_step_cpu_error"] = err2
+        if result_d is not None:
+            extras["device_step"] = result_d
 
     p50 = ptp["p50_ms"]
     result = {

@@ -118,6 +118,10 @@ class SnapshotClient(MessageEndpointClient):
             "max_size": snap.max_size,
             "merge_regions": [r.to_dict() for r in snap.get_merge_regions()],
         }
+        from faabric_tpu.util.bytes import format_byte_size
+
+        logger.debug("Pushing snapshot %s (%s) to %s", key,
+                     format_byte_size(snap.size), self.host)
         self.sync_send(int(SnapshotCalls.PUSH_SNAPSHOT), header,
                        snap.to_bytes())
 

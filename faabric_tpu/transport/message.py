@@ -122,8 +122,11 @@ def recv_frame(sock: socket.socket) -> TransportMessage:
     if magic != MAGIC:
         raise TransportError(f"Bad frame magic: {magic:#x}")
     if json_len > MAX_JSON_LEN or bin_len > MAX_BIN_LEN:
+        from faabric_tpu.util.bytes import format_byte_size
+
         raise TransportError(
-            f"Frame exceeds size bounds (json={json_len}, bin={bin_len})"
+            f"Frame exceeds size bounds (json={format_byte_size(json_len)}, "
+            f"bin={format_byte_size(bin_len)})"
         )
     header_json = _recv_exact(sock, json_len)
     payload = _recv_exact(sock, bin_len)

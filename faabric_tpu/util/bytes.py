@@ -1,0 +1,45 @@
+"""Byte-twiddling helpers (reference include/faabric/util/bytes.h —
+unaligned typed reads/writes, value↔bytes conversion)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+_FMT = {
+    "i32": "<i", "u32": "<I", "i64": "<q", "u64": "<Q",
+    "f32": "<f", "f64": "<d", "u8": "<B",
+}
+
+
+def read_value(buf, offset: int, kind: str) -> Any:
+    """Unaligned typed read from any buffer-protocol object."""
+    fmt = _FMT[kind]
+    return struct.unpack_from(fmt, buf, offset)[0]
+
+
+def write_value(buf, offset: int, kind: str, value) -> None:
+    struct.pack_into(_FMT[kind], buf, offset, value)
+
+
+def value_to_bytes(kind: str, value) -> bytes:
+    return struct.pack(_FMT[kind], value)
+
+
+def bytes_to_array(data: bytes, dtype=np.uint8) -> np.ndarray:
+    return np.frombuffer(data, dtype=dtype).copy()
+
+
+def array_to_bytes(arr: np.ndarray) -> bytes:
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def format_byte_size(n: int) -> str:
+    """Human-readable size (reference's str helpers)."""
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"  # pragma: no cover
