@@ -229,9 +229,12 @@ class Executor:
         if tracker is not None and mem is not None:
             tracker.start_thread_local_tracking(mem)
 
+        from faabric_tpu.util.clock import prof
+
         ExecutorContext.set(self, req, task.msg_idx)
         try:
-            ret = self.execute_task(pool_idx, task.msg_idx, req)
+            with prof("executor.execute_task"):
+                ret = self.execute_task(pool_idx, task.msg_idx, req)
         except FunctionMigratedException:
             logger.debug("%s task %d migrated", self.id, msg.id)
             ret = int(ReturnValue.MIGRATED)

@@ -46,6 +46,7 @@ from faabric_tpu.proto import (
     update_batch_exec_group_id,
 )
 from faabric_tpu.transport.common import MPI_BASE_PORT, MPI_PORTS_PER_HOST
+from faabric_tpu.util.clock import prof
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.gids import generate_gid
 from faabric_tpu.util.logging import get_logger
@@ -193,7 +194,7 @@ class Planner:
         # wrong app bucket (reference updateBatchExecAppId)
         update_batch_exec_app_id(req, req.app_id)
 
-        with self._lock:
+        with prof("planner.call_batch"), self._lock:
             scheduler = get_batch_scheduler()
             decision_type = scheduler.get_decision_type(self._in_flight, req)
 
@@ -229,9 +230,24 @@ class Planner:
             if preloaded is not None and decision_type in (
                     DecisionType.NEW, DecisionType.SCALE_CHANGE):
                 decision = self._slice_preloaded(preloaded, req)
+
+            # Repeat fork-join shapes reuse their placement (reference
+            # DecisionCache, used for THREADS forks)
+            from_cache = False
+            if decision is None and req.type == int(BatchExecuteType.THREADS):
+                decision = self._decision_from_cache(req, host_map)
+                from_cache = decision is not None
+
             if decision is None:
                 decision = scheduler.make_scheduling_decision(
                     host_map, self._in_flight, req)
+
+            if (req.type == int(BatchExecuteType.THREADS) and not from_cache
+                    and not is_sentinel_decision(decision)):
+                from faabric_tpu.batch_scheduler import get_decision_cache
+
+                get_decision_cache().add_cached_decision(
+                    req, list(decision.hosts), 0)
 
             if decision.app_id == NOT_ENOUGH_SLOTS:
                 logger.warning("Not enough slots for app %d (%d msgs)",
@@ -464,6 +480,31 @@ class Planner:
                 host.release_mpi_port(decision.mpi_ports[i])
             host.release_device(decision.device_ids[i])
 
+    def _decision_from_cache(self, req: BatchExecuteRequest,
+                             host_map) -> Optional[SchedulingDecision]:
+        """Rebuild a decision from the cached placement of an identical
+        fork shape, if the cached hosts still have capacity."""
+        from faabric_tpu.batch_scheduler import get_decision_cache
+
+        cached = get_decision_cache().get_cached_decision(req)
+        if cached is None:
+            return None
+        hosts = cached.hosts
+        need: dict[str, int] = {}
+        for ip in hosts:
+            need[ip] = need.get(ip, 0) + 1
+        for ip, n in need.items():
+            h = host_map.get(ip)
+            if h is None or h.available < n:
+                return None  # topology changed; fall back to the policy
+        decision = SchedulingDecision(req.app_id, 0)
+        for i, msg in enumerate(req.messages):
+            decision.add_message(hosts[i], msg.id, msg.app_idx,
+                                 msg.group_idx)
+        logger.debug("Reused cached placement for %s/%s×%d", req.user,
+                     req.function, req.n_messages())
+        return decision
+
     # -- preload ----------------------------------------------------------
     def preload_scheduling_decision(self, decision: SchedulingDecision) -> None:
         with self._lock:
@@ -515,6 +556,12 @@ class Planner:
         return out
 
     def _do_dispatch(self, dispatches: list[tuple[str, BatchExecuteRequest]]) -> None:
+        with prof("planner.dispatch"):
+            self._do_dispatch_inner(dispatches)
+
+    def _do_dispatch_inner(self,
+                           dispatches: list[tuple[str, BatchExecuteRequest]]
+                           ) -> None:
         for ip, sub in dispatches:
             is_threads = sub.type == int(BatchExecuteType.THREADS)
             if is_threads and not sub.single_host:

@@ -361,3 +361,34 @@ def test_spot_freeze_and_thaw(cluster):
             assert result.return_value == int(ReturnValue.SUCCESS)
     finally:
         reset_batch_scheduler("bin-pack")
+
+
+def test_threads_decision_cache_reuses_placement(cluster):
+    """Repeated identical THREADS forks reuse their placement through the
+    DecisionCache (reference DecisionCache.h usage)."""
+    import numpy as np
+
+    from faabric_tpu.batch_scheduler import get_decision_cache
+    from faabric_tpu.proto import BatchExecuteType
+    from faabric_tpu.snapshot import SnapshotData
+
+    w = cluster["hostA"]
+    get_decision_cache().clear()
+
+    placements = []
+    for round_num in range(2):
+        req = batch_exec_factory("demo", "echo", 4)
+        req.type = int(BatchExecuteType.THREADS)
+        for i, m in enumerate(req.messages):
+            m.group_idx = i
+        key = f"demo/echo_{req.app_id}"
+        req.snapshot_key = key
+        w.snapshot_registry.register_snapshot(key, SnapshotData(4096))
+        d = w.planner_client.call_functions(req)
+        placements.append(sorted(d.hosts))
+        for m in req.messages:
+            w.planner_client.get_message_result(req.app_id, m.id,
+                                                timeout=10.0)
+    assert placements[0] == placements[1]
+    assert get_decision_cache().get_cached_decision(
+        batch_exec_factory("demo", "echo", 4)) is not None
