@@ -15,7 +15,11 @@ from faabric_tpu.proto import BatchExecuteRequest
 
 
 class CachedDecision:
-    def __init__(self, hosts: list[str], group_id: int) -> None:
+    """Cached placement. Unlike the reference, the group id is NOT reused
+    across forks — this framework mints a fresh group id per app so PTP
+    state can be garbage-collected per app; only hosts are recycled."""
+
+    def __init__(self, hosts: list[str], group_id: int = 0) -> None:
         self._hosts = hosts
         self._group_id = group_id
 

@@ -252,3 +252,7 @@ def reset_batch_scheduler(new_mode: str | None = None) -> None:
     with _scheduler_lock:
         _scheduler = None
         _mode_override = new_mode
+    # Cached placements were chosen by the old policy
+    from faabric_tpu.batch_scheduler.decision_cache import get_decision_cache
+
+    get_decision_cache().clear()

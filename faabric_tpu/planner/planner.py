@@ -232,9 +232,13 @@ class Planner:
                 decision = self._slice_preloaded(preloaded, req)
 
             # Repeat fork-join shapes reuse their placement (reference
-            # DecisionCache, used for THREADS forks)
+            # DecisionCache). NEW decisions only: scale-changes extend an
+            # existing app's placement and must not consume or poison
+            # entries keyed merely by (user, function, count).
+            is_cacheable = (req.type == int(BatchExecuteType.THREADS)
+                            and decision_type == DecisionType.NEW)
             from_cache = False
-            if decision is None and req.type == int(BatchExecuteType.THREADS):
+            if decision is None and is_cacheable:
                 decision = self._decision_from_cache(req, host_map)
                 from_cache = decision is not None
 
@@ -242,7 +246,7 @@ class Planner:
                 decision = scheduler.make_scheduling_decision(
                     host_map, self._in_flight, req)
 
-            if (req.type == int(BatchExecuteType.THREADS) and not from_cache
+            if (is_cacheable and not from_cache
                     and not is_sentinel_decision(decision)):
                 from faabric_tpu.batch_scheduler import get_decision_cache
 
@@ -495,8 +499,9 @@ class Planner:
             need[ip] = need.get(ip, 0) + 1
         for ip, n in need.items():
             h = host_map.get(ip)
-            if h is None or h.available < n:
-                return None  # topology changed; fall back to the policy
+            if h is None or h.available < n or h.for_eviction:
+                # Topology changed / host leaving: fall back to the policy
+                return None
         decision = SchedulingDecision(req.app_id, 0)
         for i, msg in enumerate(req.messages):
             decision.add_message(hosts[i], msg.id, msg.app_idx,
@@ -839,8 +844,10 @@ class Planner:
             self._num_migrations = 0
             self._clients.close_all()
             self._snapshot_clients.close_all()
+        from faabric_tpu.batch_scheduler import get_decision_cache
         from faabric_tpu.transport.ptp_remote import close_mapping_clients
 
+        get_decision_cache().clear()
         close_mapping_clients()
 
     def flush_scheduling_state(self) -> None:
@@ -856,6 +863,9 @@ class Planner:
                 h.state.used_slots = 0
                 h.used_mpi_ports.clear()
                 h.device_load = [0] * len(h.device_load)
+        from faabric_tpu.batch_scheduler import get_decision_cache
+
+        get_decision_cache().clear()
 
 
 _planner: Optional[Planner] = None

@@ -80,6 +80,68 @@ class DistExecutor(Executor):
         self.memory[512 * (1 + msg.group_idx)] = 200 + msg.group_idx
         return int(ReturnValue.SUCCESS)
 
+    def fn_train(self, msg, req):
+        """Distributed data-parallel training: each rank computes grads on
+        its own data shard and allreduces them through the framework's MPI
+        before applying the update — every rank's params stay bit-identical
+        without any parameter server."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from faabric_tpu.mpi import MpiOp, get_mpi_context
+        from faabric_tpu.models import ModelConfig, init_params, loss_fn
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7200
+            msg.mpi_world_size = 6
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        size = world.size
+
+        cfg = ModelConfig(vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+                          d_ff=32, max_seq=16, compute_dtype=jnp.float32,
+                          remat=False)
+        # Same seed everywhere → identical initial params
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        leaves, treedef = jax.tree.flatten(params)
+        shapes = [l.shape for l in leaves]
+        sizes = [int(np.prod(s)) for s in shapes]
+
+        grad_fn = jax.jit(jax.grad(loss_fn), static_argnums=(3,))
+        data_rng = np.random.RandomState(100 + rank)  # rank-local shard
+        lr = 0.5
+        for step in range(3):
+            tokens = jnp.asarray(data_rng.randint(0, 64, (2, 8)),
+                                 dtype=jnp.int32)
+            targets = jnp.asarray(data_rng.randint(0, 64, (2, 8)),
+                                  dtype=jnp.int32)
+            grads = grad_fn(params, tokens, targets, cfg)
+            flat = np.concatenate([np.asarray(g).ravel()
+                                   for g in jax.tree.leaves(grads)])
+            summed = world.allreduce(rank, flat.astype(np.float32),
+                                     MpiOp.SUM) / size
+            # Unflatten and SGD-update
+            out, off = [], 0
+            for shp, n in zip(shapes, sizes):
+                out.append(summed[off:off + n].reshape(shp))
+                off += n
+            params = jax.tree.unflatten(
+                treedef, [l - lr * jnp.asarray(g)
+                          for l, g in zip(jax.tree.leaves(params), out)])
+        # Param checksum must agree across ranks (synchronous training)
+        checksum = float(sum(np.abs(np.asarray(l)).sum()
+                             for l in jax.tree.leaves(params)))
+        world.barrier(rank)
+        msg.output_data = f"r{rank}:{checksum:.6f}".encode()
+        return int(ReturnValue.SUCCESS)
+
     def fn_state(self, msg, req):
         """Non-master host pulls a shared value, doubles one chunk and
         pushes it back."""

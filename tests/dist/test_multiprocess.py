@@ -157,3 +157,29 @@ def test_dist_state_pull_push(dist_cluster):
     assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
     # The remote worker pulled, doubled one chunk and pushed back
     assert kv.get_chunk(0, 4) == bytes([14] * 4)
+
+
+def test_dist_data_parallel_training(dist_cluster):
+    """Data-parallel training across worker PROCESSES: gradients
+    allreduce through the framework's MPI, so every rank's parameters
+    stay identical without a parameter server — the runtime and model
+    layers working as one system."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", "train", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r0 = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                              timeout=60.0)
+    assert r0.return_value == int(ReturnValue.SUCCESS), r0.output_data
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status = me.planner_client.get_batch_results(req.app_id)
+        if status.finished:
+            break
+        time.sleep(0.2)
+    assert status.finished and status.expected_num_messages == 6
+    checksums = {m.output_data.split(b":")[1] for m in status.message_results}
+    assert len(checksums) == 1, status.message_results  # ranks in sync
+    hosts = {m.executed_host for m in status.message_results}
+    assert hosts == {"w1", "w2"}
