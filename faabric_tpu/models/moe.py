@@ -161,15 +161,13 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
     x = constrain(x, "dp", None, None)
 
-    # The Pallas paths are single-stream (see transformer.forward)
-    if mesh is not None and (cfg.attention_impl == "flash"
-                             or cfg.norm_impl == "fused"):
-        cfg = dataclasses.replace(cfg, attention_impl="reference",
-                                  norm_impl="reference")
+    # Flash shard_maps over (dp, tp); the fused norm stays single-stream
+    if mesh is not None and cfg.norm_impl == "fused":
+        cfg = dataclasses.replace(cfg, norm_impl="reference")
 
     aux_total = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:
-        x = attention_sublayer(x, blk, positions, cfg)
+        x = attention_sublayer(x, blk, positions, cfg, mesh)
         h = _rms_norm(x, blk["ln2"])
         moe_out, aux = _moe_layer(h, blk, cfg, mesh)
         aux_total = aux_total + aux

@@ -152,8 +152,28 @@ def _norm(x: jax.Array, scale: jax.Array, cfg: ModelConfig) -> jax.Array:
     return _rms_norm(x, scale)
 
 
+def _sharded_flash(q, k, v, mesh: Mesh):
+    """Flash attention under a mesh: batch (dp) and heads (tp) are
+    embarrassingly parallel for attention, so each shard runs the Pallas
+    kernel on its local (B/dp, S, H/tp, D) slab — no collectives."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover — older JAX
+        from jax.experimental.shard_map import shard_map
+
+    from faabric_tpu.ops.flash_attention import flash_attention
+
+    spec = P("dp", None, "tp", None)
+    # check_vma off: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, and this wrapper is trivially per-shard anyway
+    return shard_map(lambda q, k, v: flash_attention(q, k, v, True),
+                     mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
 def attention_sublayer(x: jax.Array, blk: dict, positions: jax.Array,
-                       cfg: ModelConfig) -> jax.Array:
+                       cfg: ModelConfig,
+                       mesh: Optional[Mesh] = None) -> jax.Array:
     """Pre-norm attention + residual — shared by the dense and MoE
     families (honours cfg.attention_impl / norm_impl)."""
     h = _norm(x, blk["ln1"], cfg)
@@ -165,7 +185,10 @@ def attention_sublayer(x: jax.Array, blk: dict, positions: jax.Array,
     if cfg.attention_impl == "flash":
         from faabric_tpu.ops.flash_attention import flash_attention
 
-        attn = flash_attention(q, k, v, True)
+        if mesh is not None:
+            attn = _sharded_flash(q, k, v, mesh)
+        else:
+            attn = flash_attention(q, k, v, True)
     else:
         attn = _attention(q, k, v)
     return x + jnp.einsum("bshe,hed->bsd", attn,
@@ -173,8 +196,8 @@ def attention_sublayer(x: jax.Array, blk: dict, positions: jax.Array,
 
 
 def _block(x: jax.Array, blk: dict, positions: jax.Array,
-           cfg: ModelConfig) -> jax.Array:
-    x = attention_sublayer(x, blk, positions, cfg)
+           cfg: ModelConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    x = attention_sublayer(x, blk, positions, cfg, mesh)
     h = _norm(x, blk["ln2"], cfg)
     ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
     return x + ff @ blk["w2"].astype(cfg.compute_dtype)
@@ -189,14 +212,18 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
                 x, NamedSharding(mesh, P(*spec)))
         return x
 
-    # The Pallas flash path is single-stream: under a mesh the activations
-    # arrive tp/sp-sharded and a bare pallas_call has no partitioning rule,
-    # so sharded runs keep the reference attention (XLA shards its einsums
-    # natively; a shard_mapped flash kernel is a later optimisation).
-    if mesh is not None and (cfg.attention_impl == "flash"
-                             or cfg.norm_impl == "fused"):
-        cfg = dataclasses.replace(cfg, attention_impl="reference",
-                                  norm_impl="reference")
+    # Flash under a mesh runs shard_mapped over (dp, tp) — but a
+    # sequence-sharded model (sp > 1) needs ring attention semantics, so
+    # it keeps the natively-partitionable reference path. The fused norm
+    # kernel stays single-stream.
+    if mesh is not None:
+        downgrade = {}
+        if cfg.attention_impl == "flash" and mesh.shape.get("sp", 1) > 1:
+            downgrade["attention_impl"] = "reference"
+        if cfg.norm_impl == "fused":
+            downgrade["norm_impl"] = "reference"
+        if downgrade:
+            cfg = dataclasses.replace(cfg, **downgrade)
 
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
@@ -205,9 +232,9 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
 
     block_fn = _block
     if cfg.remat:
-        block_fn = jax.checkpoint(_block, static_argnums=(3,))
+        block_fn = jax.checkpoint(_block, static_argnums=(3, 4))
     for blk in params["blocks"]:
-        x = block_fn(x, blk, positions, cfg)
+        x = block_fn(x, blk, positions, cfg, mesh)
         x = maybe_constrain(x, "dp", "sp", None)
 
     x = _norm(x, params["ln_f"], cfg)
