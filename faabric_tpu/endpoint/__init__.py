@@ -1,5 +1,6 @@
 """Planner HTTP REST API (reference src/endpoint + PlannerEndpointHandler)."""
 
 from faabric_tpu.endpoint.http_server import HttpMessageType, PlannerHttpEndpoint
+from faabric_tpu.endpoint.worker_endpoint import WorkerHttpEndpoint
 
-__all__ = ["HttpMessageType", "PlannerHttpEndpoint"]
+__all__ = ["HttpMessageType", "PlannerHttpEndpoint", "WorkerHttpEndpoint"]

@@ -1,0 +1,63 @@
+"""Worker HTTP endpoint.
+
+Reference analog: src/endpoint/FaabricEndpointHandler.cpp:16-56 — the
+worker's HTTP surface deliberately rejects every request, directing
+clients to the planner, which owns the REST API. Kept for wire parity
+(deployments probe worker ports) and as the hook point if a direct worker
+API ever returns.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+REJECTION = json.dumps({
+    "error": "Workers do not accept direct requests; use the planner's "
+             "HTTP endpoint",
+}).encode()
+
+
+class WorkerHttpEndpoint:
+    def __init__(self, port: int) -> None:
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reject(self) -> None:
+                self.send_response(403)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(REJECTION)))
+                self.end_headers()
+                self.wfile.write(REJECTION)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _reject
+
+            def log_message(self, fmt, *args):
+                logger.debug("worker-http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="worker-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None

@@ -1,0 +1,93 @@
+"""Executor-memory allocation helpers.
+
+Reference analog: include/faabric/util/memory.h:78-130 — there mmap
+private/shared/virtual reservations and memfd-backed snapshots. Executor
+memory here is numpy buffers (the device analog transfers HBM↔host via
+jax), so the equivalents are page-aligned allocation, reserve-then-claim
+growth, and shared memory via ``multiprocessing.shared_memory``.
+"""
+
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+from typing import Optional
+
+import numpy as np
+
+from faabric_tpu.util.dirty import PAGE_SIZE, n_pages
+
+
+def page_align_up(size: int) -> int:
+    return n_pages(size) * PAGE_SIZE
+
+
+def is_page_aligned(offset: int) -> bool:
+    return offset % PAGE_SIZE == 0
+
+
+def allocate_buffer(size: int) -> np.ndarray:
+    """Zeroed page-rounded buffer (the mmap-private analog)."""
+    return np.zeros(page_align_up(size), dtype=np.uint8)
+
+
+class VirtualBuffer:
+    """Reserve max, claim forward (reference claimVirtualMemory): a buffer
+    whose usable size grows monotonically up to a fixed reservation —
+    growth never reallocates or moves data."""
+
+    def __init__(self, max_size: int, initial_size: int = 0) -> None:
+        self.max_size = page_align_up(max_size)
+        if page_align_up(initial_size) > self.max_size:
+            raise ValueError(
+                f"Initial size {initial_size} exceeds reservation "
+                f"{self.max_size}")
+        self._backing = np.zeros(self.max_size, dtype=np.uint8)
+        self._claimed = page_align_up(initial_size)
+
+    @property
+    def size(self) -> int:
+        return self._claimed
+
+    def claim(self, new_size: int) -> np.ndarray:
+        new_size = page_align_up(new_size)
+        if new_size > self.max_size:
+            raise ValueError(
+                f"Claim {new_size} exceeds reservation {self.max_size}")
+        self._claimed = max(self._claimed, new_size)
+        return self.view()
+
+    def view(self) -> np.ndarray:
+        return self._backing[:self._claimed]
+
+
+class SharedBuffer:
+    """Cross-process shared memory region (the MAP_SHARED analog) backed
+    by ``multiprocessing.shared_memory``."""
+
+    def __init__(self, size: int, name: Optional[str] = None,
+                 create: bool = True) -> None:
+        size = page_align_up(size)
+        self._shm = shared_memory.SharedMemory(name=name, create=create,
+                                               size=size)
+        self.name = self._shm.name
+        self.array = np.frombuffer(self._shm.buf, dtype=np.uint8)
+        self._closed = False
+
+    def close(self, unlink: bool = False) -> None:
+        """Idempotent. Raises BufferError while external views of .array
+        are still alive — release them and call close() again (the own
+        view is dropped on the first attempt either way)."""
+        if self._closed:
+            return
+        self.array = None  # drop our own view
+        try:
+            self._shm.close()
+        except BufferError:
+            # External views still pin the mapping; retryable
+            raise
+        self._closed = True
+        if unlink:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:  # pragma: no cover
+                pass

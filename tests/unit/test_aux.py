@@ -250,3 +250,73 @@ def test_planner_accounting_under_concurrent_batches():
     finally:
         set_mock_mode(False)
         planner.reset()
+
+
+def test_worker_endpoint_rejects_everything():
+    """The worker HTTP surface rejects direct requests, as the reference's
+    does (FaabricEndpointHandler) — the planner owns the REST API."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from faabric_tpu.endpoint import WorkerHttpEndpoint
+    from faabric_tpu.util.network import get_free_port
+
+    port = get_free_port()
+    ep = WorkerHttpEndpoint(port)
+    ep.start()
+    try:
+        for method, data in (("GET", None), ("POST", b"{}")):
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/",
+                                         data=data, method=method)
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError("expected 403")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+                assert "planner" in _json.loads(e.read())["error"]
+    finally:
+        ep.stop()
+
+
+def test_memory_buffers():
+    import numpy as np
+
+    from faabric_tpu.util.memory import (
+        SharedBuffer,
+        VirtualBuffer,
+        allocate_buffer,
+        is_page_aligned,
+        page_align_up,
+    )
+
+    assert page_align_up(1) == 4096
+    assert page_align_up(4096) == 4096
+    assert is_page_aligned(8192) and not is_page_aligned(100)
+    buf = allocate_buffer(5000)
+    assert buf.size == 8192 and (buf == 0).all()
+
+    # Reserve-then-claim growth keeps earlier data in place
+    vb = VirtualBuffer(max_size=4 * 4096, initial_size=4096)
+    vb.view()[:4] = [1, 2, 3, 4]
+    grown = vb.claim(2 * 4096)
+    assert grown.size == 2 * 4096
+    assert list(grown[:4]) == [1, 2, 3, 4]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        vb.claim(10 * 4096)
+
+    # Cross-process shared region: attach by name and observe writes
+    sb = SharedBuffer(4096)
+    try:
+        sb.array[10] = 99
+        other = SharedBuffer(4096, name=sb.name, create=False)
+        try:
+            assert other.array[10] == 99
+            other.array[11] = 100
+            assert sb.array[11] == 100
+        finally:
+            other.close()
+    finally:
+        sb.close(unlink=True)
