@@ -43,7 +43,10 @@ class ModelConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     # "reference" = plain jnp attention; "flash" = the Pallas fused kernel
-    # (ops/flash_attention.py) — identical numerics, no (S, S) scores in HBM
+    # (ops/flash_attention.py) — identical numerics, no (S, S) scores in
+    # HBM; "ring" = sequence-parallel ring attention over the sp axis
+    # (parallel/ring_attention.py) — the long-context path that never
+    # gathers the sequence
     attention_impl: str = "reference"
     # "reference" = inline jnp RMS norm; "fused" = the Pallas kernel
     # (ops/rms_norm.py)
@@ -189,6 +192,11 @@ def attention_sublayer(x: jax.Array, blk: dict, positions: jax.Array,
             attn = _sharded_flash(q, k, v, mesh)
         else:
             attn = flash_attention(q, k, v, True)
+    elif cfg.attention_impl == "ring" and mesh is not None:
+        from faabric_tpu.parallel.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, mesh, axis="sp",
+                              batch_axis="dp", head_axis="tp")
     else:
         attn = _attention(q, k, v)
     return x + jnp.einsum("bshe,hed->bsd", attn,
@@ -219,7 +227,8 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
     if mesh is not None:
         downgrade = {}
         if cfg.attention_impl == "flash" and mesh.shape.get("sp", 1) > 1:
-            downgrade["attention_impl"] = "reference"
+            # Flash is per-shard; sequence sharding needs the ring path
+            downgrade["attention_impl"] = "ring"
         if cfg.norm_impl == "fused":
             downgrade["norm_impl"] = "reference"
         if downgrade:

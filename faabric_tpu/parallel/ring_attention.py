@@ -31,20 +31,24 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _mark_varying(x, axis: str):
-    """Tag a locally-built array as device-varying over the ring axis (the
-    fori_loop carry types must match its ppermute'd outputs). API moved
-    pvary → pcast(to='varying') across JAX versions."""
+def _mark_varying(x, axes: tuple[str, ...]):
+    """Tag a locally-built array as device-varying over the given mesh
+    axes (the fori_loop carry types must match its shard-derived outputs).
+    API moved pvary → pcast(to='varying') across JAX versions."""
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, (axis,), to="varying")
+        return jax.lax.pcast(x, axes, to="varying")
     if hasattr(jax.lax, "pvary"):  # pragma: no cover — older JAX
-        return jax.lax.pvary(x, (axis,))
+        return jax.lax.pvary(x, axes)
     return x  # pragma: no cover — oldest JAX has no varying check
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = True):
-    """q/k/v (B, S, H, D) sharded (B, S/axis, H, D); returns same sharding.
+                   causal: bool = True, batch_axis: str | None = None,
+                   head_axis: str | None = None):
+    """q/k/v (B, S, H, D) with S sharded over ``axis``; B and H may
+    additionally shard over ``batch_axis``/``head_axis`` (attention is
+    independent across batch and heads, so those axes never communicate).
+    Returns the same sharding.
 
     Within each rotation step, device i holds Q block i and K/V block
     ((i - step) mod n); causal masking uses the blocks' global positions,
@@ -55,13 +59,15 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         from faabric_tpu.ops.flash_attention import _reference_attention
 
         return _reference_attention(q, k, v, causal)
-    return _compiled_ring(mesh, axis, causal)(q, k, v)
+    return _compiled_ring(mesh, axis, causal, batch_axis, head_axis)(q, k, v)
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_ring(mesh: Mesh, axis: str, causal: bool):
-    """One jitted shard_map per (mesh, axis, causal) — eager callers must
-    hit the jit cache, not retrace per invocation."""
+def _compiled_ring(mesh: Mesh, axis: str, causal: bool,
+                   batch_axis: str | None = None,
+                   head_axis: str | None = None):
+    """One jitted shard_map per signature — eager callers must hit the jit
+    cache, not retrace per invocation."""
     n = mesh.shape[axis]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -76,7 +82,10 @@ def _compiled_ring(mesh: Mesh, axis: str, causal: bool):
         m0 = jnp.full((b, h, s_l), NEG_INF, dtype=jnp.float32)
         l0 = jnp.zeros((b, h, s_l), dtype=jnp.float32)
         acc0 = jnp.zeros((b, s_l, h, d), dtype=jnp.float32)
-        m0, l0, acc0 = (_mark_varying(x, axis) for x in (m0, l0, acc0))
+        varying_axes = tuple(a for a in (axis, batch_axis, head_axis)
+                             if a is not None)
+        m0, l0, acc0 = (_mark_varying(x, varying_axes)
+                        for x in (m0, l0, acc0))
 
         def step(i, carry):
             m_prev, l_prev, acc, k_cur, v_cur = carry
@@ -114,7 +123,7 @@ def _compiled_ring(mesh: Mesh, axis: str, causal: bool):
         out = acc / l.transpose(0, 2, 1)[..., None]
         return out.astype(q_blk.dtype)
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, head_axis, None)
     return jax.jit(shard_map(local_fn, mesh=mesh,
                              in_specs=(spec, spec, spec),
                              out_specs=spec))

@@ -201,3 +201,59 @@ def test_sharded_flash_attention_matches_reference_forward():
     out = jax.jit(lambda p, t: forward(p, t, cfg_flash, mesh))(
         sharded_params, sharded_tokens)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
+
+
+def test_model_ring_attention_under_sp_mesh():
+    """attention_impl='ring' trains with sequence-parallel attention —
+    forward equals the unsharded reference under a (dp, tp, sp) mesh."""
+    from faabric_tpu.models import (
+        ModelConfig,
+        data_sharding,
+        forward,
+        init_params,
+        param_shardings,
+    )
+
+    kw = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+              max_seq=128, compute_dtype=jnp.float32)
+    cfg_ref = ModelConfig(**kw)
+    cfg_ring = ModelConfig(**kw, attention_impl="ring")
+    params = init_params(jax.random.PRNGKey(3), cfg_ref)
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, 128, (4, 128)), dtype=jnp.int32)
+    ref = np.asarray(forward(params, tokens, cfg_ref))
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=2, tp=2, sp=2))
+    sharded_params = jax.device_put(params, param_shardings(mesh, cfg_ring))
+    sharded_tokens = jax.device_put(tokens, data_sharding(mesh))
+    out = jax.jit(lambda p, t: forward(p, t, cfg_ring, mesh))(
+        sharded_params, sharded_tokens)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
+
+
+def test_model_flash_downgrades_to_ring_under_sp():
+    """flash under sp > 1 automatically takes the ring path and still
+    matches the reference."""
+    from faabric_tpu.models import (
+        ModelConfig,
+        data_sharding,
+        forward,
+        init_params,
+        param_shardings,
+    )
+
+    kw = dict(vocab_size=128, d_model=32, n_layers=1, n_heads=4, d_ff=64,
+              max_seq=64, compute_dtype=jnp.float32)
+    cfg_ref = ModelConfig(**kw)
+    cfg_flash = ModelConfig(**kw, attention_impl="flash")
+    params = init_params(jax.random.PRNGKey(4), cfg_ref)
+    tokens = jnp.asarray(
+        np.random.RandomState(4).randint(0, 128, (2, 64)), dtype=jnp.int32)
+    ref = np.asarray(forward(params, tokens, cfg_ref))
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=2, sp=4))
+    sharded_params = jax.device_put(params, param_shardings(mesh, cfg_flash))
+    sharded_tokens = jax.device_put(tokens, data_sharding(mesh))
+    out = jax.jit(lambda p, t: forward(p, t, cfg_flash, mesh))(
+        sharded_params, sharded_tokens)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
