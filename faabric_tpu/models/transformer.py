@@ -220,10 +220,10 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
                 x, NamedSharding(mesh, P(*spec)))
         return x
 
-    # Flash under a mesh runs shard_mapped over (dp, tp) — but a
-    # sequence-sharded model (sp > 1) needs ring attention semantics, so
-    # it keeps the natively-partitionable reference path. The fused norm
-    # kernel stays single-stream.
+    # Flash under a mesh runs shard_mapped over (dp, tp); a
+    # sequence-sharded model (sp > 1) routes to ring attention instead,
+    # which keeps the sequence distributed. The fused norm kernel stays
+    # single-stream.
     if mesh is not None:
         downgrade = {}
         if cfg.attention_impl == "flash" and mesh.shape.get("sp", 1) > 1:

@@ -175,9 +175,15 @@ def test_ring_attention_cached_compilation():
     assert f1 is f2  # eager callers hit the jit cache
 
 
-def test_sharded_flash_attention_matches_reference_forward():
-    """Flash under a (dp, tp) mesh — shard_mapped Pallas kernel per local
-    slab — equals the unsharded reference forward."""
+@pytest.mark.parametrize("attention_impl,mesh_cfg", [
+    ("flash", MeshConfig(dp=4, tp=2)),       # shard_mapped Pallas kernel
+    ("ring", MeshConfig(dp=2, tp=2, sp=2)),  # sequence-parallel ring
+    ("flash", MeshConfig(dp=2, sp=4)),       # flash downgrades to ring
+])
+def test_model_attention_impls_match_reference_under_mesh(attention_impl,
+                                                          mesh_cfg):
+    """Every attention implementation under every supported mesh topology
+    equals the unsharded reference forward."""
     from faabric_tpu.models import (
         ModelConfig,
         data_sharding,
@@ -189,71 +195,15 @@ def test_sharded_flash_attention_matches_reference_forward():
     kw = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
               max_seq=128, compute_dtype=jnp.float32)
     cfg_ref = ModelConfig(**kw)
-    cfg_flash = ModelConfig(**kw, attention_impl="flash")
+    cfg_impl = ModelConfig(**kw, attention_impl=attention_impl)
     params = init_params(jax.random.PRNGKey(2), cfg_ref)
     tokens = jnp.asarray(
         np.random.RandomState(2).randint(0, 128, (4, 128)), dtype=jnp.int32)
     ref = np.asarray(forward(params, tokens, cfg_ref))
 
-    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=4, tp=2))
-    sharded_params = jax.device_put(params, param_shardings(mesh, cfg_flash))
+    mesh = build_mesh(jax.devices()[:8], mesh_cfg)
+    sharded_params = jax.device_put(params, param_shardings(mesh, cfg_impl))
     sharded_tokens = jax.device_put(tokens, data_sharding(mesh))
-    out = jax.jit(lambda p, t: forward(p, t, cfg_flash, mesh))(
-        sharded_params, sharded_tokens)
-    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
-
-
-def test_model_ring_attention_under_sp_mesh():
-    """attention_impl='ring' trains with sequence-parallel attention —
-    forward equals the unsharded reference under a (dp, tp, sp) mesh."""
-    from faabric_tpu.models import (
-        ModelConfig,
-        data_sharding,
-        forward,
-        init_params,
-        param_shardings,
-    )
-
-    kw = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
-              max_seq=128, compute_dtype=jnp.float32)
-    cfg_ref = ModelConfig(**kw)
-    cfg_ring = ModelConfig(**kw, attention_impl="ring")
-    params = init_params(jax.random.PRNGKey(3), cfg_ref)
-    tokens = jnp.asarray(
-        np.random.RandomState(3).randint(0, 128, (4, 128)), dtype=jnp.int32)
-    ref = np.asarray(forward(params, tokens, cfg_ref))
-
-    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=2, tp=2, sp=2))
-    sharded_params = jax.device_put(params, param_shardings(mesh, cfg_ring))
-    sharded_tokens = jax.device_put(tokens, data_sharding(mesh))
-    out = jax.jit(lambda p, t: forward(p, t, cfg_ring, mesh))(
-        sharded_params, sharded_tokens)
-    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
-
-
-def test_model_flash_downgrades_to_ring_under_sp():
-    """flash under sp > 1 automatically takes the ring path and still
-    matches the reference."""
-    from faabric_tpu.models import (
-        ModelConfig,
-        data_sharding,
-        forward,
-        init_params,
-        param_shardings,
-    )
-
-    kw = dict(vocab_size=128, d_model=32, n_layers=1, n_heads=4, d_ff=64,
-              max_seq=64, compute_dtype=jnp.float32)
-    cfg_ref = ModelConfig(**kw)
-    cfg_flash = ModelConfig(**kw, attention_impl="flash")
-    params = init_params(jax.random.PRNGKey(4), cfg_ref)
-    tokens = jnp.asarray(
-        np.random.RandomState(4).randint(0, 128, (2, 64)), dtype=jnp.int32)
-    ref = np.asarray(forward(params, tokens, cfg_ref))
-
-    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=2, sp=4))
-    sharded_params = jax.device_put(params, param_shardings(mesh, cfg_flash))
-    sharded_tokens = jax.device_put(tokens, data_sharding(mesh))
-    out = jax.jit(lambda p, t: forward(p, t, cfg_flash, mesh))(
+    out = jax.jit(lambda p, t: forward(p, t, cfg_impl, mesh))(
         sharded_params, sharded_tokens)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
