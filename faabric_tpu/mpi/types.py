@@ -111,6 +111,21 @@ def apply_op(op: MpiOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out.astype(a.dtype, copy=False)
 
 
+def apply_op_inplace(op: MpiOp, acc: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Accumulate ``b`` into ``acc`` without allocating when the ufunc's
+    result dtype matches (the reduce-tree hot path: one fewer buffer per
+    received contribution)."""
+    fn = _NP_OPS.get(op)
+    if fn is None:
+        raise NotImplementedError(f"MPI op {op} not supported")
+    if (acc.flags.writeable and acc.dtype == b.dtype
+            and op in (MpiOp.SUM, MpiOp.PROD, MpiOp.MAX,
+                       MpiOp.MIN, MpiOp.BAND, MpiOp.BOR)):
+        fn(acc, b, out=acc)
+        return acc
+    return apply_op(op, acc, b)
+
+
 class MpiMessageType(enum.IntEnum):
     # mirror of MpiMessage.h MpiMessageType
     NORMAL = 0

@@ -34,6 +34,7 @@ from faabric_tpu.mpi.types import (
     MpiOp,
     MpiStatus,
     apply_op,
+    apply_op_inplace,
     mpi_dtype_for,
     pack_mpi_payload,
     unpack_mpi_payload,
@@ -326,12 +327,12 @@ class MpiWorld:
             for r in self.ranks_on_host(root_host):
                 if r != root:
                     arr, _ = self._recv_raw(r, root)
-                    acc = apply_op(op, acc, arr)
+                    acc = apply_op_inplace(op, acc, arr)
             # One partial result per remote host
             for host in self.hosts():
                 if host != root_host:
                     arr, _ = self._recv_raw(self.local_leader(host), root)
-                    acc = apply_op(op, acc, arr)
+                    acc = apply_op_inplace(op, acc, arr)
             return acc
 
         if my_host == root_host:
@@ -344,7 +345,7 @@ class MpiWorld:
             for r in self.ranks_on_host(my_host):
                 if r != rank:
                     arr, _ = self._recv_raw(r, rank)
-                    acc = apply_op(op, acc, arr)
+                    acc = apply_op_inplace(op, acc, arr)
             self.send(rank, root, acc, MpiMessageType.REDUCE)
             return None
 
