@@ -57,9 +57,15 @@ class HttpMessageType(enum.IntEnum):
 
 class PlannerHttpEndpoint:
     def __init__(self, port: int | None = None,
-                 planner: Optional[Planner] = None) -> None:
+                 planner: Optional[Planner] = None,
+                 host: str | None = None) -> None:
         conf = get_system_config()
         self.port = port if port is not None else conf.endpoint_port
+        # The REST API exposes destructive unauthenticated ops (RESET,
+        # FLUSH, SET_POLICY...): bind loopback unless ENDPOINT_INTERFACE
+        # explicitly widens the exposure (e.g. "0.0.0.0" for a cluster)
+        self.host = (host if host is not None
+                     else conf.endpoint_interface or "127.0.0.1")
         self.planner = planner or get_planner()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -90,7 +96,7 @@ class PlannerHttpEndpoint:
             def log_message(self, fmt, *args):  # quiet
                 logger.debug("http: " + fmt, *args)
 
-        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="planner-http", daemon=True)
         self._thread.start()

@@ -49,14 +49,24 @@ def restore_train_state(path: str, mesh=None, cfg=None,
     path = os.path.abspath(path)
     ckpt = _checkpointer()
 
+    # MoE configs carry expert/router params: dispatch init/sharding helpers
+    # on config type once for both the template and placement blocks
+    init_fn = shardings_fn = None
+    if cfg is not None:
+        from faabric_tpu.models.moe import (MoEConfig, init_moe_params,
+                                            moe_param_shardings)
+        from faabric_tpu.models.transformer import init_params, param_shardings
+
+        is_moe = isinstance(cfg, MoEConfig)
+        init_fn = init_moe_params if is_moe else init_params
+        shardings_fn = moe_param_shardings if is_moe else param_shardings
+
     template = None  # noqa: assigned below when cfg+optimizer given
     if cfg is not None and optimizer is not None:
         # Zero-weight template gives orbax the exact target structure
-        from faabric_tpu.models.transformer import init_params
-
         t_params = jax.tree.map(
             lambda s: np.zeros(s.shape, s.dtype),
-            jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg)))
+            jax.eval_shape(lambda: init_fn(jax.random.PRNGKey(0), cfg)))
         template = {"params": t_params,
                     "opt_state": optimizer.init(t_params),
                     "step": np.asarray(0)}
@@ -69,9 +79,7 @@ def restore_train_state(path: str, mesh=None, cfg=None,
     step = int(np.asarray(state["step"]))
 
     if mesh is not None and cfg is not None:
-        from faabric_tpu.models.transformer import param_shardings
-
-        params = jax.device_put(params, param_shardings(mesh, cfg))
+        params = jax.device_put(params, shardings_fn(mesh, cfg))
         if optimizer is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 

@@ -87,8 +87,7 @@ def _compiled_ring(mesh: Mesh, axis: str, causal: bool,
         m0, l0, acc0 = (_mark_varying(x, varying_axes)
                         for x in (m0, l0, acc0))
 
-        def step(i, carry):
-            m_prev, l_prev, acc, k_cur, v_cur = carry
+        def fold(i, m_prev, l_prev, acc, k_cur, v_cur):
             kv_idx = (my_idx - i) % n
 
             scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
@@ -109,14 +108,21 @@ def _compiled_ring(mesh: Mesh, axis: str, causal: bool,
             acc_new = acc * correction.transpose(0, 2, 1)[..., None] \
                 + jnp.einsum("bhqk,bkhd->bqhd", p,
                              v_cur.astype(jnp.float32))
+            return m_new, l_new, acc_new
 
+        def step(i, carry):
+            m_prev, l_prev, acc, k_cur, v_cur = carry
+            m_new, l_new, acc_new = fold(i, m_prev, l_prev, acc, k_cur, v_cur)
             # Rotate K/V to the next ring neighbour (ICI hop)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return m_new, l_new, acc_new, k_nxt, v_nxt
 
-        m, l, acc, _, _ = jax.lax.fori_loop(
-            0, n, step, (m0, l0, acc0, k_blk, v_blk))
+        # Steps 0..n-2 fold-then-rotate; the final block folds outside the
+        # loop so no rotation result is ever discarded (2 ICI hops saved)
+        m, l, acc, k_last, v_last = jax.lax.fori_loop(
+            0, n - 1, step, (m0, l0, acc0, k_blk, v_blk))
+        m, l, acc = fold(n - 1, m, l, acc, k_last, v_last)
         # Guard fully-masked rows (l == 0 cannot happen causally for row 0
         # of block 0 since the diagonal is unmasked, but stay safe)
         l = jnp.maximum(l, 1e-30)

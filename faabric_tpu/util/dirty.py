@@ -8,8 +8,8 @@ tracking is comparison-based:
 
 - ``compare``: keep a baseline copy, vectorised page compare (numpy).
 - ``native``: same baseline, memcmp per page in C++ (util/native.py).
-- ``hash``: per-page 64-bit universal-hash baseline — one eighth the
-  memory of a full copy, vectorised blockwise.
+- ``hash``: per-page 64-bit universal-hash baseline — 8 bytes per 4 KiB
+  page (~1/512 the memory of a full copy), vectorised blockwise.
 - ``none``: every page reported dirty (the reference's fallback).
 
 Same interface as the reference: global + thread-local start/stop, page
@@ -125,13 +125,19 @@ class NativeCompareTracker(CompareTracker):
         cur = _as_array(mem)
         if lib is None:
             return super()._diff(baseline, mem)
-        size = min(cur.size, baseline.size)
-        flags = np.zeros(n_pages(size), dtype=np.uint8)
-        cur_c = np.ascontiguousarray(cur[:size])
-        base_c = np.ascontiguousarray(baseline[:size])
-        lib.diff_pages(base_c.ctypes.data, cur_c.ctypes.data, size,
-                       PAGE_SIZE, flags.ctypes.data)
-        return flags.astype(bool)
+        cmp_size = min(cur.size, baseline.size)
+        flags = np.zeros(n_pages(cur.size), dtype=np.uint8)
+        if cmp_size:
+            cur_c = np.ascontiguousarray(cur[:cmp_size])
+            base_c = np.ascontiguousarray(baseline[:cmp_size])
+            lib.diff_pages(base_c.ctypes.data, cur_c.ctypes.data, cmp_size,
+                           PAGE_SIZE, flags.ctypes.data)
+        out = flags.astype(bool)
+        # Pages past the baseline (memory grew mid-batch) are dirty by
+        # definition — mirrors CompareTracker._diff
+        if cur.size > baseline.size:
+            out[baseline.size // PAGE_SIZE:] = True
+        return out
 
 
 # Random per-byte-position multipliers for the vectorised page hash: a
@@ -145,8 +151,8 @@ _HASH_BLOCK_PAGES = 4096  # bound the widened intermediate to ~128 MiB
 
 
 class HashTracker(DirtyTracker):
-    """Per-page 64-bit baseline hash — half the memory of a full copy.
-    Hashing is a vectorised blockwise dot product (no per-page Python
+    """Per-page 64-bit baseline hash — 8 bytes per 4 KiB page instead of
+    a full copy. Hashing is a vectorised blockwise dot product (no per-page Python
     loop): this brackets every executor task, so it must not dwarf the
     guest work."""
 

@@ -148,6 +148,34 @@ def test_checkpoint_restore_continues_identically(tmp_path):
     assert abs(float(loss_a) - float(loss_b)) < 1e-5
 
 
+def test_checkpoint_restore_moe(tmp_path):
+    """MoE checkpoints restore through the MoE template/shardings path
+    (regression: template was built from the dense init unconditionally)."""
+    from faabric_tpu.models import make_optimizer
+    from faabric_tpu.models.checkpoint import (
+        restore_train_state,
+        save_train_state,
+    )
+    from faabric_tpu.models.moe import MoEConfig, init_moe_params
+    from faabric_tpu.parallel import MeshConfig, build_mesh
+
+    cfg = MoEConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                    d_ff=64, max_seq=32, n_experts=2,
+                    compute_dtype=jnp.float32)
+    mesh = build_mesh(config=MeshConfig(dp=4, ep=2))
+    opt = make_optimizer()
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    opt_state = opt.init(params)
+
+    path = str(tmp_path / "moe_ckpt")
+    save_train_state(path, params, opt_state, step=3)
+    r_params, r_opt, step = restore_train_state(path, mesh, cfg, opt)
+    assert step == 3
+    assert jax.tree.structure(r_params) == jax.tree.structure(params)
+    for a, b in zip(jax.tree.leaves(r_params), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
 # ---------------------------------------------------------------------------
 # Util parity
 # ---------------------------------------------------------------------------

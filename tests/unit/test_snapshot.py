@@ -51,6 +51,20 @@ def test_thread_local_tracking_isolated(mode):
     assert list(np.where(global_flags)[0]) == [0, 1]
 
 
+@pytest.mark.parametrize("mode", ["compare", "native", "hash"])
+def test_dirty_tracking_memory_growth(mode):
+    """Pages appended after the baseline must be reported dirty (regression:
+    the native tracker used to truncate flags to the baseline size)."""
+    mem = np.zeros(PAGE_SIZE * 2, dtype=np.uint8)
+    tracker = make_dirty_tracker(mode)
+    tracker.start_tracking(mem)
+    grown = np.concatenate([mem, np.zeros(PAGE_SIZE * 2 + 10, np.uint8)])
+    grown[PAGE_SIZE] = 7  # page 1 (within baseline)
+    flags = tracker.get_dirty_pages(grown)
+    assert flags.size == 5
+    assert list(np.where(flags)[0]) == [1, 2, 3, 4]
+
+
 # ---------------------------------------------------------------------------
 # Snapshot diffs + merge regions
 # ---------------------------------------------------------------------------
