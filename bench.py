@@ -6,8 +6,17 @@ allreduce effective rate using the same workload formula
 4·(np−1)·payload_bytes/s with the ResNet-50-scale payload, plus
 point-to-point dispatch latency — the BASELINE.md north-star metric
 (<1 ms p50) — measured over real loopback sockets between two aliased
-hosts. When a device is reachable it also times the flagship model's
-compiled train step.
+hosts.
+
+The device phase (run in a watchdog subprocess, staged full→tiny→CPU so a
+wedged TPU tunnel can never zero the round) times:
+- the flagship compiled train step with the Pallas kernels (auto =
+  flash attention + fused norm on TPU) AND with the reference jnp impls,
+  reporting both and the MFU (6·N·tokens/s over platform peak FLOPs);
+- a DeviceCollectives.allreduce bandwidth curve 1 MiB → 1 GiB with bus
+  bandwidth (NCCL convention, 2·(n−1)/n · S/t) and % of ICI ring
+  bandwidth when n ≥ 2 — the BASELINE.json north star;
+- HBM read+write bandwidth (single-chip proxy for the memory system).
 
 Headline metric: ptp_dispatch_p50_ms (vs_baseline = 1 ms target / actual,
 >1 is better than target). Secondary numbers ride in "extras".
@@ -21,6 +30,36 @@ import random
 import sys
 import threading
 import time
+
+# Peak dense bf16 FLOP/s and ICI per-link one-direction bandwidth (B/s)
+# per TPU generation; public numbers (jax-ml.github.io/scaling-book).
+# A bidirectional ring over one torus axis can use 2·link_bw, which is
+# the denominator for pct_of_ici_ring.
+_TPU_SPECS = {
+    "v2": {"peak_flops": 45e12, "ici_link_bw": 0.0},
+    "v3": {"peak_flops": 123e12, "ici_link_bw": 0.0},
+    "v4": {"peak_flops": 275e12, "ici_link_bw": 4.5e10},
+    "v5e": {"peak_flops": 197e12, "ici_link_bw": 4.5e10},
+    "v5p": {"peak_flops": 459e12, "ici_link_bw": 9e10},
+    "v6e": {"peak_flops": 918e12, "ici_link_bw": 9e10},
+}
+
+
+# libtpu device_kind strings use "lite" names for the e-series
+# (e.g. "TPU v5 lite" = v5e, "TPU v6 lite" = v6e)
+_TPU_KIND_ALIASES = {"v5lite": "v5e", "v6lite": "v6e"}
+
+
+def _tpu_spec(device_kind: str) -> dict | None:
+    kind = device_kind.lower().replace(" ", "")
+    for alias, name in _TPU_KIND_ALIASES.items():
+        if alias in kind:
+            return _TPU_SPECS[name]
+    # longest-match so "v5e"/"v5p" win over "v5"
+    for name in sorted(_TPU_SPECS, key=len, reverse=True):
+        if name in kind:
+            return _TPU_SPECS[name]
+    return None
 
 
 def bench_ptp_dispatch(iters: int = 400) -> dict:
@@ -134,11 +173,15 @@ def bench_host_allreduce(n_ranks: int = 4, elems: int = 25_500_000,
             "payload_mib": payload_bytes / (1 << 20), "rounds": rounds}
 
 
-def bench_device_step() -> dict:
-    """Flagship model compiled train step on the available device."""
-    from faabric_tpu.util.device_env import force_cpu_if_requested
+def _count_params(params) -> int:
+    import jax
 
-    force_cpu_if_requested()
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+def bench_device_step(tiny: bool = False, attention_impl: str = "auto",
+                      norm_impl: str = "auto") -> dict:
+    """Flagship model compiled train step on the available device."""
     import jax
     import numpy as np
 
@@ -148,17 +191,25 @@ def bench_device_step() -> dict:
         init_train_state,
         make_train_step,
     )
+    from faabric_tpu.models.transformer import resolve_impls
     from faabric_tpu.parallel import MeshConfig, build_mesh
 
     devices = jax.devices()
     n = len(devices)
-    cfg = ModelConfig(vocab_size=8192, d_model=512, n_layers=4, n_heads=8,
-                      d_ff=2048, max_seq=512)
+    if tiny:
+        cfg = ModelConfig(vocab_size=1024, d_model=128, n_layers=2,
+                          n_heads=4, d_ff=512, max_seq=128,
+                          attention_impl=attention_impl, norm_impl=norm_impl)
+        batch, seq = 2 * n, 128
+    else:
+        cfg = ModelConfig(vocab_size=8192, d_model=512, n_layers=4,
+                          n_heads=8, d_ff=2048, max_seq=512,
+                          attention_impl=attention_impl, norm_impl=norm_impl)
+        batch, seq = 8 * n, 512
     mesh = build_mesh(devices, MeshConfig())
     params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
     step = make_train_step(cfg, mesh)
 
-    batch, seq = 8 * n, 512
     rng = np.random.RandomState(0)
     tokens = jax.device_put(
         rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32),
@@ -179,13 +230,142 @@ def bench_device_step() -> dict:
     elapsed = time.perf_counter() - t0
 
     tokens_per_s = batch * seq * n_steps / elapsed
-    return {
+    resolved = resolve_impls(cfg, mesh)
+    out = {
         "platform": devices[0].platform,
+        "device_kind": getattr(devices[0], "device_kind", ""),
         "n_devices": n,
+        "attention_impl": resolved.attention_impl,
+        "norm_impl": resolved.norm_impl,
         "step_ms": 1000 * elapsed / n_steps,
         "tokens_per_s": tokens_per_s,
         "loss": float(loss),
+        "n_params": _count_params(params),
     }
+    # MFU: train step ≈ 6·N FLOPs/token (2 fwd + 4 bwd), vs platform peak
+    spec = _tpu_spec(out["device_kind"]) if out["platform"] == "tpu" else None
+    if spec:
+        model_flops = 6.0 * out["n_params"] * tokens_per_s
+        out["mfu"] = model_flops / (spec["peak_flops"] * n)
+    return out
+
+
+def bench_device_allreduce(tiny: bool = False) -> dict:
+    """DeviceCollectives.allreduce bandwidth curve (north star #1,
+    BASELINE.json; workload analog mpi_bench.cpp:60-85).
+
+    Bus bandwidth uses the NCCL convention 2·(n−1)/n·S/t with S = bytes
+    per rank. pct_of_ici_ring compares against 2·ICI-link bandwidth (a
+    bidirectional ring over one torus axis) and needs n ≥ 2 TPU chips;
+    on a single chip the collective is a compiled no-op, so the curve is
+    recorded but the ICI percentage is marked unavailable.
+    """
+    import jax
+    import numpy as np
+
+    from faabric_tpu.mpi.types import MpiOp
+    from faabric_tpu.parallel.collectives import DeviceCollectives
+
+    devices = jax.devices()
+    n = len(devices)
+    col = DeviceCollectives(devices)
+
+    mibs = [1, 16, 128] if tiny else [1, 16, 128, 1024]
+    curve = []
+    for mib in mibs:
+        elems = mib * (1 << 20) // 4  # float32, per rank
+        try:
+            x = col.shard_stacked(
+                [np.full(elems, r, np.float32) for r in range(n)])
+            out = col.allreduce(x, MpiOp.SUM)  # compile + warmup
+            jax.block_until_ready(out)
+            iters = 2 if mib >= 1024 else 5
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = col.allreduce(x, MpiOp.SUM)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+            s_bytes = elems * 4
+            bus_bw = 2 * (n - 1) / n * s_bytes / dt if n > 1 else s_bytes / dt
+            entry = {"payload_mib": mib, "time_ms": dt * 1000,
+                     "bus_gibs": bus_bw / (1 << 30)}
+            del x, out
+            curve.append(entry)
+        except Exception as e:  # noqa: BLE001 — OOM at the big end is data
+            curve.append({"payload_mib": mib, "error": str(e)[:120]})
+            break
+
+    result = {"platform": devices[0].platform, "n_devices": n,
+              "curve": curve}
+    spec = (_tpu_spec(getattr(devices[0], "device_kind", ""))
+            if devices[0].platform == "tpu" else None)
+    if spec and spec["ici_link_bw"] and n > 1:
+        ring_bw = 2 * spec["ici_link_bw"]
+        best = max((c.get("bus_gibs", 0) for c in curve), default=0)
+        result["ici_ring_gibs"] = ring_bw / (1 << 30)
+        result["pct_of_ici_ring"] = 100.0 * best * (1 << 30) / ring_bw
+    elif n == 1:
+        result["ici_note"] = ("single chip: allreduce is a compiled no-op; "
+                              "ICI % needs >= 2 chips (driver dryrun "
+                              "validates the multi-chip path)")
+    return result
+
+
+def bench_hbm_bandwidth() -> dict:
+    """HBM read+write bandwidth via a big on-device copy-scale (x·2 over
+    256 MiB touches 512 MiB of HBM traffic per iter)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_bytes = 256 * (1 << 20)
+    x = jnp.arange(n_bytes // 4, dtype=jnp.float32)
+    f = jax.jit(lambda a: a * 2.0)
+    jax.block_until_ready(f(x))
+    iters = 10
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(iters):
+        y = f(y)
+    jax.block_until_ready(y)
+    dt = (time.perf_counter() - t0) / iters
+    return {"traffic_gibs": 2 * n_bytes / dt / (1 << 30),
+            "payload_mib": n_bytes >> 20}
+
+
+def bench_device_phase(tiny: bool = False, out_path: str | None = None) -> dict:
+    """All device benches, writing each completed section to ``out_path``
+    immediately so a watchdog kill still leaves partial results."""
+    from faabric_tpu.util.device_env import force_cpu_if_requested
+
+    force_cpu_if_requested()
+    import jax
+
+    results: dict = {"platform": jax.default_backend(),
+                     "n_devices": len(jax.devices())}
+
+    def flush():
+        # Atomic replace: a watchdog kill mid-write must never leave a
+        # truncated file that discards the sections already completed
+        if out_path:
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(results, f)
+            os.replace(tmp, out_path)
+
+    flush()
+    for name, fn in [
+        ("step", lambda: bench_device_step(tiny)),
+        ("step_reference", lambda: bench_device_step(
+            tiny, attention_impl="reference", norm_impl="reference")),
+        ("allreduce", lambda: bench_device_allreduce(tiny)),
+        ("hbm", bench_hbm_bandwidth),
+    ]:
+        try:
+            results[name] = fn()
+        except Exception as e:  # noqa: BLE001
+            results[name + "_error"] = str(e)[:200]
+        flush()
+    return results
 
 
 def main() -> None:
@@ -208,37 +388,77 @@ def main() -> None:
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
         # Device init on the remote-TPU tunnel can wedge for minutes; run
         # the device phase under a watchdog subprocess so the harness
-        # always prints its line.
+        # always prints its line. Stages: (1) TPU full shapes with a
+        # long first-compile budget, (2) TPU tiny shapes, (3) CPU — the
+        # TPU gets two chances before any CPU fallback (round-2 failure
+        # mode: one 360 s attempt, then CPU). The subprocess streams each
+        # completed section to a temp file, so even a watchdog kill keeps
+        # the sections that finished; the XLA compilation cache under
+        # .jax_cache makes retries/reruns skip recompilation.
         import subprocess
+        import tempfile
 
-        timeout_s = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "360"))
+        repo = os.path.dirname(os.path.abspath(__file__))
+        cache_env = {"JAX_COMPILATION_CACHE_DIR":
+                     os.path.join(repo, ".jax_cache")}
 
-        def run_device(env_extra: dict) -> tuple[dict | None, str]:
+        def run_device(env_extra: dict, timeout_s: int,
+                       tiny: bool) -> tuple[dict | None, str]:
+            fd, out_file = tempfile.mkstemp(suffix=".json",
+                                            prefix="bench_dev_")
+            os.close(fd)
+            argv = [sys.executable, os.path.abspath(__file__),
+                    "--device-only", "--out", out_file]
+            if tiny:
+                argv.append("--tiny")
+            err = ""
             try:
                 proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--device-only"],
-                    capture_output=True, text=True, timeout=timeout_s,
-                    env={**os.environ, **env_extra})
-                line = (proc.stdout.strip().splitlines() or [""])[-1]
-                if proc.returncode == 0 and line.startswith("{"):
-                    return json.loads(line), ""
-                return None, f"rc={proc.returncode}: {proc.stderr[-200:]}"
+                    argv, capture_output=True, text=True, timeout=timeout_s,
+                    env={**os.environ, **cache_env, **env_extra})
+                if proc.returncode != 0:
+                    err = f"rc={proc.returncode}: {proc.stderr[-200:]}"
             except subprocess.TimeoutExpired:
-                return None, f"timeout after {timeout_s}s"
+                err = f"timeout after {timeout_s}s"
             except Exception as e:  # noqa: BLE001
-                return None, str(e)[:200]
+                err = str(e)[:200]
+            partial = None
+            try:
+                with open(out_file) as f:
+                    partial = json.load(f)
+            except Exception:  # noqa: BLE001 — missing/truncated file
+                pass
+            for leftover in (out_file, out_file + ".tmp"):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+            # A file with only the platform header means the device
+            # never produced a number
+            if partial is not None and any(
+                    k in partial for k in
+                    ("step", "allreduce", "hbm", "step_reference")):
+                return partial, err
+            return None, err or "no results produced"
 
-        result_d, err = run_device({})
-        if result_d is None:
-            # TPU tunnel down/wedged: record why, then still produce a
-            # labeled CPU number rather than nothing
-            extras["device_step_error"] = err
-            result_d, err2 = run_device({"JAX_PLATFORMS": "cpu"})
-            if result_d is None:
-                extras["device_step_cpu_error"] = err2
-        if result_d is not None:
-            extras["device_step"] = result_d
+        t_full = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
+        t_tiny = int(os.environ.get("BENCH_DEVICE_TIMEOUT_TINY", "600"))
+        stages = [
+            ("tpu_full", {}, t_full, quick),
+            ("tpu_tiny", {}, t_tiny, True),
+            ("cpu", {"JAX_PLATFORMS": "cpu"}, t_tiny, quick),
+        ]
+        device_errs = {}
+        for name, env_extra, timeout_s, tiny in stages:
+            result_d, err = run_device(env_extra, timeout_s, tiny)
+            if err:
+                device_errs[name] = err
+            if result_d is not None:
+                extras["device"] = result_d
+                extras["device_stage"] = name
+                break
+        if device_errs:
+            extras["device_errors"] = device_errs
 
     p50 = ptp["p50_ms"]
     result = {
@@ -255,6 +475,11 @@ def main() -> None:
 if __name__ == "__main__":
     if "--device-only" in sys.argv:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        print(json.dumps(bench_device_step()))
+        out_path = None
+        if "--out" in sys.argv:
+            out_path = sys.argv[sys.argv.index("--out") + 1]
+        res = bench_device_phase(tiny="--tiny" in sys.argv,
+                                 out_path=out_path)
+        print(json.dumps(res))
     else:
         main()

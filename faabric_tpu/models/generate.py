@@ -76,6 +76,9 @@ def _block_with_cache(x, blk, cache, start, length, cfg: ModelConfig):
 def forward_with_cache(params, tokens, cache, start, cfg: ModelConfig):
     """tokens (B, S) entering at position ``start`` → (logits (B, S, V),
     new cache). length = start + S."""
+    from faabric_tpu.models.transformer import resolve_impls
+
+    cfg = resolve_impls(cfg)
     b, s = tokens.shape
     length = start + s
     x = params["embed"].astype(cfg.compute_dtype)[tokens]

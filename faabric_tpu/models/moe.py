@@ -161,9 +161,10 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
     x = constrain(x, "dp", None, None)
 
-    # Flash shard_maps over (dp, tp); the fused norm stays single-stream
-    if mesh is not None and cfg.norm_impl == "fused":
-        cfg = dataclasses.replace(cfg, norm_impl="reference")
+    # Resolve "auto" kernels + mesh downgrades (flash shard_maps over
+    # (dp, tp); the fused norm stays single-stream)
+    from faabric_tpu.models.transformer import resolve_impls
+    cfg = resolve_impls(cfg, mesh)
 
     aux_total = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:

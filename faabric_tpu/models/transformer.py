@@ -46,11 +46,12 @@ class ModelConfig:
     # (ops/flash_attention.py) — identical numerics, no (S, S) scores in
     # HBM; "ring" = sequence-parallel ring attention over the sp axis
     # (parallel/ring_attention.py) — the long-context path that never
-    # gathers the sequence
-    attention_impl: str = "reference"
+    # gathers the sequence; "auto" (default) = flash on TPU, reference on
+    # CPU (interpret-mode Pallas is for tests, not speed)
+    attention_impl: str = "auto"
     # "reference" = inline jnp RMS norm; "fused" = the Pallas kernel
-    # (ops/rms_norm.py)
-    norm_impl: str = "reference"
+    # (ops/rms_norm.py); "auto" = fused on TPU, reference on CPU
+    norm_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -147,6 +148,28 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def resolve_impls(cfg: ModelConfig, mesh: Optional[Mesh] = None) -> ModelConfig:
+    """Resolve "auto" kernel choices for the current backend, and downgrade
+    combinations the mesh can't run: flash under a mesh runs shard_mapped
+    over (dp, tp), so a sequence-sharded model (sp > 1) routes to ring
+    attention, which keeps the sequence distributed; the fused norm kernel
+    stays single-stream."""
+    att, norm = cfg.attention_impl, cfg.norm_impl
+    on_tpu = jax.default_backend() == "tpu"
+    if att == "auto":
+        att = "flash" if on_tpu else "reference"
+    if norm == "auto":
+        norm = "fused" if on_tpu else "reference"
+    if mesh is not None:
+        if att == "flash" and mesh.shape.get("sp", 1) > 1:
+            att = "ring"
+        if norm == "fused":
+            norm = "reference"
+    if (att, norm) != (cfg.attention_impl, cfg.norm_impl):
+        cfg = dataclasses.replace(cfg, attention_impl=att, norm_impl=norm)
+    return cfg
+
+
 def _norm(x: jax.Array, scale: jax.Array, cfg: ModelConfig) -> jax.Array:
     if cfg.norm_impl == "fused":
         from faabric_tpu.ops.rms_norm import rms_norm
@@ -220,19 +243,7 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
                 x, NamedSharding(mesh, P(*spec)))
         return x
 
-    # Flash under a mesh runs shard_mapped over (dp, tp); a
-    # sequence-sharded model (sp > 1) routes to ring attention instead,
-    # which keeps the sequence distributed. The fused norm kernel stays
-    # single-stream.
-    if mesh is not None:
-        downgrade = {}
-        if cfg.attention_impl == "flash" and mesh.shape.get("sp", 1) > 1:
-            # Flash is per-shard; sequence sharding needs the ring path
-            downgrade["attention_impl"] = "ring"
-        if cfg.norm_impl == "fused":
-            downgrade["norm_impl"] = "reference"
-        if downgrade:
-            cfg = dataclasses.replace(cfg, **downgrade)
+    cfg = resolve_impls(cfg, mesh)
 
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
