@@ -446,7 +446,9 @@ def main() -> None:
         stages = [
             ("tpu_full", {}, t_full, quick),
             ("tpu_tiny", {}, t_tiny, True),
-            ("cpu", {"JAX_PLATFORMS": "cpu"}, t_tiny, quick),
+            # Last resort gets the full timeout: full shapes on CPU are
+            # slow and this stage must never be the one that gets killed
+            ("cpu", {"JAX_PLATFORMS": "cpu"}, t_full, quick),
         ]
         device_errs = {}
         for name, env_extra, timeout_s, tiny in stages:

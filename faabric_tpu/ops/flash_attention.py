@@ -214,6 +214,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _uses_kernel(q_shape, k_shape, causal, block_q, block_k) -> bool:
     s_q, s_k = q_shape[1], k_shape[1]
+    d = q_shape[-1]
+    # On real TPU hardware, sub-tile shapes (short sequences / narrow
+    # heads vs the 128-lane register tiling) stay on the reference path —
+    # Mosaic lowering of tiny blocks is at best wasteful padding. CPU
+    # interpret mode has no tiling, so tests exercise small shapes.
+    if jax.default_backend() == "tpu" and (
+            s_q < DEFAULT_BLOCK_Q or s_k < DEFAULT_BLOCK_K or d < 64):
+        return False
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
     # Ragged shapes — and the degenerate causal s_q > s_k case, where

@@ -26,3 +26,18 @@ from faabric_tpu.parallel.ring_attention import (  # noqa: E402
 )
 
 __all__ += ["ring_attention", "shard_sequence"]
+
+from faabric_tpu.parallel.pipeline import (  # noqa: E402
+    init_pp_train_state,
+    make_pp_loss,
+    make_pp_train_step,
+    microbatch,
+    pp_data_sharding,
+    pp_param_shardings,
+    stack_block_params,
+    unstack_block_params,
+)
+
+__all__ += ["init_pp_train_state", "make_pp_loss", "make_pp_train_step",
+            "microbatch", "pp_data_sharding", "pp_param_shardings",
+            "stack_block_params", "unstack_block_params"]

@@ -1,0 +1,316 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+TPU-first design — no per-stage processes, no host-driven schedule. The
+whole pipeline is ONE compiled SPMD program:
+
+- Block params stack into leading-``n_layers`` arrays sharded over ``pp``
+  (each stage holds a contiguous slab of ``n_layers / pp`` layers and
+  runs them with ``lax.scan``).
+- The GPipe microbatch schedule is a differentiable ``lax.scan`` over
+  ``M + S − 1`` ticks under ``shard_map``: at tick ``t`` stage ``s``
+  processes microbatch ``t − s``; activations hop stage→stage+1 with a
+  single ``lax.ppermute`` (one ICI neighbour transfer per tick).
+- Reverse-mode AD through the scan + ppermute gives the backward
+  pipeline for free — XLA schedules it as the mirrored permute chain,
+  so ``jax.grad`` of the pipelined loss is itself pipelined.
+- Within a stage, tensor parallelism is Megatron-style: heads/hidden
+  shard over ``tp`` with an explicit ``psum`` after the attention output
+  and MLP down projections (a size-1 ``tp`` axis makes them no-ops).
+- Embedding / final norm / LM head are replicated over ``pp`` (they are
+  small next to the blocks). The schedule is deliberately branch-free —
+  collectives near device-varying ``lax.cond`` deadlock — so every stage
+  embeds each tick (a cheap gather) and selects against the hopped-in
+  activation; last-stage outputs accumulate into a per-microbatch buffer
+  and the LM-head/loss runs once after the loop, scanned one microbatch
+  at a time, masked to the last stage by the final psum.
+
+The reference has no pipeline concept — its "scale the big thing" analog
+is gang-scheduled MPI worlds (SURVEY §5.7); this is the mesh-axis
+incarnation the TPU build must carry.
+
+Schedule math: ``n_ticks(S, M) = M + S − 1``; bubble fraction
+``(S − 1) / (M + S − 1)`` — exposed for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older JAX
+    from jax.experimental.shard_map import shard_map
+
+from faabric_tpu.models.transformer import (
+    ModelConfig,
+    _attention,
+    _rms_norm,
+    _rope,
+)
+from faabric_tpu.parallel.ring_attention import _mark_varying
+
+
+# ---------------------------------------------------------------------------
+# Schedule math (unit-testable without devices)
+# ---------------------------------------------------------------------------
+
+def n_ticks(n_stages: int, n_microbatches: int) -> int:
+    """GPipe ticks to drain the pipeline."""
+    return n_microbatches + n_stages - 1
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Fraction of stage-ticks idle in the fill/drain bubble."""
+    total = n_stages * n_ticks(n_stages, n_microbatches)
+    useful = n_stages * n_microbatches
+    return (total - useful) / total
+
+
+def schedule(n_stages: int, n_microbatches: int) -> list[list[int | None]]:
+    """``schedule(S, M)[t][s]`` = microbatch stage ``s`` works on at tick
+    ``t`` (None = bubble). Mirrors the on-device arithmetic exactly."""
+    out = []
+    for t in range(n_ticks(n_stages, n_microbatches)):
+        row = []
+        for s in range(n_stages):
+            m = t - s
+            row.append(m if 0 <= m < n_microbatches else None)
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Param layout: blocks stacked over a leading layer axis, sharded over pp
+# ---------------------------------------------------------------------------
+
+def stack_block_params(params: dict) -> dict:
+    """Transformer param tree (blocks as a list of dicts) → pipeline tree
+    with each block leaf stacked on a leading (n_layers,) axis."""
+    blocks = params["blocks"]
+    stacked = {k: jnp.stack([blk[k] for blk in blocks])
+               for k in blocks[0]}
+    return {"embed": params["embed"], "stacked": stacked,
+            "ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+
+
+def unstack_block_params(pp_params: dict) -> dict:
+    """Inverse of :func:`stack_block_params` (checkpoint interop)."""
+    stacked = pp_params["stacked"]
+    n_layers = next(iter(stacked.values())).shape[0]
+    blocks = [{k: stacked[k][i] for k in stacked} for i in range(n_layers)]
+    return {"embed": pp_params["embed"], "blocks": blocks,
+            "ln_f": pp_params["ln_f"], "lm_head": pp_params["lm_head"]}
+
+
+def pp_param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
+    """Layer axis over ``pp``; heads/hidden over ``tp``; embed/ln_f/
+    lm_head replicated (small next to the blocks)."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": ns(),
+        "stacked": {
+            "ln1": ns("pp", None),
+            "wqkv": ns("pp", None, None, "tp", None),
+            "wo": ns("pp", "tp", None, None),
+            "ln2": ns("pp", None),
+            "w1": ns("pp", None, "tp"),
+            "w2": ns("pp", "tp", None),
+        },
+        "ln_f": ns(),
+        "lm_head": ns(),
+    }
+
+
+def pp_data_sharding(mesh: Mesh) -> NamedSharding:
+    """(M, B, S) microbatched tokens: batch over dp, microbatch axis
+    replicated (every stage sees every microbatch's tokens; only stage 0
+    embeds them)."""
+    return NamedSharding(mesh, P(None, "dp", None))
+
+
+# ---------------------------------------------------------------------------
+# In-stage compute (Megatron tp inside a pipeline stage)
+# ---------------------------------------------------------------------------
+
+def _pp_block(x, blk, positions, cfg: ModelConfig):
+    """One transformer block on tp-local shards: qkv/w1 column-parallel,
+    wo/w2 row-parallel with a psum over ``tp`` after each."""
+    h = _rms_norm(x, blk["ln1"])
+    qkv = jnp.einsum("bsd,dthe->tbshe", h,
+                     blk["wqkv"].astype(cfg.compute_dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v)
+    attn_out = jnp.einsum("bshe,hed->bsd", attn,
+                          blk["wo"].astype(cfg.compute_dtype))
+    x = x + jax.lax.psum(attn_out, "tp")
+
+    h = _rms_norm(x, blk["ln2"])
+    ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
+    ff_out = ff @ blk["w2"].astype(cfg.compute_dtype)
+    return x + jax.lax.psum(ff_out, "tp")
+
+
+# ---------------------------------------------------------------------------
+# The pipelined loss
+# ---------------------------------------------------------------------------
+
+def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
+                         cfg: ModelConfig, n_stages: int):
+    """Per-device body (under shard_map over dp/tp/pp). tokens_mb/
+    targets_mb: (M, b_local, S)."""
+    s_idx = jax.lax.axis_index("pp")
+    m_count, b_local, seq = tokens_mb.shape
+    d_model = cfg.d_model
+    ticks = n_ticks(n_stages, m_count)
+
+    positions = jnp.broadcast_to(jnp.arange(seq)[None], (b_local, seq))
+    embed = pp_params["embed"]
+    stacked = pp_params["stacked"]
+
+    def stage_fn(x):
+        """Run my slab of layers (scan over the local layer axis)."""
+        def body(h, blk):
+            return _pp_block(h, blk, positions, cfg), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, stacked)
+        return x
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # Branch-free schedule (collectives under device-varying lax.cond
+    # deadlock — every device must run the same collective sequence):
+    # every stage embeds (a cheap gather) and selects between that and
+    # the hopped-in activation; the last stage's outputs accumulate into
+    # a per-microbatch buffer so the LM head runs ONCE after the loop,
+    # not per tick per stage.
+    def tick(carry, t):
+        x_in, outputs = carry
+        m = jnp.clip(t - s_idx, 0, m_count - 1)
+        tokens_m = tokens_mb[m]
+
+        emb = _mark_varying(embed.astype(cfg.compute_dtype)[tokens_m],
+                            ("dp", "pp"))
+        x = jnp.where(s_idx == 0, emb, x_in)
+        y = stage_fn(x)
+
+        active_last = jnp.logical_and(s_idx == n_stages - 1,
+                                      jnp.logical_and(t - s_idx >= 0,
+                                                      t - s_idx < m_count))
+        written = jax.lax.dynamic_update_slice(
+            outputs, y[None], (m, 0, 0, 0))
+        outputs = jnp.where(active_last, written, outputs)
+
+        # One ICI neighbour hop moves every stage's output forward
+        y_next = jax.lax.ppermute(y, "pp", perm)
+        return (y_next, outputs), None
+
+    x0 = _mark_varying(jnp.zeros((b_local, seq, d_model), cfg.compute_dtype),
+                       ("dp", "pp"))
+    out0 = _mark_varying(
+        jnp.zeros((m_count, b_local, seq, d_model), cfg.compute_dtype),
+        ("dp", "pp"))
+    (_, outputs), _ = jax.lax.scan(tick, (x0, out0), jnp.arange(ticks))
+
+    # Loss head scanned one microbatch at a time so peak logits memory
+    # stays (b, S, V) — not M× that. Real data only on the last stage;
+    # other stages' buffers are garbage and get masked out below.
+    def loss_one(acc, y_t):
+        y, targets_m = y_t
+        h = _rms_norm(y, pp_params["ln_f"])
+        logits = (h @ pp_params["lm_head"].astype(cfg.compute_dtype)
+                  ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets_m[..., None],
+                                   axis=-1)[..., 0]
+        return acc + jnp.mean(nll), None
+
+    loss_sum, _ = jax.lax.scan(
+        loss_one, _mark_varying(jnp.zeros((), jnp.float32), ("dp", "pp")),
+        (outputs, targets_mb))
+    local_loss = loss_sum / m_count
+
+    loss = jax.lax.psum(
+        jnp.where(s_idx == n_stages - 1, local_loss, 0.0), "pp")
+    loss = jax.lax.pmean(loss, "dp")
+    return jax.lax.pmean(loss, "tp")  # tp replicas agree; mark it so
+
+
+def make_pp_loss(cfg: ModelConfig, mesh: Mesh):
+    """Jittable ``loss(pp_params, tokens_mb, targets_mb)`` where tokens_mb
+    is (n_microbatches, batch, seq)."""
+    n_stages = mesh.shape["pp"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
+    if mesh.shape.get("sp", 1) > 1 or mesh.shape.get("ep", 1) > 1:
+        raise ValueError("pipeline path supports dp×tp×pp meshes "
+                         "(sp/ep must be 1)")
+
+    param_specs = jax.tree.map(lambda s: s.spec,
+                               pp_param_shardings(mesh, cfg))
+    data_spec = P(None, "dp", None)
+
+    local = partial(_pipeline_loss_local, cfg=cfg, n_stages=n_stages)
+    return shard_map(local, mesh=mesh,
+                     in_specs=(param_specs, data_spec, data_spec),
+                     out_specs=P())
+
+
+def microbatch(tokens: jax.Array, n_microbatches: int) -> jax.Array:
+    """(B, S) → (M, B/M, S)."""
+    b, s = tokens.shape
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by n_microbatches={n_microbatches}")
+    return tokens.reshape(n_microbatches, b // n_microbatches, s)
+
+
+def make_pp_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
+                       n_microbatches: int = 4):
+    """Returns jitted ``step(pp_params, opt_state, tokens, targets) →
+    (pp_params, opt_state, loss)``; tokens/targets are (B, S) and are
+    microbatched internally."""
+    from faabric_tpu.models.train import make_optimizer
+
+    optimizer = optimizer or make_optimizer()
+    loss_fn = make_pp_loss(cfg, mesh)
+
+    def step(pp_params, opt_state, tokens, targets):
+        tok_mb = microbatch(tokens, n_microbatches)
+        tgt_mb = microbatch(targets, n_microbatches)
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tok_mb, tgt_mb))(pp_params)
+        import optax
+
+        updates, opt_state = optimizer.update(grads, opt_state, pp_params)
+        pp_params = optax.apply_updates(pp_params, updates)
+        return pp_params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_pp_train_state(key: jax.Array, cfg: ModelConfig, mesh: Mesh,
+                        optimizer=None):
+    """Stacked params + optimizer state laid out over the pp mesh."""
+    from faabric_tpu.models.train import make_optimizer
+    from faabric_tpu.models.transformer import init_params
+
+    optimizer = optimizer or make_optimizer()
+    pp_params = stack_block_params(init_params(key, cfg))
+    pp_params = jax.device_put(pp_params, pp_param_shardings(mesh, cfg))
+    opt_state = optimizer.init(pp_params)
+    return pp_params, opt_state

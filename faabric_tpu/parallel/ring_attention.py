@@ -33,12 +33,18 @@ NEG_INF = -1e30
 
 def _mark_varying(x, axes: tuple[str, ...]):
     """Tag a locally-built array as device-varying over the given mesh
-    axes (the fori_loop carry types must match its shard-derived outputs).
-    API moved pvary → pcast(to='varying') across JAX versions."""
+    axes (loop-carry / cond-branch types must match shard-derived
+    values). Only the axes the value isn't already varying over are
+    added — pcast rejects re-marking. API moved pvary →
+    pcast(to='varying') across JAX versions."""
+    have = getattr(getattr(x, "aval", None), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in have)
+    if not missing:
+        return x
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
+        return jax.lax.pcast(x, missing, to="varying")
     if hasattr(jax.lax, "pvary"):  # pragma: no cover — older JAX
-        return jax.lax.pvary(x, axes)
+        return jax.lax.pvary(x, missing)
     return x  # pragma: no cover — oldest JAX has no varying check
 
 

