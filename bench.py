@@ -74,7 +74,8 @@ def bench_ptp_dispatch(iters: int = 400) -> dict:
     from faabric_tpu.transport.point_to_point import PointToPointBroker
     from faabric_tpu.transport.ptp_remote import PointToPointServer
 
-    base = random.randint(100, 500) * 100
+    # Stay clear of the ephemeral port range (>=32768)
+    base = random.randint(10, 200) * 100
     register_host_alias("benchA", "127.0.0.1", base)
     register_host_alias("benchB", "127.0.0.1", base + 1000)
     brokers = {h: PointToPointBroker(h) for h in ("benchA", "benchB")}
@@ -171,6 +172,155 @@ def bench_host_allreduce(n_ranks: int = 4, elems: int = 25_500_000,
     broker.clear()
     return {"effective_gibs": gibs, "np": n_ranks,
             "payload_mib": payload_bytes / (1 << 20), "rounds": rounds}
+
+
+def _bench_world(my_host: str, app_id: int = 3):
+    """Both bench processes build the same 4-rank/2-host world: ranks 0-1
+    on xbenchA, 2-3 on xbenchB (mappings installed directly — the planner
+    path is exercised elsewhere; this isolates the data plane)."""
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.mpi import MpiWorld
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+    from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+    d = SchedulingDecision(app_id=app_id, group_id=app_id)
+    d.add_message("xbenchA", 30, 0, 0)
+    d.add_message("xbenchA", 31, 1, 1)
+    d.add_message("xbenchB", 32, 2, 2)
+    d.add_message("xbenchB", 33, 3, 3)
+    broker = PointToPointBroker(my_host)
+    server = PointToPointServer(broker)
+    server.start()
+    broker.set_up_local_mappings_from_decision(d)
+    world = MpiWorld(broker, app_id, 4, app_id)
+    world.refresh_rank_hosts()
+    return broker, server, world
+
+
+def _allreduce_worker_main(elems: int, rounds: int) -> None:
+    """Child process body: ranks 2-3 on xbenchB (aliases via
+    FAABRIC_HOST_ALIASES in the env)."""
+    import numpy as np
+
+    broker, server, world = _bench_world("xbenchB")
+    print("READY", flush=True)
+    errors: list = []
+    try:
+        def rank_fn(rank):
+            try:
+                data = np.full(elems, rank, dtype=np.int32)
+                world.barrier(rank)
+                for _ in range(rounds):
+                    out = world.allreduce(rank, data, MpiOp_SUM())
+                world.barrier(rank)
+                assert out[0] == 6, out[0]  # 0+1+2+3
+            except Exception as e:  # noqa: BLE001 — reported to parent
+                errors.append(f"rank {rank}: {e!r}")
+
+        threads = [threading.Thread(target=rank_fn, args=(r,))
+                   for r in (2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        print(f"FAILED {'; '.join(errors)[:160]}" if errors else "DONE",
+              flush=True)
+    finally:
+        server.stop()
+        broker.clear()
+
+
+def MpiOp_SUM():
+    from faabric_tpu.mpi import MpiOp
+
+    return MpiOp.SUM
+
+
+def bench_host_allreduce_procs(elems: int = 25_500_000,
+                               rounds: int = 3) -> dict:
+    """Cross-PROCESS allreduce over the PTP + bulk data planes: 2 OS
+    processes × 2 ranks, 97 MiB int32 per rank, reference effective-rate
+    formula 4·(np−1)·payload·rounds/elapsed (mpi_bench.cpp:60-85). The
+    cross-process leg rides transport/bulk.py's tuned sockets with
+    chunk-pipelined leader trees.
+
+    Ceiling analysis (compare against extras.host_calibration): one round
+    is serially 2 wire legs (reduce up + broadcast down) + ~4 unavoidable
+    97 MiB copies (root/leader accumulators, broadcast fan-out copies) +
+    3 in-place adds. With memcpy at M GiB/s and loopback at W GiB/s the
+    round floor is ≈ 0.095·(2/W + 4/M + 3/(3·M)) s; the effective rate is
+    1.14 GiB/round over that. On a box with M≈2, W≈2.5 (this dev VM) the
+    ceiling is ≈ 3.4 GiB/s effective; on hardware with M≈10 the same
+    code clears 8+."""
+    import subprocess
+
+    import numpy as np
+
+    from faabric_tpu.transport.common import (
+        clear_host_aliases,
+        register_host_alias,
+    )
+
+    # Listener ports must stay clear of the kernel ephemeral range
+    # (>=32768): max here is 15000 + 9014 (bulk) = 24014
+    base_a = random.randint(10, 120) * 100
+    base_b = base_a + 3000
+    clear_host_aliases()
+    register_host_alias("xbenchA", "127.0.0.1", base_a)
+    register_host_alias("xbenchB", "127.0.0.1", base_b)
+
+    env = {**os.environ,
+           "FAABRIC_HOST_ALIASES":
+           f"xbenchA=127.0.0.1+{base_a},xbenchB=127.0.0.1+{base_b}"}
+    # Parent servers must exist BEFORE the child runs: the child's rank
+    # threads immediately dial the parent-hosted group barrier
+    broker, server, world = _bench_world("xbenchA")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--allreduce-worker",
+         str(elems), str(rounds)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = child.stdout.readline().strip()
+        assert line == "READY", f"worker said {line!r}"
+
+        try:
+            results = {}
+
+            def rank_fn(rank):
+                data = np.full(elems, rank, dtype=np.int32)
+                world.barrier(rank)
+                t0 = time.perf_counter()
+                for _ in range(rounds):
+                    out = world.allreduce(rank, data, MpiOp_SUM())
+                world.barrier(rank)
+                results[rank] = (time.perf_counter() - t0, out[0])
+
+            threads = [threading.Thread(target=rank_fn, args=(r,))
+                       for r in (0, 1)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            status = child.stdout.readline().strip()
+            assert status == "DONE", f"worker reported: {status!r}"
+            elapsed = max(v[0] for v in results.values())
+            assert all(v[1] == 6 for v in results.values()), results
+
+            payload_bytes = elems * 4
+            effective = 4 * 3 * payload_bytes * rounds  # np=4
+            return {"effective_gibs": effective / elapsed / (1 << 30),
+                    "np": 4, "n_processes": 2,
+                    "payload_mib": payload_bytes / (1 << 20),
+                    "rounds": rounds}
+        finally:
+            server.stop()
+            broker.clear()
+    finally:
+        try:
+            child.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            child.kill()
+        clear_host_aliases()
 
 
 def _count_params(params) -> int:
@@ -368,11 +518,72 @@ def bench_device_phase(tiny: bool = False, out_path: str | None = None) -> dict:
     return results
 
 
+def bench_host_calibration() -> dict:
+    """Hardware context for the host-path numbers: what THIS machine's
+    memory system and loopback TCP can do at all. The allreduce effective
+    rate is bounded by ~ (wire legs + tree copies/adds) against these."""
+    import numpy as np
+
+    n = 25_500_000
+    a = np.zeros(n, np.int32)
+    b = np.ones(n, np.int32)
+    a.copy()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        a.copy()
+    memcpy_gibs = 5 * a.nbytes / (time.perf_counter() - t0) / (1 << 30)
+    np.add(a, b, out=a)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        np.add(a, b, out=a)
+    add_gibs = 5 * a.nbytes / (time.perf_counter() - t0) / (1 << 30)
+
+    import socket as sk
+
+    srv = sk.socket()
+    srv.setsockopt(sk.SOL_SOCKET, sk.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    got = {}
+
+    def sink():
+        c, _ = srv.accept()
+        buf = bytearray(1 << 20)
+        total = 0
+        while True:
+            k = c.recv_into(buf)
+            if not k:
+                break
+            total += k
+        got["n"] = total
+        c.close()
+
+    th = threading.Thread(target=sink)
+    th.start()
+    c = sk.create_connection(("127.0.0.1", port))
+    payload = bytes(64 << 20)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        c.sendall(payload)
+    c.close()
+    th.join(timeout=10)
+    loopback_gibs = (4 * len(payload)) / (time.perf_counter() - t0) / (1 << 30)
+    srv.close()
+    return {"memcpy_gibs": round(memcpy_gibs, 2),
+            "int32_add_gibs": round(add_gibs, 2),
+            "loopback_tcp_gibs": round(loopback_gibs, 2)}
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     quick = os.environ.get("BENCH_QUICK") == "1"
 
     extras: dict = {}
+    try:
+        extras["host_calibration"] = bench_host_calibration()
+    except Exception as e:  # noqa: BLE001
+        extras["host_calibration_error"] = str(e)[:200]
 
     ptp = bench_ptp_dispatch(iters=100 if quick else 400)
     extras["ptp"] = ptp
@@ -384,6 +595,14 @@ def main() -> None:
         extras["host_allreduce"] = ar
     except Exception as e:  # noqa: BLE001
         extras["host_allreduce_error"] = str(e)[:200]
+
+    try:
+        arp = bench_host_allreduce_procs(
+            elems=1_000_000 if quick else 25_500_000,
+            rounds=1 if quick else 3)
+        extras["host_allreduce_procs"] = arp
+    except Exception as e:  # noqa: BLE001
+        extras["host_allreduce_procs_error"] = str(e)[:200]
 
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
         # Device init on the remote-TPU tunnel can wedge for minutes; run
@@ -475,7 +694,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--device-only" in sys.argv:
+    if "--allreduce-worker" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        i = sys.argv.index("--allreduce-worker")
+        _allreduce_worker_main(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+    elif "--device-only" in sys.argv:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         out_path = None
         if "--out" in sys.argv:

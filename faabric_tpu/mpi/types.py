@@ -143,6 +143,11 @@ class MpiMessageType(enum.IntEnum):
     BROADCAST = 12
     UNACKED = 13
     HANDSHAKE = 14
+    # Extension beyond the reference enum: announces a chunk-pipelined
+    # broadcast stream ([n_chunks, total_elems, dtype_code] int64) so
+    # receivers follow the sender's chunking decision instead of having
+    # to replicate it from local (possibly size-less) templates
+    CHUNK_HEADER = 100
 
 
 # Wire header for MPI payloads riding PTP: type u8, dtype u8, pad u16,
@@ -168,9 +173,42 @@ def pack_mpi_payload(msg_type: MpiMessageType, data: np.ndarray,
     return head + data.tobytes()
 
 
-def unpack_mpi_payload(raw: bytes) -> tuple[MpiMessageType, np.ndarray, int]:
+class MpiWirePayload:
+    """Lazily-serialized MPI payload: header and array buffer stay
+    separate so the bulk data plane can hand them to the kernel without
+    a 100 MiB concatenation (reference analog: writev in
+    tcp::SendSocket::sendOne). ``to_bytes()`` materializes for the RPC
+    plane / mock recording."""
+
+    __slots__ = ("head", "arr")
+
+    def __init__(self, msg_type: MpiMessageType, data: np.ndarray,
+                 request_id: int = 0) -> None:
+        self.arr = np.ascontiguousarray(data)
+        self.head = struct.pack(MPI_HEADER_FMT, int(msg_type),
+                                int(mpi_dtype_for(self.arr.dtype)), 0,
+                                self.arr.size, request_id)
+
+    def __len__(self) -> int:
+        return len(self.head) + self.arr.nbytes
+
+    def buffers(self) -> list:
+        return [self.head,
+                memoryview(self.arr.reshape(-1).view(np.uint8))]
+
+    def to_bytes(self) -> bytes:
+        return self.head + self.arr.tobytes()
+
+
+def unpack_mpi_payload(raw) -> tuple[MpiMessageType, np.ndarray, int]:
     msg_type, dtype, _, count, request_id = struct.unpack(
-        MPI_HEADER_FMT, raw[:MPI_HEADER_LEN])
+        MPI_HEADER_FMT, bytes(raw[:MPI_HEADER_LEN]))
     arr = np.frombuffer(raw, dtype=np_dtype_for(MpiDataType(dtype)),
-                        count=count, offset=MPI_HEADER_LEN).copy()
+                        count=count, offset=MPI_HEADER_LEN)
+    # A bytearray / uint8 ndarray is exclusively owned by this frame
+    # (bulk plane recv buffer): wrap it writable with no copy. Immutable
+    # bytes (shared RPC plane) still copy so callers get a caller-owned
+    # writable array.
+    if not isinstance(raw, (bytearray, np.ndarray)):
+        arr = arr.copy()
     return MpiMessageType(msg_type), arr, request_id

@@ -33,6 +33,7 @@ from faabric_tpu.mpi.types import (
     MpiMessageType,
     MpiOp,
     MpiStatus,
+    MpiWirePayload,
     apply_op,
     apply_op_inplace,
     mpi_dtype_for,
@@ -63,6 +64,12 @@ class _LocalMpiPayload:
         """Late wire conversion if routing sends this remote after all
         (e.g. a live-migration remap between send and delivery)."""
         return pack_mpi_payload(self.msg_type, self.data)
+
+    def __len__(self) -> int:
+        return len(MpiWirePayload(self.msg_type, self.data))
+
+    def buffers(self) -> list:
+        return MpiWirePayload(self.msg_type, self.data).buffers()
 
 
 class MpiWorld:
@@ -180,7 +187,9 @@ class MpiWorld:
             arr.flags.writeable = False
             payload = _LocalMpiPayload(msg_type, arr, shared=not _copy)
         else:
-            payload = pack_mpi_payload(msg_type, np.asarray(data), request_id)
+            # Lazy wire form: the bulk plane sends header + array buffer
+            # straight from this rank's memory, no concatenation copy
+            payload = MpiWirePayload(msg_type, np.asarray(data), request_id)
         self.broker.send_message(self.group_id, send_rank, recv_rank,
                                  payload, must_order=True)
 
@@ -277,49 +286,152 @@ class MpiWorld:
         group = self.broker.get_group(self.group_id)
         group.barrier(rank)
 
+    # Above this, collectives stream in chunks so tree stages overlap:
+    # while a leader reduces chunk k, chunk k+1 is on the wire and chunk
+    # k-1 is being folded at the root — the host-path analog of a
+    # pipelined ring. 4 MiB rides the kernel socket buffer cap.
+    CHUNK_BYTES = 4 * 1024 * 1024
+
+    def _chunk_bounds(self, arr: np.ndarray) -> list[tuple[int, int]]:
+        elems = max(1, self.CHUNK_BYTES // max(1, arr.itemsize))
+        flat_n = arr.size
+        return [(lo, min(lo + elems, flat_n))
+                for lo in range(0, flat_n, elems)]
+
     def broadcast(self, send_rank: int, recv_rank: int, data: np.ndarray
                   ) -> np.ndarray:
         """Reference :786-853: root sends once per remote host (to its
         local leader) + to its own host's ranks; leaders re-broadcast
-        locally."""
+        locally.
+
+        Large payloads stream chunk-pipelined. The stream is
+        SELF-DESCRIBING: the root prefixes a CHUNK_HEADER message, so
+        receivers follow the root's chunking decision and never need a
+        correctly-sized local template (mpi_bcast(buf=None) callers)."""
+        data = np.asarray(data)
         my_host = self.host_for_rank(recv_rank)
         root_host = self.host_for_rank(send_rank)
 
+        # -- root: decide chunking from the REAL payload ----------------
         if recv_rank == send_rank:
-            shared = np.array(data, copy=True)  # one copy for the fan-out
-            for host in self.hosts():
-                if host == root_host:
-                    for r in self.ranks_on_host(host):
-                        if r != send_rank:
-                            self.send(send_rank, r, shared,
-                                      MpiMessageType.BROADCAST, _copy=False)
-                else:
-                    self.send(send_rank, self.local_leader(host), shared,
-                              MpiMessageType.BROADCAST, _copy=False)
-            return np.asarray(data)
+            local = [r for r in self.ranks_on_host(root_host)
+                     if r != send_rank]
+            remote_leaders = [self.local_leader(h) for h in self.hosts()
+                              if h != root_host]
+            dests_remote_first = remote_leaders + local
 
+            if data.nbytes >= self.CHUNK_BYTES * 2:
+                flat = data.reshape(-1)
+                bounds = self._chunk_bounds(flat)
+                shared = np.array(flat, copy=True)
+                shared.flags.writeable = False
+                header = self._chunk_header(len(bounds), flat)
+                for d in dests_remote_first:
+                    self.send(send_rank, d, header,
+                              MpiMessageType.CHUNK_HEADER)
+                for lo, hi in bounds:
+                    chunk = shared[lo:hi]
+                    # Remote first: get the wire moving before local fan-out
+                    for d in dests_remote_first:
+                        self.send(send_rank, d, chunk,
+                                  MpiMessageType.BROADCAST, _copy=False)
+            else:
+                shared = np.array(data, copy=True)
+                for d in dests_remote_first:
+                    self.send(send_rank, d, shared,
+                              MpiMessageType.BROADCAST, _copy=False)
+            return data
+
+        # -- leaders: follow the incoming stream, forwarding locally ----
         leader = self.local_leader(my_host)
         if my_host != root_host and recv_rank == leader:
-            arr, _ = self._recv_raw(send_rank, recv_rank)
-            # Fan the (read-only) buffer out zero-copy, but hand the caller
-            # its own writable copy — the fan-out shares this memory
-            for r in self.ranks_on_host(my_host):
-                if r != recv_rank:
-                    self.send(recv_rank, r, arr, MpiMessageType.BROADCAST,
-                              _copy=False)
-            return arr.copy()
+            local = [r for r in self.ranks_on_host(my_host)
+                     if r != recv_rank]
+
+            def forward(arr, msg_type=MpiMessageType.BROADCAST):
+                for r in local:
+                    self.send(recv_rank, r, arr, msg_type, _copy=False)
+
+            msg_type, first = self._recv_typed(send_rank, recv_rank)
+            if msg_type != MpiMessageType.CHUNK_HEADER:
+                forward(first)
+                return self._private_result(first, data)
+            n_chunks, out = self._parse_chunk_header(first)
+            # Local ranks follow the same self-describing stream shape
+            forward(first, MpiMessageType.CHUNK_HEADER)
+            pos = 0
+            for _ in range(n_chunks):
+                arr, _ = self._recv_raw(send_rank, recv_rank)
+                out[pos:pos + arr.size] = arr
+                ro = out[pos:pos + arr.size]
+                ro.flags.writeable = False
+                forward(ro)
+                pos += arr.size
+            # out's chunk views were shared read-only with local
+            # receivers; hand the caller a private copy
+            return self._private_result(out.copy(), data, private=True)
+
+        # -- plain receivers --------------------------------------------
         src = send_rank if my_host == root_host else leader
-        arr, _ = self.recv(src, recv_rank)
+        msg_type, first = self._recv_typed(src, recv_rank)
+        if msg_type != MpiMessageType.CHUNK_HEADER:
+            return self._private_result(first, data)
+        n_chunks, out = self._parse_chunk_header(first)
+        pos = 0
+        for _ in range(n_chunks):
+            arr, _ = self._recv_raw(src, recv_rank)
+            out[pos:pos + arr.size] = arr
+            pos += arr.size
+        return self._private_result(out, data, private=True)
+
+    @staticmethod
+    def _chunk_header(n_chunks: int, flat: np.ndarray) -> np.ndarray:
+        return np.array([n_chunks, flat.size,
+                         int(mpi_dtype_for(flat.dtype))], dtype=np.int64)
+
+    @staticmethod
+    def _parse_chunk_header(header: np.ndarray) -> tuple[int, np.ndarray]:
+        from faabric_tpu.mpi.types import MpiDataType, np_dtype_for
+
+        n_chunks, total, dtype_code = (int(x) for x in header[:3])
+        return n_chunks, np.empty(total,
+                                  dtype=np_dtype_for(MpiDataType(dtype_code)))
+
+    def _recv_typed(self, send_rank: int, recv_rank: int
+                    ) -> tuple[MpiMessageType, np.ndarray]:
+        """Receive preserving the message type; the array may be shared/
+        read-only (zero-copy paths) — see _private_result."""
+        raw = self.broker.recv_message(self.group_id, send_rank, recv_rank,
+                                       must_order=True)
+        if isinstance(raw, _LocalMpiPayload):
+            return raw.msg_type, raw.data
+        msg_type, arr, _req = unpack_mpi_payload(raw)
+        return msg_type, arr
+
+    @staticmethod
+    def _private_result(arr: np.ndarray, template: np.ndarray,
+                        private: bool = False) -> np.ndarray:
+        """Caller-owned writable result, reshaped to the template when the
+        sizes agree (lenient size-less templates stay flat). ``private``
+        marks buffers this rank already exclusively owns."""
+        if not private and not arr.flags.writeable:
+            arr = arr.copy()  # shared zero-copy fan-out buffer
+        if template.size == arr.size and template.shape != arr.shape:
+            arr = arr.reshape(template.shape)
         return arr
 
     def reduce(self, rank: int, root: int, data: np.ndarray,
-               op: MpiOp = MpiOp.SUM) -> Optional[np.ndarray]:
+               op: MpiOp = MpiOp.SUM,
+               _shared_ok: bool = False) -> Optional[np.ndarray]:
         """Reference :1127-1249: non-leaders send to their local leader;
-        leaders partially reduce and forward one message to root."""
+        leaders partially reduce and forward one message to root.
+        Large payloads stream chunk-pipelined."""
+        data = np.asarray(data)
+        if data.nbytes >= self.CHUNK_BYTES * 2:
+            return self._reduce_chunked(rank, root, data, op, _shared_ok)
         my_host = self.host_for_rank(rank)
         root_host = self.host_for_rank(root)
         leader = self.local_leader(my_host)
-        data = np.asarray(data)
 
         if rank == root:
             acc = data.copy()
@@ -352,10 +464,80 @@ class MpiWorld:
         self.send(rank, leader, data, MpiMessageType.REDUCE)
         return None
 
+    def _reduce_chunked(self, rank: int, root: int, data: np.ndarray,
+                        op: MpiOp, _shared_ok: bool = False
+                        ) -> Optional[np.ndarray]:
+        """Chunk-pipelined leader-tree reduce: leaders fold and forward
+        chunk k while chunk k+1 is still arriving; the root folds chunks
+        as its senders' streams land.
+
+        ``_shared_ok`` (allreduce-only): senders' local chunks ride the
+        queues as read-only views with NO defensive copy — safe because
+        allreduce's trailing broadcast guarantees every contribution is
+        consumed before any caller regains control of its buffer. A bare
+        reduce() must copy (MPI says the send buffer is reusable on
+        return, but a lagging receiver may still be reading it)."""
+        my_host = self.host_for_rank(rank)
+        root_host = self.host_for_rank(root)
+        leader = self.local_leader(my_host)
+        flat = data.reshape(-1)
+        bounds = self._chunk_bounds(flat)
+
+        def send_chunk(dst: int, chunk: np.ndarray) -> None:
+            if _shared_ok:
+                view = chunk[:]
+                view.flags.writeable = False
+                self.send(rank, dst, view, MpiMessageType.REDUCE,
+                          _copy=False)
+            else:
+                self.send(rank, dst, chunk, MpiMessageType.REDUCE)
+
+        if rank == root:
+            senders = [r for r in self.ranks_on_host(root_host)
+                       if r != root]
+            senders += [self.local_leader(h) for h in self.hosts()
+                        if h != root_host]
+            acc = flat.copy()
+            for lo, hi in bounds:
+                acc_chunk = acc[lo:hi]
+                for s in senders:
+                    arr, _ = self._recv_raw(s, root)
+                    res = apply_op_inplace(op, acc_chunk, arr)
+                    if res is not acc_chunk:  # non-inplace op fallback
+                        acc[lo:hi] = res
+                        acc_chunk = acc[lo:hi]
+            return acc.reshape(data.shape)
+
+        if my_host == root_host:
+            for lo, hi in bounds:
+                send_chunk(root, flat[lo:hi])
+            return None
+
+        if rank == leader:
+            locals_ = [r for r in self.ranks_on_host(my_host) if r != rank]
+            acc = flat.copy()
+            for lo, hi in bounds:
+                acc_chunk = acc[lo:hi]
+                for s in locals_:
+                    arr, _ = self._recv_raw(s, rank)
+                    res = apply_op_inplace(op, acc_chunk, arr)
+                    if res is not acc_chunk:  # non-inplace op fallback
+                        acc[lo:hi] = res
+                        acc_chunk = acc[lo:hi]
+                # acc is leader-private: forward upstream without a copy
+                self.send(rank, root, acc_chunk, MpiMessageType.REDUCE)
+            return None
+
+        for lo, hi in bounds:
+            send_chunk(leader, flat[lo:hi])
+        return None
+
     def allreduce(self, rank: int, data: np.ndarray,
                   op: MpiOp = MpiOp.SUM) -> np.ndarray:
-        # reduce to 0 + broadcast (reference :1251-1264)
-        reduced = self.reduce(rank, MAIN_RANK, data, op)
+        # reduce to 0 + broadcast (reference :1251-1264). The trailing
+        # broadcast is the completion barrier that makes zero-copy local
+        # contribution sends safe (_shared_ok).
+        reduced = self.reduce(rank, MAIN_RANK, data, op, _shared_ok=True)
         return self.broadcast(MAIN_RANK, rank,
                               reduced if rank == MAIN_RANK else np.asarray(data))
 
@@ -417,11 +599,14 @@ class MpiWorld:
         return None
 
     def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
-        # gather(0) + broadcast (reference :1082-1111)
+        # gather(0) + broadcast (reference :1082-1111). The broadcast
+        # stream is self-describing (CHUNK_HEADER), so non-roots need no
+        # sized template — they follow the root's framing.
+        data = np.asarray(data)
         gathered = self.gather(rank, MAIN_RANK, data)
-        return self.broadcast(MAIN_RANK, rank,
-                              gathered if rank == MAIN_RANK
-                              else np.asarray(data))
+        template = (gathered if rank == MAIN_RANK
+                    else np.empty(0, dtype=data.dtype))
+        return self.broadcast(MAIN_RANK, rank, template)
 
     def scan(self, rank: int, data: np.ndarray,
              op: MpiOp = MpiOp.SUM) -> np.ndarray:

@@ -19,6 +19,7 @@ builds its ``jax.sharding`` mesh accordingly.
 
 from __future__ import annotations
 
+import struct
 import threading
 from typing import Optional
 
@@ -62,6 +63,8 @@ class PointToPointBroker:
 
         self._groups: dict[int, PointToPointGroup] = {}
         self._clients: dict[str, object] = {}
+        self._bulk_clients: dict[str, object] = {}
+        self._bulk_down_until: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # Mappings
@@ -142,8 +145,31 @@ class PointToPointBroker:
         if dst_host == self.host:
             self.deliver(group_id, send_idx, recv_idx, data, seq, channel)
         else:
-            # A zero-copy local payload re-routed remote (e.g. the mapping
-            # moved under live migration) converts to wire bytes late
+            # Large payloads ride the dedicated bulk plane (tuned sockets,
+            # scatter-gather send straight from the source buffers,
+            # recv_into preallocated buffers — transport/bulk.py); peers
+            # without a bulk server fall back to the RPC plane
+            from faabric_tpu.transport.bulk import BULK_THRESHOLD
+            from faabric_tpu.util.testing import is_mock_mode
+
+            if (len(data) >= BULK_THRESHOLD and not is_mock_mode()
+                    and not self._bulk_down(dst_host)):
+                bufs = (data.buffers() if hasattr(data, "buffers")
+                        else [data])
+                try:
+                    self._get_bulk_client(dst_host).send(
+                        group_id, send_idx, recv_idx, bufs, seq, channel)
+                    return
+                except (OSError, ValueError, struct.error) as e:
+                    # Remember the outage so chunk streams don't pay a
+                    # connect attempt (or timeout) per chunk
+                    self._mark_bulk_down(dst_host)
+                    logger.debug("Bulk send to %s unavailable (%s); using "
+                                 "RPC plane for %.0fs", dst_host, e,
+                                 self.BULK_RETRY_SECONDS)
+            # Lazy wire payloads (and zero-copy local payloads re-routed
+            # remote under live migration) convert to contiguous bytes
+            # late, only for the RPC plane
             if not isinstance(data, (bytes, bytearray, memoryview)) \
                     and hasattr(data, "to_bytes"):
                 data = data.to_bytes()
@@ -195,6 +221,11 @@ class PointToPointBroker:
                     self._recv_seq[key] = max(self._recv_seq.get(key, -1),
                                               seq)
                 return data
+            if seq < expected:
+                # Duplicate of an already-delivered message (bulk-plane
+                # reconnect resend whose original did land): drop it
+                # rather than leaking it in the out-of-order buffer
+                continue
             buf[seq] = data
 
     def _get_queue(self, key: tuple[int, int, int, int]) -> Queue:
@@ -246,12 +277,14 @@ class PointToPointBroker:
             self._sent_seq.clear()
             self._recv_seq.clear()
             self._ooo.clear()
-            for c in self._clients.values():
+            for c in list(self._clients.values()) \
+                    + list(self._bulk_clients.values()):
                 try:
                     c.close()
                 except Exception:  # noqa: BLE001
                     pass
             self._clients.clear()
+            self._bulk_clients.clear()
 
     def _get_client(self, host: str):
         from faabric_tpu.transport.ptp_remote import PointToPointClient
@@ -260,6 +293,32 @@ class PointToPointBroker:
             if host not in self._clients:
                 self._clients[host] = PointToPointClient(host)
             return self._clients[host]
+
+    def _get_bulk_client(self, host: str):
+        from faabric_tpu.transport.bulk import BulkClient
+
+        with self._lock:
+            if host not in self._bulk_clients:
+                self._bulk_clients[host] = BulkClient(host)
+            return self._bulk_clients[host]
+
+    # Bulk-plane outage cache: after a failed send, skip the bulk plane
+    # for this long rather than re-dialing per payload/chunk
+    BULK_RETRY_SECONDS = 30.0
+
+    def _bulk_down(self, host: str) -> bool:
+        import time
+
+        with self._lock:
+            until = self._bulk_down_until.get(host, 0.0)
+        return time.monotonic() < until
+
+    def _mark_bulk_down(self, host: str) -> None:
+        import time
+
+        with self._lock:
+            self._bulk_down_until[host] = (time.monotonic()
+                                           + self.BULK_RETRY_SECONDS)
 
 
 class PointToPointGroup:

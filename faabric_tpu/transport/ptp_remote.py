@@ -156,6 +156,18 @@ class PointToPointServer(MessageEndpointServer):
             n_threads=conf.point_to_point_server_threads,
         )
         self.broker = broker
+        # Bulk data plane rides next to the RPC plane (transport/bulk.py)
+        from faabric_tpu.transport.bulk import BulkServer
+
+        self._bulk_server = BulkServer(broker, port_offset=offset)
+
+    def start(self) -> None:
+        super().start()
+        self._bulk_server.start()
+
+    def stop(self) -> None:
+        self._bulk_server.stop()
+        super().stop()
 
     def do_async_recv(self, msg: TransportMessage) -> None:
         code = msg.code
