@@ -262,7 +262,7 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
     )
 
     # Listener ports must stay clear of the kernel ephemeral range
-    # (>=32768): max here is 15000 + 9014 (bulk) = 24014
+    # (>=32768): max here is 15000 + 8014 (bulk) = 23014
     base_a = random.randint(10, 120) * 100
     base_b = base_a + 3000
     clear_host_aliases()

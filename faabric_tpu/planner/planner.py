@@ -213,9 +213,11 @@ class Planner:
                 return do_not_migrate_decision()
 
             # Thaw: a NEW request for a frozen app resumes it
+            thawing = False
             if decision_type == DecisionType.NEW and req.app_id in self._evicted:
                 req = self._evicted.pop(req.app_id)
                 decision_type = DecisionType.NEW
+                thawing = True
 
             # Elastic scale-up: an OpenMP-style fork with the hint grows to
             # every free slot on its main host (reference Planner.cpp:833-893)
@@ -254,6 +256,10 @@ class Planner:
                     req, list(decision.hosts), 0)
 
             if decision.app_id == NOT_ENOUGH_SLOTS:
+                if thawing:
+                    # A failed thaw must NOT lose the parked app — re-park
+                    # it so a later attempt (when capacity frees) succeeds
+                    self._evicted[req.app_id] = req
                 logger.warning("Not enough slots for app %d (%d msgs)",
                                req.app_id, req.n_messages())
                 return decision

@@ -41,19 +41,13 @@ logger = get_logger(__name__)
 BULK_PORT = 8014
 # Below this the RPC plane wins (no extra connection, lower latency)
 BULK_THRESHOLD = 256 * 1024
-# OpenMPI FAQ 9 recommendation carried over from the reference
-SOCKET_BUF_BYTES = 16 * 1024 * 1024
 
 # group_hi, group_lo (group ids are 128-bit GIDs), send_idx, recv_idx,
 # channel, seq, nbytes
 _FRAME = struct.Struct("<QQiiiiq")
 _U64 = (1 << 64) - 1
 
-
-def _tune(sock: socket.socket) -> None:
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, SOCKET_BUF_BYTES)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, SOCKET_BUF_BYTES)
+from faabric_tpu.transport.message import tune_socket as _tune  # noqa: E402
 
 
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
@@ -94,10 +88,12 @@ class BulkServer:
         while not self._stopping:
             try:
                 conn, _ = self._listener.accept()
+                _tune(conn)
+                conn.settimeout(None)
             except OSError:
-                return  # listener closed
-            _tune(conn)
-            conn.settimeout(None)
+                if self._stopping or self._listener is None:
+                    return  # listener closed
+                continue  # one bad connection must not kill the acceptor
             with self._lock:
                 self._conns.append(conn)
                 # Prune finished conn threads + closed sockets so the
@@ -207,14 +203,26 @@ class BulkClient:
                 # reference keeps sender-side UNACKED buffers for this,
                 # MpiWorld.cpp:1963-2030); ordered recvs then time out
                 # rather than hang silently.
+                self._reset_sock_locked()
                 try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = self._dial()
-                self._sock.sendall(head)
-                for v in views:
-                    self._sock.sendall(v)
+                    self._sock = self._dial()
+                    self._sock.sendall(head)
+                    for v in views:
+                        self._sock.sendall(v)
+                except BaseException:
+                    # A half-written frame must never linger on a kept
+                    # socket — the receiver would splice the NEXT frame
+                    # into this one's missing tail
+                    self._reset_sock_locked()
+                    raise
+
+    def _reset_sock_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,195 @@
+"""Planner accounting property test under real thread contention.
+
+SURVEY §7 flags slot/port/device accounting across NEW / SCALE_CHANGE /
+DIST_CHANGE / freeze / thaw / result paths as a hard part to test early
+(reference Planner.cpp:1100-1111,1145-1173). Here N threads drive
+randomized app lifecycles concurrently — including spot evictions and
+thaws that race other apps' scheduling — while an observer asserts the
+capacity invariant mid-run; afterwards every slot, MPI port and chip must
+be back to zero."""
+
+import threading
+import time
+
+import numpy as np
+
+from faabric_tpu.batch_scheduler import reset_batch_scheduler
+from faabric_tpu.batch_scheduler.decision import (
+    DO_NOT_MIGRATE,
+    MUST_FREEZE,
+    NOT_ENOUGH_SLOTS,
+)
+from faabric_tpu.planner import get_planner
+from faabric_tpu.proto import ReturnValue, batch_exec_factory
+from faabric_tpu.util.testing import set_mock_mode
+
+HOSTS = [("p1", 6, 4), ("p2", 8, 8), ("p3", 4, 2), ("p4", 10, 4)]
+
+
+def _finish(planner, messages):
+    for m in messages:
+        m.return_value = int(ReturnValue.SUCCESS)
+        planner.set_message_result(m)
+
+
+def test_planner_accounting_full_lifecycle_concurrent():
+    planner = get_planner()
+    planner.reset()
+    reset_batch_scheduler("spot")
+    set_mock_mode(True)  # dispatch/mappings record instead of dialing
+    try:
+        for ip, slots, devs in HOSTS:
+            planner.register_host(ip, slots, devs)
+        capacity = {ip: slots for ip, slots, _ in HOSTS}
+
+        errors: list = []
+        stop_observer = threading.Event()
+
+        def observer():
+            # Capacity invariant must hold at every instant, not just at
+            # quiesce: a slot leak shows as used > slots or used < 0
+            while not stop_observer.is_set():
+                try:
+                    for h in planner.get_available_hosts():
+                        assert 0 <= h.used_slots <= capacity[h.ip], (
+                            f"{h.ip}: used {h.used_slots}/{capacity[h.ip]}")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+                time.sleep(0.001)
+
+        def lifecycle(seed):
+            rng = np.random.RandomState(seed)
+            try:
+                for it in range(25):
+                    scenario = rng.randint(0, 4)
+                    req = batch_exec_factory("prop", f"fn{seed}",
+                                             int(rng.randint(1, 5)))
+                    decision = planner.call_batch(req)
+                    if decision.app_id == NOT_ENOUGH_SLOTS:
+                        continue
+                    messages = list(req.messages)
+
+                    if scenario == 1:
+                        # SCALE_CHANGE: grow the running app
+                        grow = batch_exec_factory("prop", f"fn{seed}",
+                                                  int(rng.randint(1, 4)))
+                        grow.app_id = req.app_id
+                        d2 = planner.call_batch(grow)
+                        if d2.app_id != NOT_ENOUGH_SLOTS:
+                            messages += list(grow.messages)
+
+                    elif scenario == 2:
+                        # DIST_CHANGE migration check (usually
+                        # DO_NOT_MIGRATE; a racing eviction may move or
+                        # freeze us — both must keep accounting exact)
+                        d2 = planner.check_migration(req.app_id)
+                        if d2 is not None and d2.app_id == MUST_FREEZE:
+                            self_thaw(planner, req.app_id)
+
+                    elif scenario == 3 and it % 5 == 0:
+                        # Spot chaos: evict a random host, try to migrate
+                        # off it, then clear the eviction
+                        victim = HOSTS[rng.randint(len(HOSTS))][0]
+                        planner.set_next_evicted_host_ips([victim])
+                        d2 = planner.check_migration(req.app_id)
+                        planner.set_next_evicted_host_ips([])
+                        if d2 is not None and d2.app_id == MUST_FREEZE:
+                            self_thaw(planner, req.app_id)
+
+                    time.sleep(rng.rand() * 0.001)
+                    _finish(planner, messages)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def self_thaw(planner, app_id):
+            """Thaw a frozen app (the parked request — holding the SAME
+            accumulated message objects we track — comes back whole; a
+            failed attempt re-parks it, which this retry loop relies on)."""
+            thaw = batch_exec_factory("prop", "thaw", 1)
+            thaw.app_id = app_id
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                d = planner.call_batch(thaw)
+                if d.app_id not in (NOT_ENOUGH_SLOTS, DO_NOT_MIGRATE):
+                    return
+                time.sleep(0.01)  # cluster briefly full: other apps finish
+            raise TimeoutError(f"could not thaw app {app_id}")
+
+        obs = threading.Thread(target=observer)
+        obs.start()
+        threads = [threading.Thread(target=lifecycle, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        stop_observer.set()
+        obs.join(timeout=5)
+
+        assert not any(t.is_alive() for t in threads), "lifecycle hung"
+        assert not errors, errors[:3]
+
+        # Quiesced: every slot, port and chip returned; nothing in flight
+        # or frozen
+        for h in planner.get_available_hosts():
+            assert h.used_slots == 0, h
+        assert not planner.get_frozen_apps()
+        with planner._lock:
+            assert not planner._in_flight
+            for h in planner._hosts.values():
+                assert not h.used_mpi_ports, h.ip
+                assert all(n == 0 for n in h.device_load), h.ip
+    finally:
+        set_mock_mode(False)
+        reset_batch_scheduler("bin-pack")
+        planner.reset()
+
+
+def test_failed_thaw_reparks_frozen_app():
+    """A thaw that finds no capacity must NOT lose the parked request
+    (regression: call_batch popped _evicted before scheduling and dropped
+    the app on NOT_ENOUGH_SLOTS)."""
+    planner = get_planner()
+    planner.reset()
+    reset_batch_scheduler("spot")
+    set_mock_mode(True)
+    try:
+        planner.register_host("t1", 2, 2)
+        planner.register_host("t2", 2, 2)
+
+        app = batch_exec_factory("prop", "victim", 4)  # fills the cluster
+        d = planner.call_batch(app)
+        assert d.app_id not in (NOT_ENOUGH_SLOTS, MUST_FREEZE)
+
+        planner.set_next_evicted_host_ips(["t1", "t2"])
+        d2 = planner.check_migration(app.app_id)
+        assert d2 is not None and d2.app_id == MUST_FREEZE
+        assert app.app_id in planner.get_frozen_apps()
+        planner.set_next_evicted_host_ips([])
+
+        # Occupy the cluster so the thaw cannot place
+        blocker = batch_exec_factory("prop", "blocker", 4)
+        assert planner.call_batch(blocker).app_id != NOT_ENOUGH_SLOTS
+
+        thaw = batch_exec_factory("prop", "thaw", 1)
+        thaw.app_id = app.app_id
+        assert planner.call_batch(thaw).app_id == NOT_ENOUGH_SLOTS
+        # Still parked, not silently dropped
+        assert app.app_id in planner.get_frozen_apps()
+
+        _finish(planner, list(blocker.messages))
+        thaw2 = batch_exec_factory("prop", "thaw", 1)
+        thaw2.app_id = app.app_id
+        d3 = planner.call_batch(thaw2)
+        assert d3.app_id not in (NOT_ENOUGH_SLOTS, MUST_FREEZE)
+        assert d3.n_messages == 4  # the parked request came back whole
+        assert app.app_id not in planner.get_frozen_apps()
+        _finish(planner, list(app.messages))
+
+        for h in planner.get_available_hosts():
+            assert h.used_slots == 0
+    finally:
+        set_mock_mode(False)
+        reset_batch_scheduler("bin-pack")
+        planner.reset()
