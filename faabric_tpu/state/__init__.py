@@ -1,5 +1,11 @@
 """Distributed state KV (reference src/state)."""
 
+from faabric_tpu.state.backend import (
+    MasterMemoryAuthority,
+    RemoteAuthority,
+    SharedFileAuthority,
+    StateAuthority,
+)
 from faabric_tpu.state.kv import STATE_CHUNK_SIZE, StateKeyValue
 from faabric_tpu.state.state import State
 from faabric_tpu.state.remote import (
@@ -11,8 +17,12 @@ from faabric_tpu.state.remote import (
 )
 
 __all__ = [
+    "MasterMemoryAuthority",
+    "RemoteAuthority",
     "STATE_CHUNK_SIZE",
+    "SharedFileAuthority",
     "State",
+    "StateAuthority",
     "StateCalls",
     "StateClient",
     "StateServer",

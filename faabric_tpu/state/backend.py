@@ -1,0 +1,321 @@
+"""Pluggable state authorities — where a key's authoritative bytes live.
+
+Reference analog: the StateKeyValue virtuals with two backends,
+in-memory master (src/state/InMemoryStateKeyValue.cpp:90-260) and Redis
+(src/state/RedisStateKeyValue.cpp). Selected by ``STATE_MODE``:
+
+- ``inmemory`` (default): one master host per key (planner-elected); the
+  master's process memory is the authority, replicas sync over the
+  StateServer RPC. Split here into :class:`MasterMemoryAuthority` (this
+  process IS the authority) and :class:`RemoteAuthority` (RPC to it).
+- ``file`` (alias ``shm``): the authority is an mmap'd file under
+  ``STATE_DIR`` (default /dev/shm) — every process on the machine maps
+  the same bytes, locks ride fcntl.flock, appends are length-prefixed
+  records in a side file. No master election, no RPC: the TPU-pod
+  single-host analog of the reference's Redis mode (an authority
+  outside any worker process that survives worker restarts).
+- ``redis``: raises with guidance unless a redis client is importable
+  (not shipped in this image; the interface slot is here).
+
+StateKeyValue keeps the chunked lazy-pull / dirty-push / append protocol
+and delegates every authority interaction to one of these objects — the
+protocol code is backend-agnostic, which is what makes the backend
+actually pluggable.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Optional
+
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+_APPEND_REC = struct.Struct("<I")
+
+
+class StateAuthority:
+    """Authoritative-store accessor for one user/key."""
+
+    #: True when the authoritative bytes live in THIS process (the
+    #: StateServer serves them to replicas)
+    local = False
+
+    def pull_chunk(self, offset: int, length: int) -> bytes:
+        raise NotImplementedError
+
+    def push_chunk(self, offset: int, data: bytes) -> None:
+        raise NotImplementedError
+
+    def append(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_appended(self, n_values: int) -> list[bytes]:
+        raise NotImplementedError
+
+    def clear_appended(self) -> None:
+        raise NotImplementedError
+
+    def lock(self) -> None:
+        raise NotImplementedError
+
+    def unlock(self) -> None:
+        raise NotImplementedError
+
+
+class MasterMemoryAuthority(StateAuthority):
+    """This process holds the key (inmemory mode, master side). The value
+    bytes themselves stay in the StateKeyValue's local image (its local
+    fast paths and the StateServer entry points act on one buffer under
+    one lock); the authority owns what ISN'T the image: the append log
+    and the global value lock."""
+
+    local = True
+
+    # Slightly under the client socket timeout so a contended lock
+    # surfaces as an RPC error on the requester rather than an orphaned
+    # server thread that acquires for a dead client
+    LOCK_ACQUIRE_TIMEOUT = 30.0
+
+    def __init__(self, user: str, key: str) -> None:
+        self.user = user
+        self.key = key
+        self._lock = threading.Lock()
+        self._appended: list[bytes] = []
+        self._value_lock = threading.Lock()
+
+    def pull_chunk(self, offset: int, length: int) -> bytes:
+        raise RuntimeError("local authority: data lives in the KV image")
+
+    def push_chunk(self, offset: int, data: bytes) -> None:
+        raise RuntimeError("local authority: data lives in the KV image")
+
+    def append(self, data: bytes) -> None:
+        with self._lock:
+            self._appended.append(bytes(data))
+
+    def get_appended(self, n_values: int) -> list[bytes]:
+        with self._lock:
+            if len(self._appended) < n_values:
+                raise ValueError(
+                    f"Only {len(self._appended)} appended values")
+            return list(self._appended[:n_values])
+
+    def clear_appended(self) -> None:
+        with self._lock:
+            self._appended.clear()
+
+    def lock(self) -> None:
+        if not self._value_lock.acquire(timeout=self.LOCK_ACQUIRE_TIMEOUT):
+            raise TimeoutError(
+                f"Timed out acquiring global lock on {self.user}/{self.key}")
+
+    def unlock(self) -> None:
+        self._value_lock.release()
+
+
+class RemoteAuthority(StateAuthority):
+    """The key's master lives on another host (inmemory mode, replica
+    side): every op is an RPC to its StateServer."""
+
+    def __init__(self, user: str, key: str, master_host: str,
+                 client_factory) -> None:
+        self.user = user
+        self.key = key
+        self.master_host = master_host
+        self._client_factory = client_factory
+
+    def _client(self):
+        if self._client_factory is None:
+            raise RuntimeError(
+                f"No state client for non-master access to "
+                f"{self.user}/{self.key}")
+        return self._client_factory(self.master_host)
+
+    def pull_chunk(self, offset: int, length: int) -> bytes:
+        return self._client().pull_chunk(self.user, self.key, offset, length)
+
+    def push_chunk(self, offset: int, data: bytes) -> None:
+        self._client().push_chunk(self.user, self.key, offset, data)
+
+    def append(self, data: bytes) -> None:
+        self._client().append(self.user, self.key, data)
+
+    def get_appended(self, n_values: int) -> list[bytes]:
+        return self._client().pull_appended(self.user, self.key, n_values)
+
+    def clear_appended(self) -> None:
+        self._client().clear_appended(self.user, self.key)
+
+    # Lock/unlock use one-shot connections: the shared cached client
+    # serialises its sync socket, so a blocked lock request would block
+    # the holder's unlock behind it (deadlock)
+    def lock(self) -> None:
+        self._oneshot("lock")
+
+    def unlock(self) -> None:
+        self._oneshot("unlock")
+
+    def _oneshot(self, op: str) -> None:
+        from faabric_tpu.state.remote import StateClient
+
+        client = StateClient(self.master_host)
+        try:
+            getattr(client, op)(self.user, self.key)
+        finally:
+            client.close()
+
+
+class SharedFileAuthority(StateAuthority):
+    """The authority is an mmap'd file every process on the machine can
+    open (``file``/``shm`` mode). Value bytes in ``<safe>.bin``, appends
+    as length-prefixed records in ``<safe>.append``, the global lock is
+    flock on ``<safe>.lock``."""
+
+    local = False  # nothing for the StateServer to serve
+
+    def __init__(self, user: str, key: str, size: int,
+                 state_dir: str) -> None:
+        import mmap
+
+        self.user = user
+        self.key = key
+        os.makedirs(state_dir, exist_ok=True)
+        safe = f"{user}__{key}".replace("/", "_")
+        self._path = os.path.join(state_dir, safe + ".bin")
+        self._append_path = os.path.join(state_dir, safe + ".append")
+        self._lock_path = os.path.join(state_dir, safe + ".lock")
+        self._iolock = threading.Lock()
+        self._lock_fd: Optional[int] = None
+
+        # Create-or-open at the requested size (first creator sizes it)
+        flags = os.O_RDWR | os.O_CREAT
+        fd = os.open(self._path, flags, 0o644)
+        try:
+            cur = os.fstat(fd).st_size
+            if cur < size:
+                os.ftruncate(fd, size)
+            self.size = max(cur, size)
+            self._mm = mmap.mmap(fd, self.size) if self.size else None
+        finally:
+            os.close(fd)
+
+    @staticmethod
+    def existing_size(user: str, key: str, state_dir: str) -> int:
+        safe = f"{user}__{key}".replace("/", "_")
+        try:
+            return os.stat(os.path.join(state_dir, safe + ".bin")).st_size
+        except OSError:
+            return 0
+
+    def pull_chunk(self, offset: int, length: int) -> bytes:
+        with self._iolock:
+            return bytes(self._mm[offset:offset + length])
+
+    def push_chunk(self, offset: int, data: bytes) -> None:
+        if offset + len(data) > self.size:
+            raise ValueError("Pushed chunk out of bounds")
+        with self._iolock:
+            self._mm[offset:offset + len(data)] = bytes(data)
+
+    def append(self, data: bytes) -> None:
+        import fcntl
+
+        with self._iolock, open(self._append_path, "ab") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                f.write(_APPEND_REC.pack(len(data)))
+                f.write(data)
+                f.flush()  # record fully on disk before the lock drops
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+
+    def get_appended(self, n_values: int) -> list[bytes]:
+        import fcntl
+
+        out: list[bytes] = []
+        try:
+            with self._iolock, open(self._append_path, "rb") as f:
+                # Shared lock against in-flight appends / truncates
+                fcntl.flock(f, fcntl.LOCK_SH)
+                try:
+                    while len(out) < n_values:
+                        head = f.read(_APPEND_REC.size)
+                        if len(head) < _APPEND_REC.size:
+                            break
+                        (n,) = _APPEND_REC.unpack(head)
+                        body = f.read(n)
+                        if len(body) < n:
+                            raise ValueError(
+                                f"Torn append record in {self._append_path}")
+                        out.append(body)
+                finally:
+                    fcntl.flock(f, fcntl.LOCK_UN)
+        except FileNotFoundError:
+            pass
+        if len(out) < n_values:
+            raise ValueError(f"Only {len(out)} appended values")
+        return out
+
+    def clear_appended(self) -> None:
+        import fcntl
+
+        with self._iolock:
+            try:
+                with open(self._append_path, "r+b") as f:
+                    fcntl.flock(f, fcntl.LOCK_EX)
+                    try:
+                        f.truncate(0)
+                    finally:
+                        fcntl.flock(f, fcntl.LOCK_UN)
+            except OSError:
+                pass
+
+    # Same bound as MasterMemoryAuthority: a contended lock must surface
+    # as an error, not wedge the worker silently
+    LOCK_ACQUIRE_TIMEOUT = 30.0
+
+    def lock(self) -> None:
+        import fcntl
+        import time
+
+        fd = os.open(self._lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+        deadline = time.monotonic() + self.LOCK_ACQUIRE_TIMEOUT
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    os.close(fd)
+                    raise TimeoutError(
+                        f"Timed out acquiring global lock on "
+                        f"{self.user}/{self.key}")
+                time.sleep(0.01)
+        self._lock_fd = fd
+
+    def unlock(self) -> None:
+        import fcntl
+
+        fd, self._lock_fd = self._lock_fd, None
+        if fd is None:
+            raise RuntimeError("unlock without lock")
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+    def delete_files(self) -> None:
+        for p in (self._path, self._append_path, self._lock_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def make_redis_authority(*_a, **_k):  # pragma: no cover — no client lib
+    raise RuntimeError(
+        "STATE_MODE=redis needs the 'redis' client library, which this "
+        "image does not ship; use STATE_MODE=inmemory (planner-elected "
+        "masters) or STATE_MODE=file (shared-memory files)")

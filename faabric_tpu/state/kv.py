@@ -8,9 +8,10 @@ appends with remote retrieval, and read/write locks hosted by the master.
 
 TPU deltas from the reference: values are numpy byte buffers (the device
 round-trip is ``jax.device_put(kv.get_array(...))`` / ``kv.set(device_
-get(...))`` — state stays host-resident, chips pull what they need); no
-Redis backend — master election goes through the planner (the cluster
-metadata service) and all data movement is master↔replica RPC.
+get(...))`` — state stays host-resident, chips pull what they need).
+Authority interactions (where the authoritative bytes live) go through a
+pluggable :mod:`faabric_tpu.state.backend` — planner-elected in-memory
+masters by default, shared-memory files with ``STATE_MODE=file``.
 """
 
 from __future__ import annotations
@@ -20,6 +21,11 @@ from typing import Optional
 
 import numpy as np
 
+from faabric_tpu.state.backend import (
+    MasterMemoryAuthority,
+    RemoteAuthority,
+    StateAuthority,
+)
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -34,34 +40,30 @@ def n_chunks(size: int) -> int:
 class StateKeyValue:
     def __init__(self, user: str, key: str, size: int,
                  is_master: bool, master_host: str,
-                 client_factory=None) -> None:
+                 client_factory=None,
+                 authority: Optional[StateAuthority] = None) -> None:
         self.user = user
         self.key = key
         self.size = size
-        self.is_master = is_master
         self.master_host = master_host
-        self._client_factory = client_factory
+
+        if authority is None:
+            authority = (MasterMemoryAuthority(user, key) if is_master
+                         else RemoteAuthority(user, key, master_host,
+                                              client_factory))
+        self.authority = authority
+        # "Master" now means: the authoritative bytes are THIS process's
+        # image (the StateServer serves them from here)
+        self.is_master = authority.local
 
         self._lock = threading.RLock()
         self._data = np.zeros(size, dtype=np.uint8)
         chunks = n_chunks(size)
-        # Masters own authoritative data: everything is "pulled"
-        self._pulled = np.full(chunks, is_master, dtype=bool)
+        # Local-authority data is authoritative: everything is "pulled"
+        self._pulled = np.full(chunks, self.is_master, dtype=bool)
         self._dirty = np.zeros(chunks, dtype=bool)
 
-        self._appended: list[bytes] = []
-
-        # Master-side value lock (reference read/write locks; writers over
-        # RPC serialise on this)
-        self._value_lock = threading.Lock()
-
     # ------------------------------------------------------------------
-    def _client(self):
-        if self._client_factory is None:
-            raise RuntimeError(
-                f"No state client for non-master access to {self.user}/{self.key}")
-        return self._client_factory(self.master_host)
-
     def _chunk_range(self, offset: int, length: int) -> tuple[int, int]:
         first = offset // STATE_CHUNK_SIZE
         last = (offset + max(1, length) - 1) // STATE_CHUNK_SIZE
@@ -76,11 +78,10 @@ class StateKeyValue:
                        if not self._pulled[c]]
         if not missing:
             return
-        client = self._client()
         for c in missing:
             lo = c * STATE_CHUNK_SIZE
             hi = min(self.size, lo + STATE_CHUNK_SIZE)
-            data = client.pull_chunk(self.user, self.key, lo, hi - lo)
+            data = self.authority.pull_chunk(lo, hi - lo)
             with self._lock:
                 self._data[lo:lo + len(data)] = np.frombuffer(data, np.uint8)
                 self._pulled[c] = True
@@ -136,7 +137,7 @@ class StateKeyValue:
             with self._lock:
                 self._dirty[:] = False
             return
-        self._client().push_chunk(self.user, self.key, 0, self.get())
+        self.authority.push_chunk(0, self.get())
         with self._lock:
             self._dirty[:] = False
 
@@ -150,13 +151,12 @@ class StateKeyValue:
             dirty = [int(c) for c in np.where(self._dirty)[0]]
         if not dirty:
             return
-        client = self._client()
         for c in dirty:
             lo = c * STATE_CHUNK_SIZE
             hi = min(self.size, lo + STATE_CHUNK_SIZE)
             with self._lock:
                 payload = self._data[lo:hi].tobytes()
-            client.push_chunk(self.user, self.key, lo, payload)
+            self.authority.push_chunk(lo, payload)
             with self._lock:
                 self._dirty[c] = False
 
@@ -176,61 +176,22 @@ class StateKeyValue:
     # Appends (reference append/getAppended/clearAppended)
     # ------------------------------------------------------------------
     def append(self, data: bytes) -> None:
-        if self.is_master:
-            with self._lock:
-                self._appended.append(bytes(data))
-        else:
-            self._client().append(self.user, self.key, data)
+        self.authority.append(data)
 
     def get_appended(self, n_values: int) -> list[bytes]:
-        if self.is_master:
-            with self._lock:
-                if len(self._appended) < n_values:
-                    raise ValueError(
-                        f"Only {len(self._appended)} appended values")
-                return list(self._appended[:n_values])
-        return self._client().pull_appended(self.user, self.key, n_values)
+        return self.authority.get_appended(n_values)
 
     def clear_appended(self) -> None:
-        if self.is_master:
-            with self._lock:
-                self._appended.clear()
-        else:
-            self._client().clear_appended(self.user, self.key)
+        self.authority.clear_appended()
 
     # ------------------------------------------------------------------
-    # Locks (master-hosted)
+    # Locks (authority-hosted)
     # ------------------------------------------------------------------
-    # Master-side acquire bound: slightly under the client socket timeout,
-    # so a contended lock surfaces as an RPC error on the requester rather
-    # than an orphaned server thread that acquires for a dead client
-    LOCK_ACQUIRE_TIMEOUT = 30.0
-
     def lock_global(self) -> None:
-        if self.is_master:
-            if not self._value_lock.acquire(timeout=self.LOCK_ACQUIRE_TIMEOUT):
-                raise TimeoutError(
-                    f"Timed out acquiring global lock on {self.user}/{self.key}")
-        else:
-            # Lock/unlock use one-shot connections: the shared cached
-            # client serialises its sync socket, so a blocked lock request
-            # would block the holder's unlock behind it (deadlock)
-            self._oneshot_lock_call("lock")
+        self.authority.lock()
 
     def unlock_global(self) -> None:
-        if self.is_master:
-            self._value_lock.release()
-        else:
-            self._oneshot_lock_call("unlock")
-
-    def _oneshot_lock_call(self, op: str) -> None:
-        from faabric_tpu.state.remote import StateClient
-
-        client = StateClient(self.master_host)
-        try:
-            getattr(client, op)(self.user, self.key)
-        finally:
-            client.close()
+        self.authority.unlock()
 
     # -- master-side entry points used by the StateServer ---------------
     def server_pull_chunk(self, offset: int, length: int) -> bytes:
@@ -247,5 +208,4 @@ class StateKeyValue:
             self._pulled[first:last] = True
 
     def server_append(self, data: bytes) -> None:
-        with self._lock:
-            self._appended.append(bytes(data))
+        self.authority.append(data)

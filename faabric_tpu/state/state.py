@@ -39,6 +39,53 @@ class State:
         if kv is not None:
             return kv
 
+        from faabric_tpu.util.config import get_system_config
+
+        conf = get_system_config()
+        mode = conf.state_mode
+        if mode in ("file", "shm"):
+            kv = self._make_file_kv(user, key, size, conf)
+        elif mode == "redis":
+            from faabric_tpu.state.backend import make_redis_authority
+
+            # Currently raises with guidance; a future client-lib-backed
+            # authority slots in here
+            kv = StateKeyValue(user, key, size, False, "<redis>",
+                               authority=make_redis_authority(user, key,
+                                                              size))
+        elif mode != "inmemory":
+            raise ValueError(f"Unknown STATE_MODE {mode!r}")
+        else:
+            kv = self._make_inmemory_kv(user, key, size)
+
+        with self._lock:
+            # Another thread may have raced us; first one wins
+            existing = self._kvs.get(full)
+            if existing is not None:
+                return existing
+            self._kvs[full] = kv
+        logger.debug("%s created KV %s (mode=%s master=%s size=%d)",
+                     self.host, full, mode, kv.master_host, kv.size)
+        return kv
+
+    def _make_file_kv(self, user: str, key: str, size: int,
+                      conf) -> StateKeyValue:
+        from faabric_tpu.state.backend import SharedFileAuthority
+
+        if size <= 0:
+            size = SharedFileAuthority.existing_size(user, key,
+                                                     conf.state_dir)
+            if size <= 0:
+                raise ValueError(
+                    f"State key {user}/{key} does not exist yet; creation "
+                    "needs an explicit size")
+        authority = SharedFileAuthority(user, key, size, conf.state_dir)
+        return StateKeyValue(user, key, authority.size, False, "<file>",
+                             authority=authority)
+
+    def _make_inmemory_kv(self, user: str, key: str,
+                          size: int) -> StateKeyValue:
+        full = f"{user}/{key}"
         if self.planner_client is not None:
             master = self.planner_client.claim_state_master(user, key)
         else:
@@ -59,17 +106,8 @@ class State:
                     f"Master creation of {full} needs an explicit size")
             size = self._client_factory(master).state_size(user, key)
 
-        kv = StateKeyValue(user, key, size, is_master, master,
-                           client_factory=self._client_factory)
-        with self._lock:
-            # Another thread may have raced us; first one wins
-            existing = self._kvs.get(full)
-            if existing is not None:
-                return existing
-            self._kvs[full] = kv
-        logger.debug("%s created KV %s (master=%s size=%d)", self.host, full,
-                     master, size)
-        return kv
+        return StateKeyValue(user, key, size, is_master, master,
+                             client_factory=self._client_factory)
 
     def try_get_kv(self, user: str, key: str) -> Optional[StateKeyValue]:
         with self._lock:

@@ -38,7 +38,8 @@ class SystemConfig:
     log_file: str = "off"
 
     # State
-    state_mode: str = "inmemory"  # inmemory | redis
+    state_mode: str = "inmemory"  # inmemory | file (shm) | redis
+    state_dir: str = "/dev/shm/faabric_tpu_state"
     redis_state_host: str = "redis"
     redis_queue_host: str = "redis"
     redis_port: int = 6379
@@ -104,6 +105,7 @@ class SystemConfig:
         self.log_file = _env("LOG_FILE", "off")
 
         self.state_mode = _env("STATE_MODE", "inmemory")
+        self.state_dir = _env("STATE_DIR", "/dev/shm/faabric_tpu_state")
         self.redis_state_host = _env("REDIS_STATE_HOST", "redis")
         self.redis_queue_host = _env("REDIS_QUEUE_HOST", "redis")
         self.redis_port = _env_int("REDIS_PORT", 6379)

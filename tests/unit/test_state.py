@@ -2,6 +2,10 @@
 through the e2e cluster: master on one runtime, replica on the other, with
 the planner electing masters."""
 
+import os
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 
@@ -147,3 +151,99 @@ def test_push_full_and_repull(cluster_states):
     kv_m.set(b"\x03" * 64)
     kv_r.pull()
     assert kv_r.get() == b"\x03" * 64
+
+
+# ---------------------------------------------------------------------------
+# File/shm-backed state mode (second pluggable backend; reference analog:
+# the Redis state mode, src/state/RedisStateKeyValue.cpp — an authority
+# outside any worker process)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def file_state_env(tmp_path, monkeypatch):
+    from faabric_tpu.util.config import get_system_config
+
+    monkeypatch.setenv("STATE_MODE", "file")
+    monkeypatch.setenv("STATE_DIR", str(tmp_path))
+    get_system_config().reset()
+    yield str(tmp_path)
+    # Let monkeypatch restore the env FIRST, then re-read the config so
+    # it reflects whatever the outer environment really was
+    monkeypatch.undo()
+    get_system_config().reset()
+
+
+def test_file_backend_chunked_pull_push(file_state_env):
+    from faabric_tpu.state.state import State
+
+    a = State("fhostA")
+    b = State("fhostB")
+    size = STATE_CHUNK_SIZE * 3 + 10
+    kv_a = a.get_kv("demo", "fkv", size)
+    kv_a.set(b"\x07" * size)
+    kv_a.push_full()
+
+    # Second "host": same files, no RPC, lazy chunked pull
+    kv_b = b.get_kv("demo", "fkv")  # size from the existing file
+    assert kv_b.size == size
+    assert kv_b.get_chunk(STATE_CHUNK_SIZE, 16) == b"\x07" * 16
+
+    kv_b.set_chunk(0, b"\xee" * 8)
+    assert kv_b.n_dirty_chunks() == 1
+    kv_b.push_partial()
+    kv_a.pull()
+    assert kv_a.get_chunk(0, 8) == b"\xee" * 8
+
+
+def test_file_backend_appends_and_locks(file_state_env):
+    from faabric_tpu.state.state import State
+
+    a = State("fhostA")
+    b = State("fhostB")
+    kv_a = a.get_kv("demo", "flog", 8)
+    kv_b = b.get_kv("demo", "flog", 8)
+    kv_a.append(b"one")
+    kv_b.append(b"two-longer")
+    assert kv_b.get_appended(2) == [b"one", b"two-longer"]
+    kv_a.clear_appended()
+    with pytest.raises(ValueError):
+        kv_b.get_appended(1)
+
+    kv_a.lock_global()
+    kv_a.unlock_global()
+
+
+def test_file_backend_missing_key_needs_size(file_state_env):
+    from faabric_tpu.state.state import State
+
+    with pytest.raises(ValueError, match="explicit size"):
+        State("fhostA").get_kv("demo", "absent")
+
+
+def test_file_backend_cross_process(file_state_env):
+    """Two OS processes share a key through the file authority with no
+    servers at all — the backend IS the transport."""
+    code = f"""
+import sys, os
+sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))})
+os.environ["STATE_MODE"] = "file"
+os.environ["STATE_DIR"] = {repr(file_state_env)}
+from faabric_tpu.state.state import State
+kv = State("child").get_kv("demo", "xproc")
+assert kv.get_chunk(0, 5) == b"hello", kv.get_chunk(0, 5)
+kv.set_chunk(5, b"world")
+kv.push_partial()
+kv.append(b"from-child")
+print("OK")
+"""
+    from faabric_tpu.state.state import State
+
+    kv = State("parent").get_kv("demo", "xproc", 16)
+    kv.set_chunk(0, b"hello")
+    kv.push_partial()
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert out.stdout.strip().endswith("OK"), out.stderr[-500:]
+    kv.pull()
+    assert kv.get_chunk(0, 10) == b"helloworld"
+    assert kv.get_appended(1) == [b"from-child"]
