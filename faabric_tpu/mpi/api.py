@@ -34,6 +34,8 @@ MPI_LAND = MpiOp.LAND
 MPI_LOR = MpiOp.LOR
 MPI_BAND = MpiOp.BAND
 MPI_BOR = MpiOp.BOR
+MPI_MAXLOC = MpiOp.MAXLOC
+MPI_MINLOC = MpiOp.MINLOC
 
 _tls = threading.local()
 
@@ -149,6 +151,34 @@ def mpi_wait(request: int) -> Optional[tuple[np.ndarray, MpiStatus]]:
     return world.await_async(rank, request)
 
 
+def mpi_waitall(requests: list[int]
+                ) -> list[Optional[tuple[np.ndarray, MpiStatus]]]:
+    world, rank = _current()
+    return world.waitall(rank, requests)
+
+
+def mpi_waitany(requests: list[int]
+                ) -> tuple[int, Optional[tuple[np.ndarray, MpiStatus]]]:
+    world, rank = _current()
+    return world.waitany(rank, requests)
+
+
+def mpi_probe(source: int, comm=MPI_COMM_WORLD) -> MpiStatus:
+    world, rank = _current()
+    return world.probe(source, rank)
+
+
+def mpi_iprobe(source: int, comm=MPI_COMM_WORLD) -> Optional[MpiStatus]:
+    """Non-blocking: pending-message status or None (flag=false)."""
+    world, rank = _current()
+    return world.iprobe(source, rank)
+
+
+def mpi_get_count(status: MpiStatus) -> int:
+    """MPI_Get_count: elements in the message the status describes."""
+    return status.count
+
+
 # ---------------------------------------------------------------------------
 # Collectives
 # ---------------------------------------------------------------------------
@@ -177,6 +207,27 @@ def mpi_gather(sendbuf, root: int, comm=MPI_COMM_WORLD
                ) -> Optional[np.ndarray]:
     world, rank = _current()
     return world.gather(rank, root, np.asarray(sendbuf))
+
+
+def mpi_gatherv(sendbuf, root: int, comm=MPI_COMM_WORLD
+                ) -> Optional[tuple[np.ndarray, list[int]]]:
+    """Root returns (concatenated values in rank order, per-rank counts)."""
+    world, rank = _current()
+    return world.gatherv(rank, root, np.asarray(sendbuf))
+
+
+def mpi_scatterv(sendbuf, counts, root: int, comm=MPI_COMM_WORLD
+                 ) -> np.ndarray:
+    world, rank = _current()
+    return world.scatterv(root, rank,
+                          np.asarray(sendbuf) if sendbuf is not None
+                          else None, counts)
+
+
+def mpi_alltoallv(sendbuf, send_counts, comm=MPI_COMM_WORLD
+                  ) -> tuple[np.ndarray, list[int]]:
+    world, rank = _current()
+    return world.alltoallv(rank, np.asarray(sendbuf), list(send_counts))
 
 
 def mpi_allgather(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
@@ -209,8 +260,15 @@ def mpi_alltoall(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
 # Cartesian topology (reference MPI_Cart_*)
 # ---------------------------------------------------------------------------
 
-def mpi_cart_get(comm=MPI_COMM_WORLD) -> tuple[tuple[int, int],
-                                               tuple[int, int]]:
+def mpi_cart_create(dims=None, comm=MPI_COMM_WORLD) -> tuple[int, ...]:
+    """MPI_Cart_create with user dims (all-periodic); None keeps the
+    default near-square 2-D factorisation."""
+    world, _ = _current()
+    return world.cart_create(dims)
+
+
+def mpi_cart_get(comm=MPI_COMM_WORLD) -> tuple[tuple[int, ...],
+                                               tuple[int, ...]]:
     world, rank = _current()
     return world.cart_dims(), world.cart_coords(rank)
 

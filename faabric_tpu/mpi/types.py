@@ -41,6 +41,10 @@ class MpiDataType(enum.IntEnum):
     BYTE = 19
 
 
+# MPI_DOUBLE_INT: (value, index) pairs for MINLOC/MAXLOC (mpi.h's
+# struct { double val; int rank; })
+DOUBLE_INT_DTYPE = np.dtype([("val", "<f8"), ("loc", "<i4")])
+
 _NP_DTYPES: dict[int, np.dtype] = {
     MpiDataType.INT8: np.dtype(np.int8),
     MpiDataType.INT16: np.dtype(np.int16),
@@ -57,6 +61,7 @@ _NP_DTYPES: dict[int, np.dtype] = {
     MpiDataType.LONG_LONG_INT: np.dtype(np.int64),
     MpiDataType.FLOAT: np.dtype(np.float32),
     MpiDataType.DOUBLE: np.dtype(np.float64),
+    MpiDataType.DOUBLE_INT: DOUBLE_INT_DTYPE,
     MpiDataType.CHAR: np.dtype(np.uint8),
     MpiDataType.C_BOOL: np.dtype(np.uint8),
     MpiDataType.BYTE: np.dtype(np.uint8),
@@ -101,9 +106,28 @@ _NP_OPS = {
 }
 
 
+def _minmaxloc(op: MpiOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """MINLOC/MAXLOC over (val, loc) structured pairs: pick the extreme
+    value; ties resolve to the lower index (MPI semantics)."""
+    if a.dtype.names != ("val", "loc"):
+        raise TypeError(
+            f"{op.name} needs DOUBLE_INT (val, loc) pairs, got {a.dtype}")
+    if op == MpiOp.MINLOC:
+        pick_b = (b["val"] < a["val"]) | \
+            ((b["val"] == a["val"]) & (b["loc"] < a["loc"]))
+    else:
+        pick_b = (b["val"] > a["val"]) | \
+            ((b["val"] == a["val"]) & (b["loc"] < a["loc"]))
+    out = a.copy()
+    out[pick_b] = b[pick_b]
+    return out
+
+
 def apply_op(op: MpiOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Typed reduce (reference MpiWorld::op_reduce:1266-1388 — there hand
     rolled loops per dtype; numpy ufuncs vectorise the same semantics)."""
+    if op in (MpiOp.MINLOC, MpiOp.MAXLOC):
+        return _minmaxloc(op, a, b)
     fn = _NP_OPS.get(op)
     if fn is None:
         raise NotImplementedError(f"MPI op {op} not supported")
@@ -116,13 +140,12 @@ def apply_op_inplace(op: MpiOp, acc: np.ndarray, b: np.ndarray) -> np.ndarray:
     result dtype matches (the reduce-tree hot path: one fewer buffer per
     received contribution)."""
     fn = _NP_OPS.get(op)
-    if fn is None:
-        raise NotImplementedError(f"MPI op {op} not supported")
-    if (acc.flags.writeable and acc.dtype == b.dtype
+    if (fn is not None and acc.flags.writeable and acc.dtype == b.dtype
             and op in (MpiOp.SUM, MpiOp.PROD, MpiOp.MAX,
                        MpiOp.MIN, MpiOp.BAND, MpiOp.BOR)):
         fn(acc, b, out=acc)
         return acc
+    # Non-ufunc ops (MINLOC/MAXLOC) and dtype mismatches allocate
     return apply_op(op, acc, b)
 
 

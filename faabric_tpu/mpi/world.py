@@ -25,7 +25,7 @@ Transport split, re-designed TPU-first:
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -232,6 +232,37 @@ class MpiWorld:
                            dtype=int(mpi_dtype_for(arr.dtype)))
         return arr, status
 
+    def probe(self, send_rank: int, recv_rank: int,
+              timeout: float | None = None) -> MpiStatus:
+        """Blocking MPI_Probe: status of the next pending message from
+        ``send_rank`` without consuming it (reference mpi.h MPI_Probe)."""
+        raw = self.broker.probe_message(self.group_id, send_rank, recv_rank,
+                                        timeout=timeout)
+        return self._status_of(send_rank, raw)
+
+    def iprobe(self, send_rank: int, recv_rank: int) -> Optional[MpiStatus]:
+        """Non-blocking MPI_Iprobe: status or None."""
+        raw = self.broker.try_probe_message(self.group_id, send_rank,
+                                            recv_rank)
+        if raw is None:
+            return None
+        return self._status_of(send_rank, raw)
+
+    @staticmethod
+    def _status_of(send_rank: int, raw) -> MpiStatus:
+        if isinstance(raw, _LocalMpiPayload):
+            return MpiStatus(source=send_rank, count=raw.data.size,
+                             dtype=int(mpi_dtype_for(raw.data.dtype)))
+        # Wire payload: count/dtype come from the fixed header — probing
+        # a pending 100 MiB message must not deserialize it
+        import struct as _struct
+
+        from faabric_tpu.mpi.types import MPI_HEADER_FMT, MPI_HEADER_LEN
+
+        _mt, dtype, _, count, _rid = _struct.unpack(
+            MPI_HEADER_FMT, bytes(raw[:MPI_HEADER_LEN]))
+        return MpiStatus(source=send_rank, count=count, dtype=dtype)
+
     def sendrecv(self, send_data: np.ndarray, send_rank: int, dst: int,
                  src: int, recv_rank: int) -> tuple[np.ndarray, MpiStatus]:
         """Concurrent send+recv for one rank (reference :752-785 uses an
@@ -275,6 +306,40 @@ class MpiWorld:
     def pending_requests(self, rank: int) -> int:
         with self._lock:
             return len(self._requests.get(rank, {}))
+
+    def request_ready(self, rank: int, request_id: int) -> bool:
+        """True when await_async would complete without blocking (sends
+        complete at isend; recvs when their message has arrived)."""
+        with self._lock:
+            entry = self._requests.get(rank, {}).get(request_id)
+        if entry is None:
+            raise KeyError(f"Unknown MPI request {request_id} for rank {rank}")
+        if entry[0] == "send":
+            return True
+        _, send_rank, recv_rank = entry
+        return self.broker.try_probe_message(self.group_id, send_rank,
+                                             recv_rank) is not None
+
+    def waitall(self, rank: int, request_ids: list[int]
+                ) -> list[Optional[tuple[np.ndarray, MpiStatus]]]:
+        """MPI_Waitall: complete every request, results in input order."""
+        return [self.await_async(rank, rid) for rid in request_ids]
+
+    def waitany(self, rank: int, request_ids: list[int],
+                timeout: float | None = None
+                ) -> tuple[int, Optional[tuple[np.ndarray, MpiStatus]]]:
+        """MPI_Waitany: (index, result) of the first completable request.
+        Sends are instantly ready; recvs poll their arrival."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            for i, rid in enumerate(request_ids):
+                if self.request_ready(rank, rid):
+                    return i, self.await_async(rank, rid)
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError("MPI_Waitany timed out")
+            _time.sleep(0.0005)
 
     # ------------------------------------------------------------------
     # Collectives — locality-aware leader trees on the host path
@@ -598,6 +663,81 @@ class MpiWorld:
         self.send(send_rank, leader, data, MpiMessageType.GATHER)
         return None
 
+    # ------------------------------------------------------------------
+    # v-variants (variable counts; reference mpi.h gatherv/scatterv/
+    # alltoallv). Counts ride the wire with each message, so only the
+    # root needs the count vector; transfers are direct sends (the
+    # leader-tree optimisation applies to the uniform-count fast paths).
+    # ------------------------------------------------------------------
+    def gatherv(self, rank: int, root: int, data: np.ndarray
+                ) -> Optional[tuple[np.ndarray, list[int]]]:
+        """Root returns (concatenated values in rank order, counts)."""
+        data = np.asarray(data).reshape(-1)
+        if rank != root:
+            self.send(rank, root, data, MpiMessageType.GATHER)
+            return None
+        parts: list[np.ndarray] = []
+        for r in range(self.size):
+            if r == root:
+                parts.append(data)
+            else:
+                # _recv_raw: concatenate copies anyway, skip recv()'s
+                # defensive copy
+                arr, _ = self._recv_raw(r, root)
+                parts.append(arr)
+        return np.concatenate(parts), [int(p.size) for p in parts]
+
+    def scatterv(self, send_rank: int, recv_rank: int,
+                 data: Optional[np.ndarray],
+                 counts: Optional[list[int]]) -> np.ndarray:
+        """Root splits ``data`` into per-rank pieces of ``counts`` sizes."""
+        if recv_rank == send_rank:
+            flat = np.asarray(data).reshape(-1)
+            if counts is None or len(counts) != self.size:
+                raise ValueError("scatterv root needs one count per rank")
+            if sum(counts) != flat.size:
+                raise ValueError(
+                    f"scatterv counts sum {sum(counts)} != data {flat.size}")
+            offsets = np.cumsum([0] + list(counts[:-1]))
+            for r in range(self.size):
+                if r != send_rank:
+                    self.send(send_rank, r,
+                              flat[offsets[r]:offsets[r] + counts[r]],
+                              MpiMessageType.SCATTER)
+            lo = offsets[send_rank]
+            return flat[lo:lo + counts[send_rank]].copy()
+        arr, _ = self.recv(send_rank, recv_rank)
+        return arr
+
+    def alltoallv(self, rank: int, data: np.ndarray,
+                  send_counts: list[int]
+                  ) -> tuple[np.ndarray, list[int]]:
+        """Rank-``j`` slice of ``data`` (``send_counts[j]`` elements) goes
+        to rank j; returns (concatenation of received blocks in rank
+        order, received counts)."""
+        flat = np.asarray(data).reshape(-1)
+        if len(send_counts) != self.size:
+            raise ValueError("alltoallv needs one send count per rank")
+        if sum(send_counts) != flat.size:
+            raise ValueError(
+                f"alltoallv counts sum {sum(send_counts)} != {flat.size}")
+        offsets = np.cumsum([0] + list(send_counts[:-1]))
+        my_block = None
+        for r in range(self.size):
+            block = flat[offsets[r]:offsets[r] + send_counts[r]]
+            if r == rank:
+                my_block = block.copy()
+            else:
+                self.send(rank, r, block, MpiMessageType.ALLTOALL)
+        parts: list[np.ndarray] = []
+        for r in range(self.size):
+            if r == rank:
+                parts.append(my_block)
+            else:
+                arr, _ = self._recv_raw(r, rank)
+                parts.append(arr)
+        return np.concatenate(parts), [int(p.size) for p in parts]
+
     def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
         # gather(0) + broadcast (reference :1082-1111). The broadcast
         # stream is self-describing (CHUNK_HEADER), so non-roots need no
@@ -640,32 +780,53 @@ class MpiWorld:
         return out.reshape(-1)
 
     # ------------------------------------------------------------------
-    # Cartesian topology (reference :369-493, 2-D periodic, LAMMPS-style)
+    # Cartesian topology (reference :369-493 — there fixed 2-D periodic,
+    # LAMMPS-style; here user dims via cart_create, defaulting to the
+    # reference's near-square 2-D factorisation)
     # ------------------------------------------------------------------
-    def cart_dims(self) -> tuple[int, int]:
+    _cart_user_dims: Optional[tuple[int, ...]] = None
+
+    def cart_create(self, dims: Optional[Sequence[int]] = None
+                    ) -> tuple[int, ...]:
+        """MPI_Cart_create with user dims (all-periodic); ``None`` keeps
+        the default 2-D factorisation."""
+        if dims is None:
+            self._cart_user_dims = None
+            return self.cart_dims()
+        dims = tuple(int(d) for d in dims)
+        if any(d <= 0 for d in dims):
+            raise ValueError(f"Cartesian dims must be positive: {dims}")
+        if int(np.prod(dims)) != self.size:
+            raise ValueError(
+                f"Cartesian dims {dims} do not tile {self.size} ranks")
+        self._cart_user_dims = dims
+        return dims
+
+    def cart_dims(self) -> tuple[int, ...]:
+        if self._cart_user_dims is not None:
+            return self._cart_user_dims
         side = int(np.floor(np.sqrt(self.size)))
         while side > 1 and self.size % side != 0:
             side -= 1
         return side, self.size // side
 
-    def cart_coords(self, rank: int) -> tuple[int, int]:
-        _, cols = self.cart_dims()
-        return rank // cols, rank % cols
+    def cart_coords(self, rank: int) -> tuple[int, ...]:
+        return tuple(int(c) for c in
+                     np.unravel_index(rank, self.cart_dims()))
 
-    def cart_rank(self, coords: tuple[int, int]) -> int:
-        rows, cols = self.cart_dims()
-        return (coords[0] % rows) * cols + (coords[1] % cols)
+    def cart_rank(self, coords: Sequence[int]) -> int:
+        dims = self.cart_dims()
+        wrapped = [c % d for c, d in zip(coords, dims)]
+        return int(np.ravel_multi_index(wrapped, dims))
 
     def cart_shift(self, rank: int, dim: int, disp: int) -> tuple[int, int]:
         """(source, dest) for a periodic shift along dim."""
-        row, col = self.cart_coords(rank)
-        if dim == 0:
-            src = self.cart_rank((row - disp, col))
-            dst = self.cart_rank((row + disp, col))
-        else:
-            src = self.cart_rank((row, col - disp))
-            dst = self.cart_rank((row, col + disp))
-        return src, dst
+        coords = list(self.cart_coords(rank))
+        src_coords = list(coords)
+        dst_coords = list(coords)
+        src_coords[dim] -= disp
+        dst_coords[dim] += disp
+        return self.cart_rank(src_coords), self.cart_rank(dst_coords)
 
     # ------------------------------------------------------------------
     # Migration (reference prepareMigration :2095-2131)

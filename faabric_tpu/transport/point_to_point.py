@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import struct
 import threading
+import time
 from typing import Optional
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
@@ -60,6 +61,8 @@ class PointToPointBroker:
         self._sent_seq: dict[tuple[int, int, int, int], int] = {}
         self._recv_seq: dict[tuple[int, int, int, int], int] = {}
         self._ooo: dict[tuple[int, int, int, int], dict[int, bytes]] = {}
+        # unsequenced messages staged by probe/ordered-recv scans
+        self._unseq: dict[tuple[int, int, int, int], object] = {}
 
         self._groups: dict[int, PointToPointGroup] = {}
         self._clients: dict[str, object] = {}
@@ -202,31 +205,80 @@ class PointToPointBroker:
 
         # Ordered path: consume in seq order, buffering whatever arrives
         # early (reference PointToPointBroker.cpp:778-862).
+        nxt = self._scan_next(key, q, timeout)
+        if nxt is None:  # only the non-blocking variant returns None
+            raise TimeoutError(f"PTP ordered recv timed out on {key}")
+        kind, payload = nxt
         with self._lock:
+            if kind == "unseq":
+                return self._unseq[key].popleft()
             expected = self._recv_seq.get(key, -1) + 1
+            self._recv_seq[key] = expected
+            return self._ooo[key].pop(expected)
+
+    def _scan_next(self, key, q, timeout: float | None,
+                   blocking: bool = True):
+        """Drain the raw queue until the next DELIVERABLE message for
+        ``key`` is staged, without consuming it: ("seq", data) when the
+        expected sequence number is buffered, ("unseq", data) when an
+        unsequenced message is first in line (kept in a side backlog so
+        probe never corrupts the sequence state), or None when
+        non-blocking and nothing is pending. Duplicates of
+        already-delivered seqs (bulk-plane reconnect resends) are
+        dropped. Shared by ordered recv, probe and iprobe."""
+        import collections
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
             buf = self._ooo.setdefault(key, {})
+            backlog = self._unseq.setdefault(key, collections.deque())
         while True:
-            if expected in buf:
-                with self._lock:
-                    self._recv_seq[key] = expected
-                return buf.pop(expected)
-            try:
-                seq, data = q.dequeue(timeout=timeout)
-            except QueueTimeoutException as e:
-                raise TimeoutError(
-                    f"PTP ordered recv timed out on {key} "
-                    f"(expected seq {expected})") from e
-            if seq == expected or seq == NO_SEQUENCE_NUM:
-                with self._lock:
-                    self._recv_seq[key] = max(self._recv_seq.get(key, -1),
-                                              seq)
-                return data
-            if seq < expected:
-                # Duplicate of an already-delivered message (bulk-plane
-                # reconnect resend whose original did land): drop it
-                # rather than leaking it in the out-of-order buffer
-                continue
-            buf[seq] = data
+            with self._lock:
+                if backlog:
+                    return ("unseq", backlog[0])
+                expected = self._recv_seq.get(key, -1) + 1
+                if expected in buf:
+                    return ("seq", buf[expected])
+            if not blocking:
+                item = q.try_dequeue()
+                if item is None:
+                    return None
+            else:
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                try:
+                    item = q.dequeue(timeout=remaining)
+                except QueueTimeoutException:
+                    return None
+            seq, data = item
+            with self._lock:
+                if seq == NO_SEQUENCE_NUM:
+                    backlog.append(data)
+                elif seq <= self._recv_seq.get(key, -1):
+                    pass  # duplicate already delivered: drop
+                else:
+                    buf[seq] = data
+
+    def probe_message(self, group_id: int, send_idx: int, recv_idx: int,
+                      timeout: float | None = None,
+                      channel: int = DATA_CHANNEL):
+        """Peek the next deliverable message without consuming it (MPI
+        probe). Blocks up to ``timeout``; raises TimeoutError."""
+        conf = get_system_config()
+        timeout = timeout if timeout is not None else conf.global_message_timeout
+        key = (group_id, send_idx, recv_idx, channel)
+        nxt = self._scan_next(key, self._get_queue(key), timeout)
+        if nxt is None:
+            raise TimeoutError(f"PTP probe timed out on {key}")
+        return nxt[1]
+
+    def try_probe_message(self, group_id: int, send_idx: int, recv_idx: int,
+                          channel: int = DATA_CHANNEL):
+        """Non-blocking probe: the next deliverable message or None."""
+        key = (group_id, send_idx, recv_idx, channel)
+        nxt = self._scan_next(key, self._get_queue(key), None,
+                              blocking=False)
+        return None if nxt is None else nxt[1]
 
     def _get_queue(self, key: tuple[int, int, int, int]) -> Queue:
         with self._lock:
@@ -257,7 +309,8 @@ class PointToPointBroker:
             self._flags.pop(group_id, None)
             for key in [k for k in self._queues if k[0] == group_id]:
                 del self._queues[key]
-            for d in (self._sent_seq, self._recv_seq, self._ooo):
+            for d in (self._sent_seq, self._recv_seq, self._ooo,
+                      self._unseq):
                 for key in [k for k in d if k[0] == group_id]:
                     del d[key]
 
@@ -277,6 +330,7 @@ class PointToPointBroker:
             self._sent_seq.clear()
             self._recv_seq.clear()
             self._ooo.clear()
+            self._unseq.clear()
             for c in list(self._clients.values()) \
                     + list(self._bulk_clients.values()):
                 try:

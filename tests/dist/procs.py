@@ -72,6 +72,75 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:{int(out[0])}".encode()
         return int(ReturnValue.SUCCESS)
 
+    def fn_mpi_status(self, msg, req):
+        """Port of the reference example mpi_status
+        (tests/dist/mpi/examples/mpi_status.cpp): rank 0 sends 40 ints;
+        rank 1 probes, receives, and checks MPI_Get_count reports the
+        ACTUAL count, not the buffer capacity it asked for."""
+        from faabric_tpu.mpi import get_mpi_context
+        from faabric_tpu.mpi.api import mpi_get_count
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7300
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+
+        actual_count = 40
+        if rank == 0:
+            world.send(0, 1, np.arange(actual_count, dtype=np.int32))
+            msg.output_data = f"sent:{actual_count}".encode()
+        elif rank == 1:
+            st = world.probe(0, 1, timeout=20.0)
+            if mpi_get_count(st) != actual_count:
+                msg.output_data = f"probe:{st.count}".encode()
+                return int(ReturnValue.FAILED)
+            arr, st2 = world.recv(0, 1)
+            if mpi_get_count(st2) != actual_count or arr.size != actual_count:
+                msg.output_data = f"recv:{st2.count}".encode()
+                return int(ReturnValue.FAILED)
+            msg.output_data = f"got:{st2.count}".encode()
+        else:
+            msg.output_data = b"idle"
+        world.barrier(rank)
+        return int(ReturnValue.SUCCESS)
+
+    def fn_mpi_isendrecv(self, msg, req):
+        """Port of the reference example mpi_isendrecv
+        (tests/dist/mpi/examples/mpi_isendrecv.cpp): every rank
+        asynchronously receives from its left neighbour and sends its
+        rank to the right, then waits on both requests."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7400
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+
+        right = (rank + 1) % world.size
+        left = (rank - 1) % world.size
+        recv_req = world.irecv(left, rank)
+        send_req = world.isend(rank, right, np.array([rank], np.int32))
+        results = world.waitall(rank, [recv_req, send_req])
+        got = int(results[0][0][0])
+        world.barrier(rank)
+        if got != left:
+            msg.output_data = f"r{rank}:got{got}wanted{left}".encode()
+            return int(ReturnValue.FAILED)
+        msg.output_data = f"r{rank}:async-ok".encode()
+        return int(ReturnValue.SUCCESS)
+
     def fn_threads(self, msg, req):
         counter = self.memory[:8].view(np.int64)
         # One executor runs all local threads; serialise the shared add

@@ -104,6 +104,55 @@ def test_dist_mpi_allreduce(dist_cluster):
     assert hosts == {"w1", "w2"}
 
 
+def test_dist_mpi_status_example(dist_cluster):
+    """Reference example port: mpi_status.cpp — probe + status count of a
+    partial-buffer receive across hosts."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", "mpi_status", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=40.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+
+    deadline = time.time() + 20
+    status = None
+    while time.time() < deadline:
+        status = me.planner_client.get_batch_results(req.app_id)
+        if status.finished:
+            break
+        time.sleep(0.2)
+    assert status.finished and status.expected_num_messages == 8
+    outs = {m.mpi_rank: m.output_data for m in status.message_results}
+    assert outs[1] == b"got:40"
+    assert all(m.return_value == int(ReturnValue.SUCCESS)
+               for m in status.message_results), outs
+
+
+def test_dist_mpi_isendrecv_example(dist_cluster):
+    """Reference example port: mpi_isendrecv.cpp — async ring exchange
+    (irecv left, isend right, waitall) across hosts."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", "mpi_isendrecv", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=40.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+
+    deadline = time.time() + 20
+    status = None
+    while time.time() < deadline:
+        status = me.planner_client.get_batch_results(req.app_id)
+        if status.finished:
+            break
+        time.sleep(0.2)
+    assert status.finished and status.expected_num_messages == 8
+    for m in status.message_results:
+        assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+        assert m.output_data.endswith(b"async-ok")
+
+
 def test_dist_threads_snapshot_merge(dist_cluster):
     from faabric_tpu.snapshot import (
         SnapshotData,

@@ -4,6 +4,7 @@ servers; every collective is checked against numpy."""
 
 import random
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -312,3 +313,136 @@ def test_migration_blocked_with_pending_async(mpi_cluster):
     world.irecv(0, 0)
     with pytest.raises(RuntimeError):
         world.prepare_migration(0)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 API breadth: probe, waitall/waitany, v-variants, MINLOC/MAXLOC,
+# user-dims cartesian (reference mpi.h / MpiWorld.cpp:369-493)
+# ---------------------------------------------------------------------------
+
+def test_probe_and_iprobe(mpi_cluster):
+    def fn(world, rank):
+        if rank == 1:
+            world.send(1, 0, np.arange(40, dtype=np.int32))
+            return None
+        if rank == 0:
+            # iprobe polls until the message lands, without consuming it
+            deadline = time.time() + 10
+            st = None
+            while st is None and time.time() < deadline:
+                st = world.iprobe(1, 0)
+            assert st is not None and st.count == 40
+            # Blocking probe sees the SAME message, still unconsumed
+            st2 = world.probe(1, 0, timeout=5.0)
+            assert st2.count == 40
+            arr, st3 = world.recv(1, 0)
+            assert arr.size == 40 and st3.count == 40
+            assert arr[-1] == 39
+            # Nothing left
+            assert world.iprobe(1, 0) is None
+        return None
+
+    run_ranks(mpi_cluster, fn, n=2)
+
+
+def test_waitall_waitany(mpi_cluster):
+    def fn(world, rank):
+        if rank == 0:
+            rids = [world.irecv(src, 0) for src in (1, 2, 3)]
+            idx, result = world.waitany(0, rids, timeout=10.0)
+            assert result is not None
+            rest = [r for i, r in enumerate(rids) if i != idx]
+            results = world.waitall(0, rest)
+            got = sorted([int(result[0][0])]
+                         + [int(r[0][0]) for r in results])
+            assert got == [10, 20, 30]
+        elif rank in (1, 2, 3):
+            world.send(rank, 0, np.full(4, rank * 10, dtype=np.int32))
+        return None
+
+    run_ranks(mpi_cluster, fn, n=4)
+
+
+def test_gatherv_scatterv(mpi_cluster):
+    def fn(world, rank):
+        # gatherv: rank r contributes r+1 values
+        mine = np.full(rank + 1, rank, dtype=np.int32)
+        out = world.gatherv(rank, 0, mine)
+        if rank == 0:
+            data, counts = out
+            assert counts == [r + 1 for r in range(world.size)]
+            expected = np.concatenate(
+                [np.full(r + 1, r, np.int32) for r in range(world.size)])
+            np.testing.assert_array_equal(data, expected)
+        world.barrier(rank)
+        # scatterv: reverse counts
+        counts = [world.size - r for r in range(world.size)]
+        if rank == 0:
+            flat = np.concatenate(
+                [np.full(c, i, np.int32) for i, c in enumerate(counts)])
+            got = world.scatterv(0, 0, flat, counts)
+        else:
+            got = world.scatterv(0, rank, None, None)
+        np.testing.assert_array_equal(
+            got, np.full(world.size - rank, rank, np.int32))
+        return None
+
+    run_ranks(mpi_cluster, fn, n=6)
+
+
+def test_alltoallv(mpi_cluster):
+    def fn(world, rank):
+        # rank r sends (j+1) copies of r*10+j to rank j
+        counts = [j + 1 for j in range(world.size)]
+        data = np.concatenate(
+            [np.full(j + 1, rank * 10 + j, np.int32)
+             for j in range(world.size)])
+        got, recv_counts = world.alltoallv(rank, data, counts)
+        assert recv_counts == [rank + 1] * world.size
+        expected = np.concatenate(
+            [np.full(rank + 1, src * 10 + rank, np.int32)
+             for src in range(world.size)])
+        np.testing.assert_array_equal(got, expected)
+        return None
+
+    run_ranks(mpi_cluster, fn, n=6)
+
+
+def test_minloc_maxloc_allreduce(mpi_cluster):
+    from faabric_tpu.mpi.types import DOUBLE_INT_DTYPE
+
+    def fn(world, rank):
+        pairs = np.zeros(3, dtype=DOUBLE_INT_DTYPE)
+        # Values arranged so the min of slot i is at rank (i % size) and
+        # ties (slot 2) resolve to the LOWEST rank
+        pairs["val"] = [float(rank == 0), float((rank + 1) % world.size),
+                        1.0]
+        pairs["loc"] = rank
+        got = world.allreduce(rank, pairs, MpiOp.MINLOC)
+        assert got["loc"][2] == 0  # tie → lowest rank
+        assert got["val"][0] == 0.0
+        got_max = world.allreduce(rank, pairs, MpiOp.MAXLOC)
+        assert got_max["val"][2] == 1.0 and got_max["loc"][2] == 0
+        return None
+
+    run_ranks(mpi_cluster, fn, n=6)
+
+
+def test_cart_create_user_dims(mpi_cluster):
+    def fn(world, rank):
+        if rank == 0:
+            dims = world.cart_create((3, 2, 1))
+            assert dims == (3, 2, 1)
+            assert world.cart_coords(5) == (2, 1, 0)
+            assert world.cart_rank((2, 1, 0)) == 5
+            # Periodic wrap in every dimension
+            assert world.cart_rank((-1, 0, 0)) == world.cart_rank((2, 0, 0))
+            src, dst = world.cart_shift(0, 0, 1)
+            assert (src, dst) == (4, 2)
+            with pytest.raises(ValueError, match="do not tile"):
+                world.cart_create((4, 2))
+            world.cart_create(None)  # back to the 2-D default
+            assert world.cart_dims() == (2, 3)
+        return None
+
+    run_ranks(mpi_cluster, fn, n=1)
