@@ -329,14 +329,25 @@ class MpiWorld:
                 timeout: float | None = None
                 ) -> tuple[int, Optional[tuple[np.ndarray, MpiStatus]]]:
         """MPI_Waitany: (index, result) of the first completable request.
-        Sends are instantly ready; recvs poll their arrival."""
+        Sends are instantly ready; recvs poll their arrival. Ids already
+        completed by an earlier wait are skipped (the standard repeated-
+        waitany loop); an empty/fully-completed list returns (-1, None)
+        — MPI_UNDEFINED."""
         import time as _time
 
         deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
+            live = 0
             for i, rid in enumerate(request_ids):
-                if self.request_ready(rank, rid):
+                try:
+                    ready = self.request_ready(rank, rid)
+                except KeyError:
+                    continue  # completed by an earlier wait
+                live += 1
+                if ready:
                     return i, self.await_async(rank, rid)
+            if live == 0:
+                return -1, None
             if deadline is not None and _time.monotonic() >= deadline:
                 raise TimeoutError("MPI_Waitany timed out")
             _time.sleep(0.0005)

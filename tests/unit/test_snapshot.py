@@ -65,6 +65,39 @@ def test_dirty_tracking_memory_growth(mode):
     assert list(np.where(flags)[0]) == [1, 2, 3, 4]
 
 
+@pytest.mark.parametrize("mode", ["compare", "native", "hash"])
+def test_region_hints_track_only_hinted_pages(mode):
+    """With hints, only writes inside the declared extents are reported
+    (that's the contract); bracketing cost scales with the hint set."""
+    mem = np.zeros(PAGE_SIZE * 64, np.uint8)
+    tracker = make_dirty_tracker(mode)
+    hints = [(PAGE_SIZE * 2, PAGE_SIZE), (PAGE_SIZE * 10, 100)]
+    tracker.start_tracking(mem, region_hints=hints)
+    mem[PAGE_SIZE * 2 + 5] = 1     # inside hint 1
+    mem[PAGE_SIZE * 10 + 50] = 2   # inside hint 2
+    mem[PAGE_SIZE * 30] = 3        # OUTSIDE hints: not reported
+    flags = tracker.get_dirty_pages(mem)
+    assert flags.size == 64
+    assert list(np.where(flags)[0]) == [2, 10]
+
+    # Thread-local hinted tracking isolates the same way
+    tracker.start_thread_local_tracking(mem, region_hints=hints)
+    mem[PAGE_SIZE * 10] = 9
+    local = tracker.get_thread_local_dirty_pages(mem)
+    assert list(np.where(local)[0]) == [10]
+
+
+@pytest.mark.parametrize("mode", ["compare", "hash"])
+def test_region_hints_partial_trailing_page(mode):
+    """Hints covering the image's trailing partial page work."""
+    mem = np.zeros(PAGE_SIZE * 3 + 100, np.uint8)
+    tracker = make_dirty_tracker(mode)
+    tracker.start_tracking(mem, region_hints=[(PAGE_SIZE * 3, 100)])
+    mem[PAGE_SIZE * 3 + 10] = 1
+    flags = tracker.get_dirty_pages(mem)
+    assert list(np.where(flags)[0]) == [3]
+
+
 # ---------------------------------------------------------------------------
 # Snapshot diffs + merge regions
 # ---------------------------------------------------------------------------
