@@ -1,13 +1,17 @@
 """Mixture-of-Experts transformer: the second model family, exercising
 expert parallelism over the ``ep`` mesh axis.
 
-Top-1 (switch-style) routing with fixed expert capacity, in the
-einsum-dispatch formulation: a one-hot dispatch tensor scatters tokens
-into per-expert buffers, experts run as one batched matmul pair, and the
-combine einsum gathers results weighted by the router gate. Experts shard
-over ``ep``; with the dispatch/combine sharding constraints XLA inserts
-the token all_to_alls over ICI — the MoE analog of the MPI world's
-alltoall (SURVEY §2.4), expressed entirely through shardings.
+Top-k routing (switch-style top-1 by default, GShard-style top-2+ via
+``router_top_k``) with fixed expert capacity, in the einsum-dispatch
+formulation: a one-hot dispatch tensor scatters tokens into per-expert
+buffers, experts run as one batched matmul pair, and the combine einsum
+gathers results weighted by the router gates (renormalized over the
+selected experts for k > 1). Capacity is allocated slot-major — every
+token's first choice outranks any token's second choice, the standard
+priority rule. Experts shard over ``ep``; with the dispatch/combine
+sharding constraints XLA inserts the token all_to_alls over ICI — the
+MoE analog of the MPI world's alltoall (SURVEY §2.4), expressed entirely
+through shardings.
 
 Static shapes throughout: capacity is fixed, overflow tokens drop (their
 residual passes through), standard for TPU switch routing.
@@ -34,6 +38,9 @@ from faabric_tpu.models.transformer import (
 class MoEConfig(ModelConfig):
     n_experts: int = 4
     capacity_factor: float = 1.25
+    # Experts per token: 1 = switch routing (gate = raw top prob),
+    # >1 = GShard-style with gates renormalized over the selected experts
+    router_top_k: int = 1
     # Auxiliary load-balancing loss weight (switch transformer)
     aux_loss_weight: float = 0.01
 
@@ -92,7 +99,8 @@ def moe_param_shardings(mesh: Mesh, cfg: MoEConfig) -> dict:
 
 
 def _capacity(cfg: MoEConfig, seq: int) -> int:
-    return max(1, int(np.ceil(seq * cfg.capacity_factor / cfg.n_experts)))
+    return max(1, int(np.ceil(
+        seq * cfg.router_top_k * cfg.capacity_factor / cfg.n_experts)))
 
 
 def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
@@ -100,28 +108,38 @@ def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
     """x (B, S, D) → (out, aux_loss)."""
     b, s, d = x.shape
     e = cfg.n_experts
+    k = cfg.router_top_k
     c = _capacity(cfg, s)
 
     logits = (x.astype(jnp.float32)
               @ blk["router"].astype(jnp.float32))  # (B, S, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.max(probs, axis=-1)                  # (B, S)
-    expert = jnp.argmax(probs, axis=-1)             # (B, S)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)   # (B, S, K)
+    if k == 1:
+        gates = topk_probs                           # switch: raw prob
+    else:
+        gates = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
-    # Switch load-balancing aux loss: E · Σ_e f_e · p_e
-    one_hot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (B, S, E)
-    density = one_hot.mean(axis=1)                  # fraction per expert
+    # Switch load-balancing aux loss over FIRST choices: E · Σ_e f_e · p_e
+    top1_hot = jax.nn.one_hot(topk_idx[..., 0], e, dtype=jnp.float32)
+    density = top1_hot.mean(axis=1)                  # fraction per expert
     density_proxy = probs.mean(axis=1)
     aux = (density * density_proxy).sum(axis=-1).mean() * e
 
-    # Position of each token within its expert's capacity buffer
-    pos = (jnp.cumsum(one_hot, axis=1) - 1.0) * one_hot  # (B, S, E)
-    pos = pos.sum(axis=-1)                               # (B, S)
-    keep = pos < c
-    dispatch = (one_hot * keep[..., None].astype(jnp.float32))[..., None] \
-        * jax.nn.one_hot(pos.astype(jnp.int32), c,
+    # Capacity allocation, slot-major: flatten (K, S) assignments so all
+    # first choices outrank any second choice, cumsum positions within
+    # each expert's buffer, drop past capacity
+    oh = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)      # (B, S, K, E)
+    oh_flat = oh.transpose(0, 2, 1, 3).reshape(b, k * s, e)  # slot-major
+    pos_flat = ((jnp.cumsum(oh_flat, axis=1) - 1.0) * oh_flat).sum(axis=-1)
+    keep = (pos_flat < c).astype(jnp.float32)
+    disp_flat = (oh_flat * keep[..., None])[..., None] \
+        * jax.nn.one_hot(pos_flat.astype(jnp.int32), c,
                          dtype=jnp.float32)[:, :, None, :]
-    # dispatch: (B, S, E, C)
+    disp = disp_flat.reshape(b, k, s, e, c)                  # per slot
+    dispatch = disp.sum(axis=1)                              # (B, S, E, C)
+    combine_w = (disp
+                 * gates.transpose(0, 2, 1)[..., None, None]).sum(axis=1)
 
     def constrain(arr, *spec):
         if mesh is not None:
@@ -141,8 +159,7 @@ def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
     out_e = jnp.einsum("ebcf,efd->ebcd", h, w2)
     out_e = constrain(out_e, "ep", "dp", None, None)
 
-    combine = dispatch * gate[..., None, None]
-    out = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
+    out = jnp.einsum("bsec,ebcd->bsd", combine_w, out_e)
     return out.astype(x.dtype), aux.astype(jnp.float32)
 
 

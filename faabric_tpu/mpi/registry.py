@@ -108,11 +108,14 @@ class MpiWorldRegistry:
         with self._lock:
             world = self._worlds.pop(world_id, None)
         if world is not None:
+            world.close()
             self.broker.clear_group(world.group_id)
 
     def clear(self) -> None:
         with self._lock:
-            self._worlds.clear()
+            worlds, self._worlds = dict(self._worlds), {}
+        for w in worlds.values():
+            w.close()
 
 
 class MpiContext:

@@ -47,6 +47,40 @@ logger = get_logger(__name__)
 MAIN_RANK = 0
 
 
+class _SendWorker:
+    """Daemon FIFO worker for one rank's remote async sends. Daemon so a
+    transfer wedged on a dead peer can never hang interpreter exit; FIFO
+    so a rank's sends to any one destination stay in order."""
+
+    def __init__(self, name: str) -> None:
+        import queue as _queue
+
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._t = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._t.start()
+
+    def submit(self, fn):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        self._q.put((fn, fut))
+        return fut
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — delivered at wait()
+                fut.set_exception(e)
+
+    def shutdown(self) -> None:
+        self._q.put(None)
+
+
 class _LocalMpiPayload:
     """Same-host MPI message: the array object itself rides the queue.
     ``shared`` marks fan-out buffers delivered to several receivers (a
@@ -97,6 +131,8 @@ class MpiWorld:
         self.record_exec_graph = False
 
         self._device_collectives = None
+        self._send_workers: dict[int, _SendWorker] = {}
+        self._in_send_pool = threading.local()
 
     # ------------------------------------------------------------------
     # Topology
@@ -172,6 +208,10 @@ class MpiWorld:
                     self._msg_count_to_rank.get(recv_rank, 0) + 1
                 key = (int(msg_type), recv_rank)
                 self._msg_type_count[key] = self._msg_type_count.get(key, 0) + 1
+
+        # Program order: a blocking send must not overtake this rank's
+        # queued async sends to the same destination
+        self._fence_sends(send_rank, recv_rank)
 
         # Same-host ranks skip serialization entirely: one defensive copy
         # (MPI semantics: the sender may reuse its buffer immediately) rides
@@ -272,14 +312,64 @@ class MpiWorld:
         self.send(send_rank, dst, send_data, MpiMessageType.SENDRECV)
         return self.recv(src, recv_rank)
 
-    # -- async (reference :496-540 encodes requests; here a registry) ----
+    # -- async (reference :496-540 encodes requests + UNACKED buffers;
+    # here a registry + per-rank send workers) ---------------------------
+    def _send_worker(self, rank: int) -> "_SendWorker":
+        """One daemon worker per sending rank: submission order per rank
+        keeps (source, dest) streams non-overtaking, and one rank's slow
+        transfer never stalls another rank's async sends."""
+        with self._lock:
+            w = self._send_workers.get(rank)
+            if w is None:
+                w = _SendWorker(f"mpi-{self.id}-send-r{rank}")
+                self._send_workers[rank] = w
+            return w
+
+    def _fence_sends(self, rank: int, recv_rank: int) -> None:
+        """Order a blocking send after the rank's queued isends TO THE
+        SAME DESTINATION (MPI non-overtaking is per (source, dest) pair).
+        Skipped on the send worker itself — it IS the queue."""
+        if not self._send_workers:
+            return  # no remote isend ever issued: nothing to fence
+        if getattr(self._in_send_pool, "flag", False):
+            return
+        with self._lock:
+            futs = [entry[1] for entry in
+                    self._requests.get(rank, {}).values()
+                    if entry[0] == "send" and entry[1] is not None
+                    and entry[2] == recv_rank]
+        for f in futs:
+            f.exception()  # wait; errors surface at wait()
+
     def isend(self, send_rank: int, recv_rank: int, data: np.ndarray) -> int:
         with self._lock:
             rid = self._next_request_id
             self._next_request_id += 1
-            self._requests.setdefault(send_rank, {})[rid] = ("send",)
-        # PTP sends are buffered and non-blocking; fire immediately
-        self.send(send_rank, recv_rank, data, request_id=rid)
+
+        self.broker.wait_for_mappings(self.group_id)
+        remote = self.broker.get_host_for_receiver(
+            self.group_id, recv_rank) != self.broker.host
+        if remote:
+            # Remote sends can block on TCP: run on the rank's send
+            # worker so isend returns immediately (the reference's
+            # UNACKED-buffer progress analog). Copy now — MPI lets the
+            # caller reuse the buffer as soon as isend returns.
+            payload = np.asarray(data).copy()
+
+            def _do_send():
+                self._in_send_pool.flag = True
+                self.send(send_rank, recv_rank, payload, request_id=rid)
+
+            fut = self._send_worker(send_rank).submit(_do_send)
+            with self._lock:
+                self._requests.setdefault(send_rank, {})[rid] = (
+                    "send", fut, recv_rank)
+        else:
+            # Local enqueue never blocks; fire inline
+            self.send(send_rank, recv_rank, data, request_id=rid)
+            with self._lock:
+                self._requests.setdefault(send_rank, {})[rid] = (
+                    "send", None, recv_rank)
         return rid
 
     def irecv(self, send_rank: int, recv_rank: int) -> int:
@@ -299,6 +389,9 @@ class MpiWorld:
         if entry is None:
             raise KeyError(f"Unknown MPI request {request_id} for rank {rank}")
         if entry[0] == "send":
+            fut = entry[1]
+            if fut is not None:
+                fut.result()  # join the send worker; surfaces send errors
             return None
         _, send_rank, recv_rank = entry
         return self.recv(send_rank, recv_rank)
@@ -315,7 +408,8 @@ class MpiWorld:
         if entry is None:
             raise KeyError(f"Unknown MPI request {request_id} for rank {rank}")
         if entry[0] == "send":
-            return True
+            fut = entry[1]
+            return fut is None or fut.done()
         _, send_rank, recv_rank = entry
         return self.broker.try_probe_message(self.group_id, send_rank,
                                              recv_rank) is not None
@@ -329,10 +423,11 @@ class MpiWorld:
                 timeout: float | None = None
                 ) -> tuple[int, Optional[tuple[np.ndarray, MpiStatus]]]:
         """MPI_Waitany: (index, result) of the first completable request.
-        Sends are instantly ready; recvs poll their arrival. Ids already
-        completed by an earlier wait are skipped (the standard repeated-
-        waitany loop); an empty/fully-completed list returns (-1, None)
-        — MPI_UNDEFINED."""
+        Local sends are instantly ready, remote isends once their send
+        worker finishes them, recvs when their message arrives. Ids
+        already completed by an earlier wait are skipped (the standard
+        repeated-waitany loop); an empty/fully-completed list returns
+        (-1, None) — MPI_UNDEFINED."""
         import time as _time
 
         deadline = None if timeout is None else _time.monotonic() + timeout
@@ -838,6 +933,13 @@ class MpiWorld:
         src_coords[dim] -= disp
         dst_coords[dim] += disp
         return self.cart_rank(src_coords), self.cart_rank(dst_coords)
+
+    def close(self) -> None:
+        """Stop this world's send workers (registry teardown)."""
+        with self._lock:
+            workers, self._send_workers = dict(self._send_workers), {}
+        for w in workers.values():
+            w.shutdown()
 
     # ------------------------------------------------------------------
     # Migration (reference prepareMigration :2095-2131)

@@ -446,3 +446,24 @@ def test_cart_create_user_dims(mpi_cluster):
         return None
 
     run_ranks(mpi_cluster, fn, n=1)
+
+
+def test_isend_remote_async_with_ordering(mpi_cluster):
+    """Remote isend runs on the send worker (caller returns immediately,
+    buffer reusable) and a subsequent BLOCKING send from the same rank
+    never overtakes it (program-order fence)."""
+    def fn(world, rank):
+        if rank == 0:
+            buf = np.full(300_000, 7, dtype=np.int32)  # ~1.2 MB → bulk
+            rid = world.isend(0, 3, buf)  # rank 3 lives on the other host
+            buf[:] = -1  # caller may reuse the buffer right away
+            world.send(0, 3, np.array([99], np.int32))  # must arrive 2nd
+            world.await_async(0, rid)
+        elif rank == 3:
+            first, _ = world.recv(0, 3)
+            assert first.size == 300_000 and first[0] == 7, first[:3]
+            second, _ = world.recv(0, 3)
+            assert second.tolist() == [99]
+        return None
+
+    run_ranks(mpi_cluster, fn, n=6)
