@@ -93,23 +93,62 @@ def forward_with_cache(params, tokens, cache, start, cfg: ModelConfig):
     return logits, new_cache
 
 
-@partial(jax.jit, static_argnums=(2, 3))
-def generate(params, prompt, cfg: ModelConfig, n_tokens: int):
-    """Greedy decode: prompt (B, S_p) int32 → (B, n_tokens) int32.
-    Prefill + a scanned single-token decode loop, all one program."""
+def _pick_token(logits, key, temperature: float, top_k: int,
+                top_p: float) -> jax.Array:
+    """One sampling step over (B, V) logits. temperature == 0 → greedy;
+    otherwise temperature-scaled sampling with optional top-k then
+    nucleus (top-p) truncation — the standard serving stack."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative mass >= top_p (the
+        # first token always survives)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 5, 6, 7, 8))
+def generate(params, prompt, cfg: ModelConfig, n_tokens: int,
+             key: jax.Array | None = None, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 1.0, mesh=None):
+    """Decode: prompt (B, S_p) int32 → (B, n_tokens) int32. Prefill + a
+    scanned single-token decode loop, all one program. Default is greedy
+    (temperature 0); pass a PRNG ``key`` with ``temperature``/``top_k``/
+    ``top_p`` for sampling. With ``mesh``, the KV cache shards batch over
+    ``dp`` and heads over ``tp`` (matching tp-sharded params), so decode
+    runs tensor-parallel with XLA inserting the activation collectives."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     b, s_p = prompt.shape
     cache = init_kv_cache(cfg, b)
+    if mesh is not None:
+        kv_sharding = NamedSharding(mesh, P("dp", None, "tp", None))
+        cache = [{k: jax.lax.with_sharding_constraint(v, kv_sharding)
+                  for k, v in layer.items()} for layer in cache]
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
-    next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    key, sub = jax.random.split(key)
+    next_tok = _pick_token(logits[:, -1], sub, temperature, top_k, top_p)
 
     def step(carry, _):
-        tok, pos, cache = carry
+        tok, pos, cache, key = carry
         logits, cache = forward_with_cache(params, tok[:, None], cache,
                                            pos, cfg)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return (nxt, pos + 1, cache), tok
+        key, sub = jax.random.split(key)
+        nxt = _pick_token(logits[:, -1], sub, temperature, top_k, top_p)
+        return (nxt, pos + 1, cache, key), tok
 
-    (_, _, _), toks = jax.lax.scan(step, (next_tok, s_p, cache), None,
-                                   length=n_tokens)
+    (_, _, _, _), toks = jax.lax.scan(step, (next_tok, s_p, cache, key),
+                                      None, length=n_tokens)
     return toks.T  # (B, n_tokens)

@@ -115,7 +115,8 @@ class MpiWorldRegistry:
         with self._lock:
             worlds, self._worlds = dict(self._worlds), {}
         for w in worlds.values():
-            w.close()
+            if w is not None:  # None = create_world's in-flight reservation
+                w.close()
 
 
 class MpiContext:

@@ -56,6 +56,8 @@ class _SendWorker:
         import queue as _queue
 
         self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._closed = False
+        self._state_lock = threading.Lock()
         self._t = threading.Thread(target=self._loop, name=name, daemon=True)
         self._t.start()
 
@@ -63,7 +65,19 @@ class _SendWorker:
         from concurrent.futures import Future
 
         fut: Future = Future()
-        self._q.put((fn, fut))
+        # Lock orders submits against shutdown's sentinel: either this
+        # lands in the FIFO before the None (worker runs it) or _closed
+        # is already visible (run inline) — a submitted future can never
+        # be silently dropped, which would hang await_async forever
+        with self._state_lock:
+            closed = self._closed
+            if not closed:
+                self._q.put((fn, fut))
+        if closed:
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
         return fut
 
     def _loop(self) -> None:
@@ -78,7 +92,9 @@ class _SendWorker:
                 fut.set_exception(e)
 
     def shutdown(self) -> None:
-        self._q.put(None)
+        with self._state_lock:
+            self._closed = True
+            self._q.put(None)
 
 
 class _LocalMpiPayload:
@@ -383,7 +399,8 @@ class MpiWorld:
     def await_async(self, rank: int, request_id: int
                     ) -> Optional[tuple[np.ndarray, MpiStatus]]:
         """MPI_Wait. Recvs complete here (lazy, like the reference's
-        recvBatchReturnLast :1963-2030); sends completed at isend."""
+        recvBatchReturnLast :1963-2030); local sends completed at isend,
+        remote isends join their send worker here (errors surface now)."""
         with self._lock:
             entry = self._requests.get(rank, {}).pop(request_id, None)
         if entry is None:
@@ -401,8 +418,9 @@ class MpiWorld:
             return len(self._requests.get(rank, {}))
 
     def request_ready(self, rank: int, request_id: int) -> bool:
-        """True when await_async would complete without blocking (sends
-        complete at isend; recvs when their message has arrived)."""
+        """True when await_async would complete without blocking (local
+        sends at isend, remote isends when their send worker finishes,
+        recvs when their message has arrived)."""
         with self._lock:
             entry = self._requests.get(rank, {}).get(request_id)
         if entry is None:

@@ -457,3 +457,69 @@ def test_chained_functions_and_exec_graph(cluster):
     assert graph.count_nodes() == 3
     child_outputs = sorted(c.msg.output_data for c in graph.root.children)
     assert child_outputs == [b"10", b"20"]
+
+
+def test_threads_batch_with_region_hints(cluster, monkeypatch):
+    """Same two-host THREADS merge flow with DIRTY_REGION_HINTS=1: the
+    snapshot declares every write extent, trackers bracket only those
+    pages, and the merged result is identical."""
+    import numpy as np
+
+    from faabric_tpu.proto import BatchExecuteType
+    from faabric_tpu.snapshot import (
+        SnapshotData,
+        SnapshotDataType,
+        SnapshotMergeOperation,
+    )
+    from faabric_tpu.util.config import get_system_config
+
+    monkeypatch.setenv("DIRTY_REGION_HINTS", "1")
+    get_system_config().reset()
+    try:
+        w = cluster["workers"]["hostA"]
+
+        class ThreadsFactory(ExecutorFactory):
+            def create_executor(self, msg):
+                return ThreadsExecutor(msg)
+
+        set_executor_factory(ThreadsFactory())
+
+        n_threads = 8
+        base_mem = np.zeros(ThreadsExecutor.MEM_SIZE, dtype=np.uint8)
+        base_mem[:8].view(np.int64)[0] = 500
+        snap = SnapshotData(base_mem.tobytes())
+        # Declare EVERY write extent explicitly (the hints contract);
+        # no gap-fill up front, so declared coverage stays small and the
+        # hints actually engage
+        snap.add_merge_region(0, 8, SnapshotDataType.LONG,
+                              SnapshotMergeOperation.SUM)
+        for i in range(n_threads):
+            snap.add_merge_region(128 * (1 + i), 1, SnapshotDataType.RAW,
+                                  SnapshotMergeOperation.BYTEWISE)
+
+        req = batch_exec_factory("demo", "threads", n_threads)
+        req.type = int(BatchExecuteType.THREADS)
+        for i, m in enumerate(req.messages):
+            m.group_idx = i
+        key = f"demo/threads_hints_{req.app_id}"
+        req.snapshot_key = key
+        w.snapshot_registry.register_snapshot(key, snap)
+
+        decision = w.planner_client.call_functions(req)
+        assert set(decision.hosts) == {"hostA", "hostB"}
+        for m in req.messages:
+            result = w.planner_client.get_message_result(req.app_id, m.id,
+                                                         timeout=15.0)
+            assert result.return_value == int(ReturnValue.SUCCESS), \
+                result.output_data
+
+        applied = snap.write_queued_diffs()
+        assert applied >= 2, applied
+        merged = snap.data
+        assert merged[:8].view("int64")[0] == 500 + sum(
+            i + 1 for i in range(n_threads))
+        for i in range(n_threads):
+            assert merged[128 * (1 + i)] == 100 + i
+    finally:
+        monkeypatch.undo()
+        get_system_config().reset()
