@@ -13,6 +13,15 @@ from faabric_tpu.executor.factory import (
     set_executor_factory,
 )
 
+from faabric_tpu.executor.jax_executor import (  # noqa: E402
+    GuestContext,
+    JaxExecutor,
+    JaxExecutorFactory,
+    clear_registered_functions,
+    register_function,
+    unregister_function,
+)
+
 __all__ = [
     "Executor",
     "ExecutorContext",
@@ -20,6 +29,12 @@ __all__ = [
     "ExecutorTask",
     "FunctionFrozenException",
     "FunctionMigratedException",
+    "GuestContext",
+    "JaxExecutor",
+    "JaxExecutorFactory",
+    "clear_registered_functions",
     "get_executor_factory",
+    "register_function",
     "set_executor_factory",
+    "unregister_function",
 ]

@@ -93,18 +93,19 @@ def forward_with_cache(params, tokens, cache, start, cfg: ModelConfig):
     return logits, new_cache
 
 
-def _pick_token(logits, key, temperature: float, top_k: int,
-                top_p: float) -> jax.Array:
-    """One sampling step over (B, V) logits. temperature == 0 → greedy;
-    otherwise temperature-scaled sampling with optional top-k then
-    nucleus (top-p) truncation — the standard serving stack."""
-    if temperature == 0.0:
+def _pick_token(logits, key, greedy: bool, temperature, top_k: int,
+                use_top_p: bool, top_p) -> jax.Array:
+    """One sampling step over (B, V) logits. Static structure (greedy vs
+    sample, top-k size, top-p enabled) picks the program; temperature and
+    top_p themselves are TRACED operands, so a serving loop varying them
+    per request reuses one compiled decode."""
+    if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
+    if use_top_p:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
@@ -116,16 +117,10 @@ def _pick_token(logits, key, temperature: float, top_k: int,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(2, 3, 5, 6, 7, 8))
-def generate(params, prompt, cfg: ModelConfig, n_tokens: int,
-             key: jax.Array | None = None, temperature: float = 0.0,
-             top_k: int = 0, top_p: float = 1.0, mesh=None):
-    """Decode: prompt (B, S_p) int32 → (B, n_tokens) int32. Prefill + a
-    scanned single-token decode loop, all one program. Default is greedy
-    (temperature 0); pass a PRNG ``key`` with ``temperature``/``top_k``/
-    ``top_p`` for sampling. With ``mesh``, the KV cache shards batch over
-    ``dp`` and heads over ``tp`` (matching tp-sharded params), so decode
-    runs tensor-parallel with XLA inserting the activation collectives."""
+@partial(jax.jit, static_argnums=(2, 3, 6, 7, 9, 10))
+def _generate_impl(params, prompt, cfg: ModelConfig, n_tokens: int,
+                   key, temperature, greedy: bool, top_k: int, top_p,
+                   use_top_p: bool, mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     b, s_p = prompt.shape
@@ -134,21 +129,41 @@ def generate(params, prompt, cfg: ModelConfig, n_tokens: int,
         kv_sharding = NamedSharding(mesh, P("dp", None, "tp", None))
         cache = [{k: jax.lax.with_sharding_constraint(v, kv_sharding)
                   for k, v in layer.items()} for layer in cache]
-    if key is None:
-        key = jax.random.PRNGKey(0)
 
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
     key, sub = jax.random.split(key)
-    next_tok = _pick_token(logits[:, -1], sub, temperature, top_k, top_p)
+    next_tok = _pick_token(logits[:, -1], sub, greedy, temperature,
+                           top_k, use_top_p, top_p)
 
     def step(carry, _):
         tok, pos, cache, key = carry
         logits, cache = forward_with_cache(params, tok[:, None], cache,
                                            pos, cfg)
         key, sub = jax.random.split(key)
-        nxt = _pick_token(logits[:, -1], sub, temperature, top_k, top_p)
+        nxt = _pick_token(logits[:, -1], sub, greedy, temperature,
+                          top_k, use_top_p, top_p)
         return (nxt, pos + 1, cache, key), tok
 
     (_, _, _, _), toks = jax.lax.scan(step, (next_tok, s_p, cache, key),
                                       None, length=n_tokens)
     return toks.T  # (B, n_tokens)
+
+
+def generate(params, prompt, cfg: ModelConfig, n_tokens: int,
+             key: jax.Array | None = None, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 1.0, mesh=None):
+    """Decode: prompt (B, S_p) int32 → (B, n_tokens) int32. Prefill + a
+    scanned single-token decode loop, all one program. Default is greedy
+    (temperature 0); pass a PRNG ``key`` with ``temperature``/``top_k``/
+    ``top_p`` for sampling (varying temperature/top_p does NOT
+    recompile; varying top_k does — it's a shape). With ``mesh``, the KV
+    cache shards batch over ``dp`` and heads over ``tp`` (matching
+    tp-sharded params), so decode runs tensor-parallel with XLA
+    inserting the activation collectives."""
+    greedy = temperature == 0.0
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return _generate_impl(
+        params, prompt, cfg, n_tokens, key,
+        jnp.float32(temperature if not greedy else 1.0), greedy,
+        int(top_k), jnp.float32(top_p), top_p < 1.0, mesh)

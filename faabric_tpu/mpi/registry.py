@@ -72,6 +72,13 @@ class MpiWorldRegistry:
                     self._worlds.pop(world_id, None)
             raise
         with self._lock:
+            if world_id not in self._worlds:
+                # clear() swept the registry (worker teardown) while we
+                # were chaining ranks: don't resurrect a world into a
+                # dead registry
+                world.close()
+                raise RuntimeError(
+                    f"Registry cleared while creating world {world_id}")
             self._worlds[world_id] = world
         logger.debug("Created MPI world %d (size=%d group=%d)", world_id,
                      size, group_id)

@@ -67,17 +67,16 @@ class _SendWorker:
         fut: Future = Future()
         # Lock orders submits against shutdown's sentinel: either this
         # lands in the FIFO before the None (worker runs it) or _closed
-        # is already visible (run inline) — a submitted future can never
-        # be silently dropped, which would hang await_async forever
+        # is already visible and the future FAILS — never runs inline
+        # (inline would reorder past still-queued sends and could block
+        # the caller on a wedged peer) and never silently drops (which
+        # would hang await_async forever)
         with self._state_lock:
-            closed = self._closed
-            if not closed:
+            if self._closed:
+                fut.set_exception(RuntimeError(
+                    "MPI world closed while async send pending"))
+            else:
                 self._q.put((fn, fut))
-        if closed:
-            try:
-                fut.set_result(fn())
-            except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
         return fut
 
     def _loop(self) -> None:

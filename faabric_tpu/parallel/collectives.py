@@ -200,6 +200,39 @@ class DeviceCollectives:
         return self._compiled(key, build)(x)
 
     # ------------------------------------------------------------------
+    # Point-to-point over ICI (the device analog of the PTP broker's
+    # host dispatch — SURVEY §5.8: "PTP dispatch becomes device-to-device
+    # transfers over ICI")
+    # ------------------------------------------------------------------
+    def permute(self, x: jax.Array,
+                pairs: Sequence[tuple[int, int]]) -> jax.Array:
+        """Move rank shards along (src, dst) pairs in ONE compiled
+        ``ppermute`` (each a direct ICI transfer). Ranks that are not a
+        destination receive zeros — MPI-style sendrecv chains compose
+        from these primitives without host round-trips."""
+        key = ("permute", tuple(pairs), x.shape, str(x.dtype))
+
+        def build():
+            perm = list(pairs)
+
+            def f(shard):
+                return jax.lax.ppermute(shard, self.axis, perm)
+            return self._shard_mapped(f, P(self.axis), P(self.axis))
+
+        return self._compiled(key, build)(x)
+
+    def send_recv(self, x: jax.Array, src: int, dst: int) -> jax.Array:
+        """Single device-to-device transfer: rank ``src``'s shard lands
+        on rank ``dst`` (others zero)."""
+        return self.permute(x, [(src, dst)])
+
+    def shift(self, x: jax.Array, disp: int = 1) -> jax.Array:
+        """Ring rotation by ``disp`` (every rank sends, every rank
+        receives — the neighbour-exchange building block)."""
+        return self.permute(
+            x, [(i, (i + disp) % self.n) for i in range(self.n)])
+
+    # ------------------------------------------------------------------
     def to_per_rank(self, x: jax.Array) -> list[np.ndarray]:
         """Read a stacked (n, *buf) array back as per-rank host buffers."""
         host = np.asarray(x)

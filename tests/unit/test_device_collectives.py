@@ -119,3 +119,32 @@ def test_world_device_collectives_end_to_end():
     out = coll.allreduce(coll.shard_stacked(bufs))
     np.testing.assert_allclose(coll.to_per_rank(out)[0],
                                np.full(8, sum(range(N)), dtype=np.float32))
+
+
+def test_device_p2p_send_recv_and_shift():
+    """Device-plane point-to-point: compiled ppermute transfers between
+    specific ranks (the ICI analog of PTP dispatch)."""
+    import numpy as np
+
+    from faabric_tpu.parallel import DeviceCollectives
+
+    devs = jax.devices()[:4]
+    col = DeviceCollectives(devs)
+    x = col.shard_stacked([np.full(8, r, np.float32) for r in range(4)])
+
+    # src 1 → dst 3; everyone else zero
+    out = col.to_per_rank(col.send_recv(x, 1, 3))
+    np.testing.assert_array_equal(out[3], np.full(8, 1, np.float32))
+    for r in (0, 1, 2):
+        np.testing.assert_array_equal(out[r], np.zeros(8, np.float32))
+
+    # ring shift by 1: rank r receives rank (r-1)'s shard
+    out = col.to_per_rank(col.shift(x, 1))
+    for r in range(4):
+        np.testing.assert_array_equal(
+            out[r], np.full(8, (r - 1) % 4, np.float32))
+
+    # two disjoint pairs in one compiled transfer
+    out = col.to_per_rank(col.permute(x, [(0, 2), (3, 1)]))
+    np.testing.assert_array_equal(out[2], np.zeros(8) + 0)
+    np.testing.assert_array_equal(out[1], np.full(8, 3, np.float32))

@@ -523,3 +523,70 @@ def test_threads_batch_with_region_hints(cluster, monkeypatch):
     finally:
         monkeypatch.undo()
         get_system_config().reset()
+
+
+def test_jax_executor_guest_functions(cluster):
+    """First-class JaxExecutor: registered guest callables gang-schedule
+    through the planner, see their planner-assigned chip, and exchange
+    through the gang's MPI world."""
+    from faabric_tpu.executor import (
+        JaxExecutorFactory,
+        clear_registered_functions,
+        register_function,
+    )
+    from faabric_tpu.mpi import MpiOp
+
+    @register_function("jaxdemo", "square_on_chip")
+    def square_on_chip(ctx):
+        import jax
+        import jax.numpy as jnp
+
+        n = int(ctx.message.input_data.decode())
+        # Run on the chip the planner pinned this rank to
+        with jax.default_device(ctx.device):
+            out = int(jax.jit(lambda v: v * v)(jnp.int32(n)))
+        return f"{out}@{ctx.device_id}".encode()
+
+    @register_function("jaxdemo", "gang_allreduce")
+    def gang_allreduce(ctx):
+        import numpy as np
+
+        world = ctx.mpi_world()
+        rank = ctx.message.mpi_rank
+        out = world.allreduce(rank, np.full(16, rank + 1, np.int64),
+                              MpiOp.SUM)
+        return f"r{rank}:{int(out[0])}".encode()
+
+    set_executor_factory(JaxExecutorFactory())
+    try:
+        w = cluster["workers"]["hostA"]
+
+        # Per-chip placement: 4 tasks, each sees a distinct device id
+        req = batch_exec_factory("jaxdemo", "square_on_chip", 4)
+        for i, m in enumerate(req.messages):
+            m.input_data = str(i + 2).encode()
+        w.planner_client.call_functions(req)
+        devices = set()
+        for i, m in enumerate(req.messages):
+            r = w.planner_client.get_message_result(req.app_id, m.id,
+                                                    timeout=15.0)
+            assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+            val, dev = r.output_data.decode().split("@")
+            assert int(val) == (i + 2) ** 2
+            devices.add(dev)
+        assert len(devices) == 4  # one chip per rank
+
+        # Gang MPI through the GuestContext helper
+        req2 = batch_exec_factory("jaxdemo", "gang_allreduce", 1)
+        req2.messages[0].mpi_rank = 0
+        req2.messages[0].is_mpi = False
+        req2.messages[0].mpi_world_id = 0
+        req2.messages[0].mpi_world_size = 6
+        w.planner_client.call_functions(req2)
+        r = w.planner_client.get_message_result(req2.app_id,
+                                                req2.messages[0].id,
+                                                timeout=20.0)
+        assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+        assert r.output_data == b"r0:21"  # sum of 1..6
+    finally:
+        clear_registered_functions()

@@ -168,7 +168,8 @@ def test_top_p_cutoff_keeps_nucleus():
 
     logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]])
     for seed in range(5):
-        tok = _pick_token(logits, jax.random.PRNGKey(seed), 1.0, 0, 0.5)
+        tok = _pick_token(logits, jax.random.PRNGKey(seed), False,
+                          jnp.float32(1.0), 0, True, jnp.float32(0.5))
         assert int(tok[0]) == 0
 
 
