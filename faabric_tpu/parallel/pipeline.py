@@ -194,11 +194,11 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
     # Branch-free schedule (collectives under device-varying lax.cond
     # deadlock — every device must run the same collective sequence):
     # every stage embeds (a cheap gather) and selects between that and
-    # the hopped-in activation; the last stage's outputs accumulate into
-    # a per-microbatch buffer so the LM head runs ONCE after the loop,
-    # not per tick per stage.
-    def tick(carry, t):
-        x_in, outputs = carry
+    # the hopped-in activation. Stage outputs stream out as scan ys —
+    # the last stage's microbatch m output is simply tick m + S − 1, a
+    # STATIC slice after the loop — so the backward saves O(T) per-tick
+    # activations, not the O(T·M) an in-carry output buffer would.
+    def tick(x_in, t):
         m = jnp.clip(t - s_idx, 0, m_count - 1)
         tokens_m = tokens_mb[m]
 
@@ -207,23 +207,15 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
         x = jnp.where(s_idx == 0, emb, x_in)
         y = stage_fn(x)
 
-        active_last = jnp.logical_and(s_idx == n_stages - 1,
-                                      jnp.logical_and(t - s_idx >= 0,
-                                                      t - s_idx < m_count))
-        written = jax.lax.dynamic_update_slice(
-            outputs, y[None], (m, 0, 0, 0))
-        outputs = jnp.where(active_last, written, outputs)
-
         # One ICI neighbour hop moves every stage's output forward
-        y_next = jax.lax.ppermute(y, "pp", perm)
-        return (y_next, outputs), None
+        return jax.lax.ppermute(y, "pp", perm), y
 
     x0 = _mark_varying(jnp.zeros((b_local, seq, d_model), cfg.compute_dtype),
                        ("dp", "pp"))
-    out0 = _mark_varying(
-        jnp.zeros((m_count, b_local, seq, d_model), cfg.compute_dtype),
-        ("dp", "pp"))
-    (_, outputs), _ = jax.lax.scan(tick, (x0, out0), jnp.arange(ticks))
+    _, ys = jax.lax.scan(tick, x0, jnp.arange(ticks))
+    # Last stage produced microbatch m at tick m + (S − 1); every other
+    # stage's slice is garbage and is masked out by the final psum
+    outputs = ys[n_stages - 1:n_stages - 1 + m_count]
 
     # Loss head scanned one microbatch at a time so peak logits memory
     # stays (b, S, V) — not M× that. Real data only on the last stage;

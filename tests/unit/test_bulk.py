@@ -46,6 +46,28 @@ def bulk_pair():
     clear_host_aliases()
 
 
+def run_threads(fns, timeout=60):
+    """Run the given zero-arg callables on threads, re-raising any
+    exception (a swallowed rank error otherwise shows up as a hang)."""
+    errors = []
+
+    def wrap(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+        return run
+
+    ts = [threading.Thread(target=wrap(fn)) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in ts), "rank thread hung"
+    assert not errors, errors
+
+
 def test_large_payload_rides_bulk_plane(bulk_pair):
     """A payload over the threshold arrives intact and in order with a
     128-bit group id (regression: 64-bit frame field overflowed on real
@@ -97,12 +119,8 @@ def test_mpi_large_allreduce_cross_host(bulk_pair):
         w.refresh_rank_hosts()
         out[rank] = w.allreduce(rank, datas[rank], MpiOp.SUM)
 
-    ts = [threading.Thread(target=rank_fn, args=("bulkA", 0)),
-          threading.Thread(target=rank_fn, args=("bulkB", 1))]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=30)
+    run_threads([lambda: rank_fn("bulkA", 0), lambda: rank_fn("bulkB", 1)],
+                timeout=30)
     expected = datas[0] + datas[1]
     for rank in (0, 1):
         np.testing.assert_array_equal(out[rank], expected)
@@ -127,11 +145,7 @@ def test_chunked_broadcast_sizeless_receiver(bulk_pair):
         # Size-less template: receiver follows the sender's stream
         out[1] = worlds["bulkB"].broadcast(0, 1, np.empty(0))
 
-    ts = [threading.Thread(target=root), threading.Thread(target=receiver)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=30)
+    run_threads([root, receiver], timeout=30)
     np.testing.assert_array_equal(out[1], payload)
     assert out[1].flags.writeable
 
@@ -151,12 +165,8 @@ def test_large_allgather_cross_host(bulk_pair):
         w.refresh_rank_hosts()
         out[rank] = w.allgather(rank, datas[rank])
 
-    ts = [threading.Thread(target=rank_fn, args=("bulkA", 0)),
-          threading.Thread(target=rank_fn, args=("bulkB", 1))]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=30)
+    run_threads([lambda: rank_fn("bulkA", 0), lambda: rank_fn("bulkB", 1)],
+                timeout=30)
     expected = np.concatenate([datas[0], datas[1]])
     for rank in (0, 1):
         np.testing.assert_array_equal(out[rank], expected)
@@ -195,3 +205,30 @@ def test_bulk_falls_back_to_rpc_without_server():
         for b in brokers.values():
             b.clear()
         clear_host_aliases()
+
+
+def test_interleaved_mixed_size_collectives_stress(bulk_pair):
+    """Back-to-back allreduces alternating across the bulk (chunked) and
+    RPC planes with varying sizes — ordering/OOO state must hold across
+    plane switches on the same keys."""
+    worlds = {h: MpiWorld(b, GROUP, 2, GROUP)
+              for h, b in bulk_pair.items()}
+    sizes = [100, (9 << 20) // 4, 1000, (12 << 20) // 4, 64,
+             BULK_THRESHOLD // 4 + 1]
+    out = {}
+
+    def rank_fn(host, rank):
+        w = worlds[host]
+        w.refresh_rank_hosts()
+        acc = []
+        for i, n in enumerate(sizes):
+            got = w.allreduce(rank, np.full(n, rank + i, np.int32),
+                              MpiOp.SUM)
+            acc.append((int(got[0]), int(got[-1])))
+        out[rank] = acc
+
+    run_threads([lambda: rank_fn("bulkA", 0), lambda: rank_fn("bulkB", 1)])
+    for i in range(len(sizes)):
+        expected = (0 + i) + (1 + i)
+        assert out[0][i] == (expected, expected)
+        assert out[1][i] == (expected, expected)

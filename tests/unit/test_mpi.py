@@ -467,3 +467,40 @@ def test_isend_remote_async_with_ordering(mpi_cluster):
         return None
 
     run_ranks(mpi_cluster, fn, n=6)
+
+
+def test_two_concurrent_worlds_are_isolated(mpi_cluster):
+    """Two MPI worlds over the same brokers (reference
+    test_multiple_mpi_worlds.cpp): traffic and collectives never cross
+    group boundaries even when interleaved from the same threads."""
+    # Second world on a second group over the same brokers
+    base_group = GROUP_ID + 777
+    d2 = SchedulingDecision(app_id=base_group, group_id=base_group)
+    worlds_b = {}
+    brokers = {h: mpi_cluster(0 if h == "mpiA" else 5).broker
+               for h in ("mpiA", "mpiB")}
+    for rank in range(6):
+        host = "mpiA" if rank < 3 else "mpiB"
+        d2.add_message(host, 3000 + rank, rank, rank,
+                       mpi_port=8120 + rank, device_id=rank % 4)
+    for h, b in brokers.items():
+        b.set_up_local_mappings_from_decision(d2)
+        worlds_b[h] = MpiWorld(b, base_group, 6, base_group)
+
+    def fn(world_a, rank):
+        world_b = worlds_b["mpiA" if rank < 3 else "mpiB"]
+        # Interleave: allreduce in A, p2p in B, then allreduce in B
+        out_a = world_a.allreduce(rank, np.full(8, rank, np.int64),
+                                  MpiOp.SUM)
+        if rank == 0:
+            world_b.send(0, 5, np.array([1234], np.int64))
+        if rank == 5:
+            arr, _ = world_b.recv(0, 5)
+            assert arr.tolist() == [1234]
+        out_b = world_b.allreduce(rank, np.full(8, rank * 10, np.int64),
+                                  MpiOp.SUM)
+        return int(out_a[0]), int(out_b[0])
+
+    results = run_ranks(mpi_cluster, fn, n=6)
+    for rank in range(6):
+        assert results[rank] == (15, 150)  # sums of 0..5 and 0..50
