@@ -163,6 +163,36 @@ def mpi_waitany(requests: list[int]
     return world.waitany(rank, requests)
 
 
+def mpi_test(request: int) -> tuple[bool, Optional[tuple]]:
+    """MPI_Test: (flag, result). flag False → request still pending (the
+    request stays live); True → completed, result as mpi_wait. Testing a
+    handle that already completed is legal (MPI_REQUEST_NULL semantics)
+    and reports (True, None)."""
+    world, rank = _current()
+    try:
+        if not world.request_ready(rank, request):
+            return False, None
+    except KeyError:
+        return True, None  # completed by an earlier wait/test
+    return True, world.await_async(rank, request)
+
+
+def mpi_type_size(dtype) -> int:
+    """MPI_Type_size over the framework's datatype enum or a numpy
+    dtype."""
+    from faabric_tpu.mpi.types import MpiDataType, np_dtype_for
+
+    if isinstance(dtype, (int, MpiDataType)):
+        return int(np_dtype_for(MpiDataType(int(dtype))).itemsize)
+    return int(np.dtype(dtype).itemsize)
+
+
+def mpi_reduce_scatter(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD
+                       ) -> np.ndarray:
+    world, rank = _current()
+    return world.reduce_scatter(rank, np.asarray(sendbuf), op)
+
+
 def mpi_probe(source: int, comm=MPI_COMM_WORLD) -> MpiStatus:
     world, rank = _current()
     return world.probe(source, rank)

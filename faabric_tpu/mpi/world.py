@@ -861,6 +861,20 @@ class MpiWorld:
                 parts.append(arr)
         return np.concatenate(parts), [int(p.size) for p in parts]
 
+    def reduce_scatter(self, rank: int, data: np.ndarray,
+                       op: MpiOp = MpiOp.SUM) -> np.ndarray:
+        """MPI_Reduce_scatter_block: reduce (size·k,) contributions, rank
+        r keeps segment r (reference composes it the same way: reduce to
+        root + scatter)."""
+        data = np.asarray(data).reshape(-1)
+        if data.size % self.size:
+            raise ValueError(
+                f"reduce_scatter needs size divisible by {self.size}")
+        k = data.size // self.size
+        reduced = self.reduce(rank, MAIN_RANK, data, op)
+        return self.scatter(MAIN_RANK, rank,
+                            reduced if rank == MAIN_RANK else np.empty(0), k)
+
     def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
         # gather(0) + broadcast (reference :1082-1111). The broadcast
         # stream is self-describing (CHUNK_HEADER), so non-roots need no

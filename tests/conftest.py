@@ -74,3 +74,28 @@ def _reset_globals():
     clear_mock_snapshot_requests()
     clear_mock_state_requests()
     clear_sent_ptp()
+
+
+def run_threads(fns, timeout=60.0):
+    """Run zero-arg callables on threads; join with timeout, re-raise the
+    first captured exception (a swallowed rank error otherwise presents
+    as a hang)."""
+    import threading
+
+    errors = []
+
+    def wrap(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+        return run
+
+    ts = [threading.Thread(target=wrap(fn)) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in ts), "worker thread hung"
+    assert not errors, errors

@@ -4,10 +4,10 @@ tuned dedicated sockets, merged with RPC-plane ordering.
 Reference analog: the raw-TCP MPI data plane
 (include/faabric/transport/tcp/Socket.h:75-78)."""
 
-import threading
-
 import numpy as np
 import pytest
+
+from tests.conftest import run_threads
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
 from faabric_tpu.mpi import MpiOp, MpiWorld
@@ -44,28 +44,6 @@ def bulk_pair():
     for b in brokers.values():
         b.clear()
     clear_host_aliases()
-
-
-def run_threads(fns, timeout=60):
-    """Run the given zero-arg callables on threads, re-raising any
-    exception (a swallowed rank error otherwise shows up as a hang)."""
-    errors = []
-
-    def wrap(fn):
-        def run():
-            try:
-                fn()
-            except Exception as e:  # noqa: BLE001
-                errors.append(e)
-        return run
-
-    ts = [threading.Thread(target=wrap(fn)) for fn in fns]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=timeout)
-    assert not any(t.is_alive() for t in ts), "rank thread hung"
-    assert not errors, errors
 
 
 def test_large_payload_rides_bulk_plane(bulk_pair):

@@ -57,22 +57,16 @@ def mpi_cluster():
 
 def run_ranks(world_for_rank, fn, n=6, timeout=20.0):
     """Run fn(world, rank) on a thread per rank; returns results by rank."""
+    from tests.conftest import run_threads
+
     results = {}
-    errors = []
 
     def runner(rank):
-        try:
+        def run():
             results[rank] = fn(world_for_rank(rank), rank)
-        except Exception as e:  # noqa: BLE001
-            errors.append((rank, e))
+        return run
 
-    threads = [threading.Thread(target=runner, args=(r,)) for r in range(n)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=timeout)
-    assert not any(t.is_alive() for t in threads), "rank thread hung"
-    assert not errors, errors
+    run_threads([runner(r) for r in range(n)], timeout=timeout)
     return results
 
 
@@ -504,3 +498,16 @@ def test_two_concurrent_worlds_are_isolated(mpi_cluster):
     results = run_ranks(mpi_cluster, fn, n=6)
     for rank in range(6):
         assert results[rank] == (15, 150)  # sums of 0..5 and 0..50
+
+
+def test_reduce_scatter(mpi_cluster):
+    def fn(world, rank):
+        data = np.arange(12, dtype=np.int64) + rank  # 6 ranks × 2 elems
+        return world.reduce_scatter(rank, data, MpiOp.SUM)
+
+    results = run_ranks(mpi_cluster, fn)
+    total = np.sum(np.stack([np.arange(12, dtype=np.int64) + r
+                             for r in range(6)]), axis=0)
+    for rank in range(6):
+        np.testing.assert_array_equal(results[rank],
+                                      total[rank * 2:(rank + 1) * 2])
