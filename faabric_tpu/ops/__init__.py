@@ -1,6 +1,11 @@
 """Pallas TPU kernels for the hot device ops."""
 
-from faabric_tpu.ops.flash_attention import flash_attention
+from faabric_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+    merge_attention_blocks,
+)
 from faabric_tpu.ops.rms_norm import rms_norm
 
-__all__ = ["flash_attention", "rms_norm"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "merge_attention_blocks", "rms_norm"]

@@ -286,23 +286,22 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, g):
-    q, k, v, out, lse = res
-    if lse is None:
-        # Forward fell back to reference numerics; match them in reverse
-        _, vjp = jax.vjp(
-            lambda q, k, v: _reference_attention(q, k, v, causal), q, k, v)
-        return vjp(g)
-
+def _run_bwd_kernels(q, k, v, g_out, out, lse, causal, block_q, block_k,
+                     g_lse=None):
+    """Launch the two-pass backward kernels. ``g_lse`` (the lse output's
+    cotangent, when the caller exposed lse) folds into the row correction:
+    ds = p·(dp − (Δ − g_lse)), since ∂lse/∂s = p."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
 
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
-    dof, of = _fold_heads(g), _fold_heads(out)
+    dof, of = _fold_heads(g_out), _fold_heads(out)
     # delta_i = Σ_d dO·O — the softmax-jacobian row correction, O(S·D)
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     interpret = jax.default_backend() == "cpu"
     offset = s_k - s_q
@@ -355,4 +354,93 @@ def _flash_bwd(causal, block_q, block_k, res, g):
     return unfold(dqf, s_q), unfold(dkf, s_k), unfold(dvf, s_k)
 
 
+def _flash_bwd(causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    if lse is None:
+        # Forward fell back to reference numerics; match them in reverse
+        _, vjp = jax.vjp(
+            lambda q, k, v: _reference_attention(q, k, v, causal), q, k, v)
+        return vjp(g)
+    return _run_bwd_kernels(q, k, v, g, out, lse, causal, block_q, block_k)
+
+
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) variant — the building block for flash-decoding-style block
+# merging: partial attentions over key blocks combine exactly via
+#   lse = logaddexp(lse_a, lse_b)
+#   out = out_a·exp(lse_a − lse) + out_b·exp(lse_b − lse)
+# ---------------------------------------------------------------------------
+
+def _reference_lse(q, k, causal: bool):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    # (B, H, S_q) → fold to the kernel's (B·H, S_q) layout
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    b, h, s_q = lse.shape
+    return lse.reshape(b * h, s_q)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_k: int = DEFAULT_BLOCK_K):
+    """Attention plus the per-row log-sum-exp: (out (B,S,H,D),
+    lse (B·H, S) fp32). Differentiable in BOTH outputs — the lse
+    cotangent folds into the existing backward kernels as a delta
+    adjustment (ds = p·(dp − (Δ − g_lse)), since ∂lse/∂s = p)."""
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k)
+    if lse is None:  # reference fallback path
+        lse = _reference_lse(q, k, causal)
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k):
+    out, kernel_lse = _flash_forward(q, k, v, causal, block_q, block_k)
+    lse = (kernel_lse if kernel_lse is not None
+           else _reference_lse(q, k, causal))
+    return (out, lse), (q, k, v, out, kernel_lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, res, cotangents):
+    g_out, g_lse = cotangents
+    q, k, v, out, lse = res
+    if lse is None:
+        # Reference numerics in reverse for the fallback path
+        def ref(q, k, v):
+            return (_reference_attention(q, k, v, causal),
+                    _reference_lse(q, k, causal))
+
+        _, vjp = jax.vjp(ref, q, k, v)
+        return vjp((g_out, g_lse))
+    return _run_bwd_kernels(q, k, v, g_out, out, lse, causal,
+                            block_q, block_k, g_lse=g_lse)
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def merge_attention_blocks(outs, lses):
+    """Combine partial attentions over disjoint key blocks (each an
+    (out, lse) pair from flash_attention_with_lse) into the attention
+    over their union — the flash-decoding merge."""
+    lse_total = lses[0]
+    for l in lses[1:]:
+        lse_total = jnp.logaddexp(lse_total, l)
+    b_h, s_q = lse_total.shape
+    out = None
+    for o, l in zip(outs, lses):
+        # lse layout (B·H, S) → broadcast over (B, S, H, D)
+        w = jnp.exp(l - lse_total)
+        b = o.shape[0]
+        h = b_h // b
+        w = w.reshape(b, h, s_q).transpose(0, 2, 1)[..., None]
+        term = o.astype(jnp.float32) * w
+        out = term if out is None else out + term
+    return out.astype(outs[0].dtype), lse_total

@@ -338,3 +338,75 @@ def test_long_context_ring_training_step():
         rng.randint(0, 128, (1, 2048), dtype=np.int32), data_sharding(mesh))
     _, _, loss = step_fn(params, opt_state, tokens, tokens)
     assert np.isfinite(float(loss)), float(loss)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) variant + block merging (flash-decoding building block)
+# ---------------------------------------------------------------------------
+
+def test_flash_with_lse_matches_logsumexp():
+    from faabric_tpu.ops.flash_attention import flash_attention_with_lse
+
+    q, k, v = qkv(b=2, s=256, h=2, d=16)
+    out, lse = flash_attention_with_lse(q, k, v)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    scale = 1.0 / np.sqrt(16)
+    logits = np.einsum("bqhd,bkhd->bhqk", np.asarray(q),
+                       np.asarray(k)) * scale
+    mask = np.tril(np.ones((256, 256), bool))
+    logits = np.where(mask[None, None], logits, -1e30)
+    expect = np.log(np.exp(logits - logits.max(-1, keepdims=True)
+                           ).sum(-1)) + logits.max(-1)
+    np.testing.assert_allclose(np.asarray(lse), expect.reshape(4, 256),
+                               atol=2e-4)
+
+
+def test_flash_with_lse_gradients_including_lse_cotangent():
+    """Backward with a loss that USES the lse output: the g_lse folds
+    into the kernels as a delta adjustment and must match reference
+    autodiff."""
+    from faabric_tpu.ops.flash_attention import (
+        _reference_lse,
+        flash_attention_with_lse,
+    )
+
+    q, k, v = qkv(b=1, s=256, h=2, d=16, seed=17)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v)
+        return jnp.sum(out ** 2) + 0.3 * jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        out = _reference_attention(q, k, v, causal=True)
+        lse = _reference_lse(q, k, True)
+        return jnp.sum(out ** 2) + 0.3 * jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_merge_attention_blocks():
+    """Partial attentions over disjoint key blocks merge exactly into the
+    full attention (non-causal; the flash-decoding combine)."""
+    from faabric_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        merge_attention_blocks,
+    )
+
+    q, k, v = qkv(b=2, s=256, h=2, d=16, seed=19)
+    full, full_lse = flash_attention_with_lse(q, k, v, False)
+
+    k1, k2 = k[:, :128], k[:, 128:]
+    v1, v2 = v[:, :128], v[:, 128:]
+    o1, l1 = flash_attention_with_lse(q, k1, v1, False)
+    o2, l2 = flash_attention_with_lse(q, k2, v2, False)
+    merged, merged_lse = merge_attention_blocks([o1, o2], [l1, l2])
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(merged_lse),
+                               np.asarray(full_lse), atol=2e-4)
