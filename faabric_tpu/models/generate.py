@@ -117,10 +117,10 @@ def _pick_token(logits, key, greedy: bool, temperature, top_k: int,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(2, 3, 6, 7, 9, 10))
+@partial(jax.jit, static_argnums=(2, 3, 6, 7, 9, 10, 11))
 def _generate_impl(params, prompt, cfg: ModelConfig, n_tokens: int,
                    key, temperature, greedy: bool, top_k: int, top_p,
-                   use_top_p: bool, mesh):
+                   use_top_p: bool, mesh, prefill_chunk: int = 0):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     b, s_p = prompt.shape
@@ -130,7 +130,19 @@ def _generate_impl(params, prompt, cfg: ModelConfig, n_tokens: int,
         cache = [{k: jax.lax.with_sharding_constraint(v, kv_sharding)
                   for k, v in layer.items()} for layer in cache]
 
-    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    if prefill_chunk and prefill_chunk < s_p:
+        # Chunked prefill: attention during prefill peaks at
+        # (chunk × max_seq) scores instead of (S_p × max_seq) — the
+        # long-prompt memory bound. Chunk boundaries are static.
+        pos = 0
+        logits = None
+        while pos < s_p:
+            hi = min(pos + prefill_chunk, s_p)
+            logits, cache = forward_with_cache(
+                params, prompt[:, pos:hi], cache, pos, cfg)
+            pos = hi
+    else:
+        logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
     key, sub = jax.random.split(key)
     next_tok = _pick_token(logits[:, -1], sub, greedy, temperature,
                            top_k, use_top_p, top_p)
@@ -151,7 +163,8 @@ def _generate_impl(params, prompt, cfg: ModelConfig, n_tokens: int,
 
 def generate(params, prompt, cfg: ModelConfig, n_tokens: int,
              key: jax.Array | None = None, temperature: float = 0.0,
-             top_k: int = 0, top_p: float = 1.0, mesh=None):
+             top_k: int = 0, top_p: float = 1.0, mesh=None,
+             prefill_chunk: int = 0):
     """Decode: prompt (B, S_p) int32 → (B, n_tokens) int32. Prefill + a
     scanned single-token decode loop, all one program. Default is greedy
     (temperature 0); pass a PRNG ``key`` with ``temperature``/``top_k``/
@@ -159,11 +172,14 @@ def generate(params, prompt, cfg: ModelConfig, n_tokens: int,
     recompile; varying top_k does — it's a shape). With ``mesh``, the KV
     cache shards batch over ``dp`` and heads over ``tp`` (matching
     tp-sharded params), so decode runs tensor-parallel with XLA
-    inserting the activation collectives."""
+    inserting the activation collectives. ``prefill_chunk`` processes
+    long prompts in fixed-size chunks, bounding prefill attention
+    memory."""
     greedy = temperature == 0.0
     if key is None:
         key = jax.random.PRNGKey(0)
     return _generate_impl(
         params, prompt, cfg, n_tokens, key,
         jnp.float32(temperature if not greedy else 1.0), greedy,
-        int(top_k), jnp.float32(top_p), top_p < 1.0, mesh)
+        int(top_k), jnp.float32(top_p), top_p < 1.0, mesh,
+        int(prefill_chunk))

@@ -193,3 +193,18 @@ def test_generate_under_tp_mesh_matches_single_device():
         mesh, jax.sharding.PartitionSpec("dp", None)))
     out = np.asarray(generate(sharded, sp, cfg, 8, mesh=mesh))
     np.testing.assert_array_equal(out, ref)
+
+
+def test_chunked_prefill_matches_full_prefill():
+    """Chunked prefill (incl. a ragged final chunk) produces identical
+    greedy decode to whole-prompt prefill."""
+    from faabric_tpu.models.generate import generate
+
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      d_ff=64, max_seq=64, compute_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(11).randint(0, 64, (2, 21)), jnp.int32)
+    full = np.asarray(generate(params, prompt, cfg, 8))
+    chunked = np.asarray(generate(params, prompt, cfg, 8, prefill_chunk=8))
+    np.testing.assert_array_equal(chunked, full)
