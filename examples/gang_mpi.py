@@ -1,0 +1,75 @@
+"""A gang-scheduled MPI program through the full runtime stack.
+
+One process hosts a planner and a worker; a registered guest function is
+invoked once, creates a 4-rank MPI world (the planner gang-schedules the
+other ranks, pinning each to a chip), and the ranks allreduce.
+
+Run: python examples/gang_mpi.py
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from faabric_tpu.executor import (
+    JaxExecutorFactory,
+    clear_registered_functions,
+    register_function,
+)
+from faabric_tpu.mpi import MpiOp
+from faabric_tpu.planner import PlannerServer, get_planner
+from faabric_tpu.proto import ReturnValue, batch_exec_factory
+from faabric_tpu.runner import WorkerRuntime
+from faabric_tpu.transport.common import (
+    clear_host_aliases,
+    register_host_alias,
+)
+
+
+@register_function("example", "allreduce")
+def allreduce(ctx):
+    world = ctx.mpi_world()
+    rank = ctx.message.mpi_rank
+    out = world.allreduce(rank, np.full(1024, rank + 1, np.int64),
+                          MpiOp.SUM)
+    return f"rank {rank} on chip {ctx.device_id}: sum={int(out[0])}".encode()
+
+
+def main() -> None:
+    base = random.randint(20, 120) * 100
+    register_host_alias("planner", "127.0.0.1", base)
+    register_host_alias("worker", "127.0.0.1", base + 1000)
+
+    get_planner().reset()
+    planner_server = PlannerServer(port_offset=base)
+    planner_server.start()
+    worker = WorkerRuntime(host="worker", slots=4, n_devices=4,
+                           factory=JaxExecutorFactory(),
+                           planner_host="planner")
+    try:
+        worker.start()
+        req = batch_exec_factory("example", "allreduce", 1)
+        req.messages[0].mpi_rank = 0
+        req.messages[0].mpi_world_size = 4
+        worker.planner_client.call_functions(req)
+        r = worker.planner_client.get_message_result(
+            req.app_id, req.messages[0].id, timeout=30.0)
+        assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+        print(r.output_data.decode())  # rank 0's view
+        status = worker.planner_client.get_batch_results(req.app_id)
+        for m in sorted(status.message_results, key=lambda m: m.mpi_rank):
+            print(m.output_data.decode())
+    finally:
+        worker.shutdown()
+        planner_server.stop()
+        get_planner().reset()
+        clear_host_aliases()
+        clear_registered_functions()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Shared state between processes with the file authority — no servers.
+
+Run: python examples/state_kv.py
+(spawns a child process that reads and mutates the same key)
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+CHILD = """
+import os, sys
+sys.path.insert(0, {root!r})
+os.environ["STATE_MODE"] = "file"
+os.environ["STATE_DIR"] = {state_dir!r}
+from faabric_tpu.state.state import State
+kv = State("child").get_kv("example", "shared")
+print("child sees:", kv.get_chunk(0, 5).decode())
+kv.set_chunk(5, b"world")
+kv.push_partial()
+kv.append(b"child-was-here")
+"""
+
+
+def main() -> None:
+    state_dir = tempfile.mkdtemp(prefix="faabric_state_")
+    os.environ["STATE_MODE"] = "file"
+    os.environ["STATE_DIR"] = state_dir
+    from faabric_tpu.util.config import get_system_config
+
+    get_system_config().reset()
+    from faabric_tpu.state.state import State
+
+    kv = State("parent").get_kv("example", "shared", 16)
+    kv.set_chunk(0, b"hello")
+    kv.push_partial()
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    code = CHILD.format(root=os.path.abspath(root), state_dir=state_dir)
+    out = subprocess.run([sys.executable, "-c", code],
+                        capture_output=True, text=True, timeout=60)
+    print(out.stdout.strip())
+
+    kv.pull()
+    print("parent sees:", kv.get_chunk(0, 10).decode())
+    print("append log :", kv.get_appended(1)[0].decode())
+
+
+if __name__ == "__main__":
+    main()
