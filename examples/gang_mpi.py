@@ -60,7 +60,14 @@ def main() -> None:
             req.app_id, req.messages[0].id, timeout=30.0)
         assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
         print(r.output_data.decode())  # rank 0's view
+        # Other ranks' results land asynchronously: poll until finished
+        import time
+
+        deadline = time.time() + 20
         status = worker.planner_client.get_batch_results(req.app_id)
+        while not status.finished and time.time() < deadline:
+            time.sleep(0.2)
+            status = worker.planner_client.get_batch_results(req.app_id)
         for m in sorted(status.message_results, key=lambda m: m.mpi_rank):
             print(m.output_data.decode())
     finally:

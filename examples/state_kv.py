@@ -42,11 +42,16 @@ def main() -> None:
     code = CHILD.format(root=os.path.abspath(root), state_dir=state_dir)
     out = subprocess.run([sys.executable, "-c", code],
                         capture_output=True, text=True, timeout=60)
+    if out.returncode != 0:
+        sys.exit(f"child failed:\n{out.stderr}")
     print(out.stdout.strip())
 
     kv.pull()
     print("parent sees:", kv.get_chunk(0, 10).decode())
     print("append log :", kv.get_appended(1)[0].decode())
+    import shutil
+
+    shutil.rmtree(state_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":
