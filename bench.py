@@ -174,6 +174,12 @@ def bench_host_allreduce(n_ranks: int = 4, elems: int = 25_500_000,
             "payload_mib": payload_bytes / (1 << 20), "rounds": rounds}
 
 
+def _mpi_sum():
+    from faabric_tpu.mpi import MpiOp
+
+    return MpiOp.SUM
+
+
 def _bench_world(my_host: str, app_id: int = 3):
     """Both bench processes build the same 4-rank/2-host world: ranks 0-1
     on xbenchA, 2-3 on xbenchB (mappings installed directly — the planner
@@ -211,7 +217,7 @@ def _allreduce_worker_main(elems: int, rounds: int) -> None:
                 data = np.full(elems, rank, dtype=np.int32)
                 world.barrier(rank)
                 for _ in range(rounds):
-                    out = world.allreduce(rank, data, MpiOp_SUM())
+                    out = world.allreduce(rank, data, _mpi_sum())
                 world.barrier(rank)
                 assert out[0] == 6, out[0]  # 0+1+2+3
             except Exception as e:  # noqa: BLE001 — reported to parent
@@ -228,12 +234,6 @@ def _allreduce_worker_main(elems: int, rounds: int) -> None:
     finally:
         server.stop()
         broker.clear()
-
-
-def MpiOp_SUM():
-    from faabric_tpu.mpi import MpiOp
-
-    return MpiOp.SUM
 
 
 def bench_host_allreduce_procs(elems: int = 25_500_000,
@@ -291,7 +291,7 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
                 world.barrier(rank)
                 t0 = time.perf_counter()
                 for _ in range(rounds):
-                    out = world.allreduce(rank, data, MpiOp_SUM())
+                    out = world.allreduce(rank, data, _mpi_sum())
                 world.barrier(rank)
                 results[rank] = (time.perf_counter() - t0, out[0])
 

@@ -20,9 +20,10 @@ whole pipeline is ONE compiled SPMD program:
   small next to the blocks). The schedule is deliberately branch-free —
   collectives near device-varying ``lax.cond`` deadlock — so every stage
   embeds each tick (a cheap gather) and selects against the hopped-in
-  activation; last-stage outputs accumulate into a per-microbatch buffer
-  and the LM-head/loss runs once after the loop, scanned one microbatch
-  at a time, masked to the last stage by the final psum.
+  activation; stage outputs stream out as scan ys (the last stage's
+  microbatch m is the static slice at tick m + S − 1) and the
+  LM-head/loss runs once after the loop, scanned one microbatch at a
+  time, masked to the last stage by the final psum.
 
 The reference has no pipeline concept — its "scale the big thing" analog
 is gang-scheduled MPI worlds (SURVEY §5.7); this is the mesh-axis
@@ -34,11 +35,8 @@ Schedule math: ``n_ticks(S, M) = M + S − 1``; bubble fraction
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Optional
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -278,6 +276,8 @@ def make_pp_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
     microbatched internally."""
     from faabric_tpu.models.train import make_optimizer
 
+    import optax
+
     optimizer = optimizer or make_optimizer()
     loss_fn = make_pp_loss(cfg, mesh)
 
@@ -286,8 +286,6 @@ def make_pp_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
         tgt_mb = microbatch(targets, n_microbatches)
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, tok_mb, tgt_mb))(pp_params)
-        import optax
-
         updates, opt_state = optimizer.update(grads, opt_state, pp_params)
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss

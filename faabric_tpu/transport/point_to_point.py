@@ -19,6 +19,7 @@ builds its ``jax.sharding`` mesh accordingly.
 
 from __future__ import annotations
 
+import collections
 import struct
 import threading
 import time
@@ -239,8 +240,6 @@ class PointToPointBroker:
         non-blocking and nothing is pending. Duplicates of
         already-delivered seqs (bulk-plane reconnect resends) are
         dropped. Shared by ordered recv, probe and iprobe."""
-        import collections
-
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             buf = self._ooo.setdefault(key, {})
@@ -374,15 +373,11 @@ class PointToPointBroker:
     BULK_RETRY_SECONDS = 30.0
 
     def _bulk_down(self, host: str) -> bool:
-        import time
-
         with self._lock:
             until = self._bulk_down_until.get(host, 0.0)
         return time.monotonic() < until
 
     def _mark_bulk_down(self, host: str) -> None:
-        import time
-
         with self._lock:
             self._bulk_down_until[host] = (time.monotonic()
                                            + self.BULK_RETRY_SECONDS)
