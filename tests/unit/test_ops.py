@@ -410,3 +410,26 @@ def test_merge_attention_blocks():
                                atol=2e-5)
     np.testing.assert_allclose(np.asarray(merged_lse),
                                np.asarray(full_lse), atol=2e-4)
+
+
+def test_flash_attention_bf16_forward_and_gradients():
+    """bf16 inputs (the TPU compute dtype): kernel forward and two-pass
+    backward stay within bf16 tolerances of the reference."""
+    rng = np.random.RandomState(23)
+    q, k, v = (jnp.asarray(rng.randn(1, 256, 2, 16), jnp.bfloat16)
+               for _ in range(3))
+    out = flash_attention(q, k, v)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        _reference_attention(q, k, v).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=0.5, rtol=0.1)

@@ -168,3 +168,21 @@ def test_pipeline_rejects_bad_configs():
     mesh_sp = build_mesh(jax.devices()[:8], MeshConfig(dp=2, sp=2, pp=2))
     with pytest.raises(ValueError, match="sp/ep"):
         make_pp_loss(CFG, mesh_sp)
+
+
+def test_pipeline_deep_config_pp4_tp2():
+    """8 layers over pp=4 stages with tp=2 (dp=1): the deepest topology
+    an 8-device mesh carries; loss matches dense."""
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=8, n_heads=4,
+                      d_ff=64, max_seq=32, compute_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    tokens, targets = data(batch=4, seed=9)
+    ref = float(loss_fn(params, tokens, targets, cfg))
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=1, tp=2, pp=4))
+    pp_params = jax.device_put(stack_block_params(params),
+                               pp_param_shardings(mesh, cfg))
+    tok = jax.device_put(microbatch(tokens, 4), pp_data_sharding(mesh))
+    tgt = jax.device_put(microbatch(targets, 4), pp_data_sharding(mesh))
+    loss = float(jax.jit(make_pp_loss(cfg, mesh))(pp_params, tok, tgt))
+    assert abs(loss - ref) < 1e-5
