@@ -208,6 +208,12 @@ class MpiWorld:
                 self._device_collectives = DeviceCollectives(devices)
             return self._device_collectives
 
+    def device_send_recv(self, x, src_rank: int, dst_rank: int):
+        """Device-plane p2p: rank ``src``'s shard lands on rank ``dst``'s
+        chip in one compiled ICI transfer (others zero) — the device twin
+        of the host send/recv below."""
+        return self.device_collectives().send_recv(x, src_rank, dst_rank)
+
     # ------------------------------------------------------------------
     # Point-to-point
     # ------------------------------------------------------------------

@@ -148,3 +148,27 @@ def test_device_p2p_send_recv_and_shift():
     out = col.to_per_rank(col.permute(x, [(0, 2), (3, 1)]))
     np.testing.assert_array_equal(out[2], np.zeros(8) + 0)
     np.testing.assert_array_equal(out[1], np.full(8, 3, np.float32))
+
+
+def test_world_device_send_recv():
+    """MpiWorld's device-plane p2p: rank shards move between the chips
+    the planner pinned, via the world's own device mesh."""
+    import numpy as np
+
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.mpi import MpiWorld
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+
+    broker = PointToPointBroker("devhost")
+    d = SchedulingDecision(app_id=8080, group_id=8080)
+    for r in range(4):
+        d.add_message("devhost", 100 + r, r, r, device_id=r)
+    broker.set_up_local_mappings_from_decision(d)
+    world = MpiWorld(broker, 8080, 4, 8080)
+
+    col = world.device_collectives()
+    x = col.shard_stacked([np.full(8, r + 1, np.float32) for r in range(4)])
+    out = col.to_per_rank(world.device_send_recv(x, 2, 0))
+    np.testing.assert_array_equal(out[0], np.full(8, 3, np.float32))
+    np.testing.assert_array_equal(out[2], np.zeros(8, np.float32))
+    broker.clear()
