@@ -701,14 +701,20 @@ def main() -> None:
                 return partial, err
             return None, err or "no results produced"
 
-        t_full = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
-        t_tiny = int(os.environ.get("BENCH_DEVICE_TIMEOUT_TINY", "600"))
+        # Worst-case staging must stay well under any plausible driver
+        # bench timeout (~30 min total incl. host benches); a SLOW but
+        # working TPU is still safe because the subprocess streams each
+        # completed section to the result file and a watchdog kill keeps
+        # whatever finished
+        t_full = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "600"))
+        t_tiny = int(os.environ.get("BENCH_DEVICE_TIMEOUT_TINY", "300"))
+        t_cpu = int(os.environ.get("BENCH_DEVICE_TIMEOUT_CPU", "700"))
         stages = [
             ("tpu_full", {}, t_full, quick),
             ("tpu_tiny", {}, t_tiny, True),
-            # Last resort gets the full timeout: full shapes on CPU are
-            # slow and this stage must never be the one that gets killed
-            ("cpu", {"JAX_PLATFORMS": "cpu"}, t_full, quick),
+            # Last resort gets its own generous budget: full shapes on
+            # CPU are slow and this stage must never be the one killed
+            ("cpu", {"JAX_PLATFORMS": "cpu"}, t_cpu, quick),
         ]
         device_errs = {}
         for name, env_extra, timeout_s, tiny in stages:
