@@ -708,7 +708,10 @@ def main() -> None:
         # whatever finished
         t_full = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "600"))
         t_tiny = int(os.environ.get("BENCH_DEVICE_TIMEOUT_TINY", "300"))
-        t_cpu = int(os.environ.get("BENCH_DEVICE_TIMEOUT_CPU", "700"))
+        # Raising BENCH_DEVICE_TIMEOUT keeps protecting the CPU last
+        # resort too
+        t_cpu = int(os.environ.get("BENCH_DEVICE_TIMEOUT_CPU",
+                                   str(max(700, t_full))))
         stages = [
             ("tpu_full", {}, t_full, quick),
             ("tpu_tiny", {}, t_tiny, True),

@@ -24,19 +24,74 @@ from faabric_tpu.models.transformer import (
 )
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
-    return optax.adamw(lr, weight_decay=weight_decay)
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
+                   warmup_steps: int = 0, total_steps: int | None = None,
+                   clip_norm: float | None = None):
+    """AdamW with optional warmup-cosine schedule and global-norm
+    gradient clipping — the standard large-model training recipe."""
+    if total_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=max(1, warmup_steps),
+            decay_steps=max(total_steps, warmup_steps + 1))
+    elif warmup_steps:
+        # No horizon given: warm up then HOLD at peak (never silently
+        # decay to zero on an invented horizon)
+        schedule = optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup_steps),
+             optax.constant_schedule(lr)], [warmup_steps])
+    else:
+        schedule = lr
+    tx = optax.adamw(schedule, weight_decay=weight_decay)
+    if clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+    return tx
 
 
 def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
-                    optimizer=None):
+                    optimizer=None, accum_steps: int = 1):
     """Returns jitted ``step(params, opt_state, tokens, targets) →
-    (params, opt_state, loss)``."""
+    (params, opt_state, loss)``. ``accum_steps > 1`` splits the batch
+    into that many microbatches and accumulates gradients with a
+    ``lax.scan`` before the single optimizer update — big effective
+    batches without the activation memory (means over equal microbatches
+    equal the full-batch gradient exactly)."""
     optimizer = optimizer or make_optimizer()
 
+    def grads_of(params, tokens, targets):
+        return jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                           cfg, mesh)
+
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
-                                                  cfg, mesh)
+        if accum_steps > 1:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps={accum_steps}")
+            tok = tokens.reshape(accum_steps, b // accum_steps,
+                                 *tokens.shape[1:])
+            tgt = targets.reshape(accum_steps, b // accum_steps,
+                                  *targets.shape[1:])
+            if mesh is not None:
+                # Each microbatch must stay dp-sharded (the contiguous
+                # reshape would otherwise park whole microbatches on a
+                # subset of dp shards, idling the rest of the mesh)
+                mb_sharding = NamedSharding(mesh, P(None, "dp", "sp"))
+                tok = jax.lax.with_sharding_constraint(tok, mb_sharding)
+                tgt = jax.lax.with_sharding_constraint(tgt, mb_sharding)
+
+            def acc(carry, mb):
+                loss_sum, g_sum = carry
+                loss, g = grads_of(params, mb[0], mb[1])
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, g_sum, g)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss_sum, g_sum), _ = jax.lax.scan(
+                acc, (jnp.zeros(()), zeros), (tok, tgt))
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, g_sum)
+        else:
+            loss, grads = grads_of(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
