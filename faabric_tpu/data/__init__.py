@@ -1,0 +1,5 @@
+"""Input pipeline: memmap token datasets + prefetching mesh loaders."""
+
+from faabric_tpu.data.loader import DataLoader, TokenDataset
+
+__all__ = ["DataLoader", "TokenDataset"]

@@ -1,0 +1,170 @@
+"""Input pipeline: token datasets and a prefetching loader for meshes.
+
+The runtime-side IO piece of the framework (the reference's native
+data-path analog — there it is C++ queues feeding executors; here the
+host loader feeds chips): a memmap-backed token store, deterministic
+shuffled windows, and a background thread that stages the NEXT batch
+onto the devices (dp-sharded) while the current step runs, so input IO
+overlaps compute instead of serializing with it.
+
+Usage::
+
+    ds = TokenDataset.from_file("corpus.bin", seq_len=2048)  # or from array
+    loader = DataLoader(ds, batch_size=32, mesh=mesh, seed=0)
+    for tokens, targets in loader:          # device-resident, dp-sharded
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class TokenDataset:
+    """Contiguous token ids carved into (seq_len + 1) windows: a window
+    yields (inputs = w[:-1], targets = w[1:])."""
+
+    def __init__(self, tokens: np.ndarray, seq_len: int) -> None:
+        if tokens.ndim != 1:
+            raise ValueError("TokenDataset wants a flat token id array")
+        self.tokens = tokens
+        self.seq_len = int(seq_len)
+        self.n_windows = (tokens.size - 1) // self.seq_len
+        if self.n_windows <= 0:
+            raise ValueError(
+                f"{tokens.size} tokens cannot fill a {seq_len}-token window")
+
+    @classmethod
+    def from_file(cls, path: str, seq_len: int,
+                  dtype=np.int32) -> "TokenDataset":
+        """Zero-copy memmap over a flat binary token file — corpora far
+        larger than RAM stream through the page cache."""
+        return cls(np.memmap(path, dtype=dtype, mode="r"), seq_len)
+
+    def window(self, idx: int) -> tuple[np.ndarray, np.ndarray]:
+        lo = idx * self.seq_len
+        w = np.asarray(self.tokens[lo:lo + self.seq_len + 1])
+        return w[:-1], w[1:]
+
+    def __len__(self) -> int:
+        return self.n_windows
+
+
+class DataLoader:
+    """Batches of shuffled windows, staged onto the mesh one batch ahead.
+
+    Deterministic per (seed, epoch): every rank/process computes the same
+    permutation, so multi-host data parallelism can slice the same order
+    by dp coordinate without coordination traffic.
+    """
+
+    def __init__(self, dataset: TokenDataset, batch_size: int,
+                 mesh=None, seed: int = 0, drop_last: bool = True,
+                 prefetch: int = 2) -> None:
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.mesh = mesh
+        self.seed = seed
+        self.drop_last = drop_last
+        self.prefetch = max(1, int(prefetch))
+        if drop_last and len(dataset) < batch_size:
+            raise ValueError(
+                f"{len(dataset)} windows < batch_size {batch_size}")
+        if mesh is not None:
+            dp = mesh.shape.get("dp", 1)
+            if batch_size % dp:
+                raise ValueError(
+                    f"batch_size {batch_size} not divisible by dp={dp}")
+            if not drop_last:
+                raise ValueError(
+                    "drop_last=False cannot shard a partial final batch "
+                    "over the mesh; use drop_last=True")
+        self._epoch = 0
+
+    # -- assembly -------------------------------------------------------
+    def _batch_indices(self, epoch: int):
+        rng = np.random.RandomState((self.seed * 1_000_003 + epoch)
+                                    & 0x7FFFFFFF)
+        order = rng.permutation(len(self.dataset))
+        stop = (len(order) - len(order) % self.batch_size
+                if self.drop_last else len(order))
+        for lo in range(0, stop, self.batch_size):
+            yield order[lo:lo + self.batch_size]
+
+    def _assemble(self, idxs: np.ndarray):
+        xs = np.empty((len(idxs), self.dataset.seq_len), np.int32)
+        ys = np.empty_like(xs)
+        for i, w in enumerate(idxs):
+            x, y = self.dataset.window(int(w))
+            xs[i], ys[i] = x, y
+        if self.mesh is None:
+            return xs, ys
+        import jax
+
+        from faabric_tpu.models.train import data_sharding
+
+        sharding = data_sharding(self.mesh)
+        return (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        """One epoch, prefetched: a daemon worker assembles + device_puts
+        the next batches while the caller consumes the current one."""
+        epoch, self._epoch = self._epoch, self._epoch + 1
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        _END = object()
+
+        def put(item) -> bool:
+            # Bounded put that gives up when the consumer abandoned the
+            # epoch (break/exception) — otherwise the thread would park
+            # in q.put forever, pinning staged device batches
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for idxs in self._batch_indices(epoch):
+                    if stop.is_set() or not put(self._assemble(idxs)):
+                        return
+            except Exception as e:  # noqa: BLE001 — surfaced to consumer
+                put(e)
+            finally:
+                put(_END)
+
+        t = threading.Thread(target=producer, name="dataloader-prefetch",
+                             daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else \
+            -(-n // self.batch_size)

@@ -1,0 +1,40 @@
+"""Evaluation: token-level NLL / perplexity over a batch stream."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from faabric_tpu.models.transformer import ModelConfig, forward, token_nll
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _eval_step(params, tokens, targets, cfg: ModelConfig, mesh):
+    nll = token_nll(forward(params, tokens, cfg, mesh), targets)
+    return jnp.sum(nll), nll.size
+
+
+def evaluate_perplexity(params, cfg: ModelConfig,
+                        batches: Iterable, mesh=None,
+                        max_batches: Optional[int] = None) -> dict:
+    """Mean token NLL and perplexity over (tokens, targets) batches
+    (e.g. a :class:`faabric_tpu.data.DataLoader`)."""
+    import itertools
+
+    total_nll = 0.0
+    total_tokens = 0
+    if max_batches is not None:
+        batches = itertools.islice(iter(batches), max_batches)
+    for tokens, targets in batches:
+        nll_sum, count = _eval_step(params, tokens, targets, cfg, mesh)
+        total_nll += float(nll_sum)
+        total_tokens += int(count)
+    if total_tokens == 0:
+        raise ValueError("evaluate_perplexity got no batches")
+    mean_nll = total_nll / total_tokens
+    return {"nll": mean_nll, "perplexity": float(np.exp(mean_nll)),
+            "tokens": total_tokens}

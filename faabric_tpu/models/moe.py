@@ -200,10 +200,10 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
 
 def moe_loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
                 cfg: MoEConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    from faabric_tpu.models.transformer import token_nll
+
     logits, aux = moe_forward(params, tokens, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.aux_loss_weight * aux
+    return jnp.mean(token_nll(logits, targets)) + cfg.aux_loss_weight * aux
 
 
 def make_moe_train_step(cfg: MoEConfig, mesh: Optional[Mesh] = None,

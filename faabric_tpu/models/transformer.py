@@ -262,9 +262,13 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
     return maybe_constrain(logits.astype(jnp.float32), "dp", "sp", None)
 
 
+def token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-token negative log-likelihood — THE loss definition, shared by
+    training (dense + MoE) and evaluation so they can never diverge."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
 def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
             cfg: ModelConfig, mesh: Optional[Mesh] = None) -> jax.Array:
-    logits = forward(params, tokens, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(token_nll(forward(params, tokens, cfg, mesh), targets))

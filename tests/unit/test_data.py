@@ -1,0 +1,123 @@
+"""Input pipeline: memmap token datasets + prefetching mesh loaders."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from faabric_tpu.data import DataLoader, TokenDataset
+from faabric_tpu.parallel import MeshConfig, build_mesh
+
+
+def make_ds(n_tokens=1000, seq=16):
+    return TokenDataset(np.arange(n_tokens, dtype=np.int32), seq)
+
+
+def test_windows_are_shifted_pairs():
+    ds = make_ds()
+    x, y = ds.window(3)
+    np.testing.assert_array_equal(y, x + 1)  # arange: targets = inputs + 1
+    assert x.size == 16
+    assert len(ds) == (1000 - 1) // 16
+
+
+def test_loader_deterministic_and_epoch_varies():
+    ds = make_ds()
+    a = [x[0, 0] for x, _ in DataLoader(ds, 8, seed=5)]
+    b = [x[0, 0] for x, _ in DataLoader(ds, 8, seed=5)]
+    assert [int(v) for v in a] == [int(v) for v in b]
+
+    ld = DataLoader(ds, 8, seed=5)
+    e0 = [int(x[0, 0]) for x, _ in ld]
+    e1 = [int(x[0, 0]) for x, _ in ld]  # second epoch reshuffles
+    assert e0 != e1
+
+    # Every window appears exactly once per epoch (drop_last may trim)
+    seen = []
+    for x, _ in DataLoader(ds, 8, seed=1):
+        seen.extend((np.asarray(x[:, 0]) // 16).tolist())
+    assert len(seen) == len(set(seen))
+
+
+def test_loader_shards_over_dp_and_trains():
+    from faabric_tpu.models import (
+        ModelConfig,
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    import jax.numpy as jnp
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=4, tp=2))
+    ds = make_ds(n_tokens=2000, seq=16)
+    loader = DataLoader(ds, batch_size=8, mesh=mesh, seed=0)
+
+    cfg = ModelConfig(vocab_size=2048, d_model=32, n_layers=1, n_heads=4,
+                      d_ff=64, max_seq=16, compute_dtype=jnp.float32)
+    opt = make_optimizer()
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                         opt)
+    step = make_train_step(cfg, mesh, opt)
+
+    n = 0
+    for tokens, targets in loader:
+        assert tokens.sharding.spec[0] == "dp"  # batch sharded over dp
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        n += 1
+        if n == 3:
+            break
+    assert np.isfinite(float(loss))
+
+
+def test_loader_propagates_producer_errors():
+    class Bad(TokenDataset):
+        def window(self, idx):
+            raise RuntimeError("boom")
+
+    ds = Bad(np.arange(100, dtype=np.int32), 8)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(DataLoader(ds, 4))
+
+
+def test_from_file_memmap(tmp_path):
+    path = tmp_path / "corpus.bin"
+    np.arange(500, dtype=np.int32).tofile(path)
+    ds = TokenDataset.from_file(str(path), seq_len=32)
+    x, y = ds.window(1)
+    assert int(x[0]) == 32 and int(y[-1]) == 64
+
+
+def test_evaluate_perplexity_improves_with_training():
+    """End-to-end: loader -> train steps -> eval; perplexity drops and a
+    random-init model starts near uniform (ppl ~ vocab)."""
+    import jax.numpy as jnp
+
+    from faabric_tpu.models import (
+        ModelConfig,
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from faabric_tpu.models.evaluate import evaluate_perplexity
+
+    mesh = build_mesh(jax.devices()[:4], MeshConfig(dp=4))
+    ds = make_ds(n_tokens=4000, seq=16)
+    cfg = ModelConfig(vocab_size=4096, d_model=32, n_layers=1, n_heads=4,
+                      d_ff=64, max_seq=16, compute_dtype=jnp.float32)
+    opt = make_optimizer(lr=3e-3)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                         opt)
+    before = evaluate_perplexity(
+        params, cfg, DataLoader(ds, 8, mesh=mesh, seed=2), mesh,
+        max_batches=4)
+    assert 1000 < before["perplexity"] < 20000  # near-uniform at init
+
+    step = make_train_step(cfg, mesh, opt)
+    for tokens, targets in DataLoader(ds, 8, mesh=mesh, seed=3):
+        params, opt_state, _ = step(params, opt_state, tokens, targets)
+    after = evaluate_perplexity(
+        params, cfg, DataLoader(ds, 8, mesh=mesh, seed=2), mesh,
+        max_batches=4)
+    assert after["perplexity"] < before["perplexity"]
+    assert after["tokens"] == before["tokens"] > 0
