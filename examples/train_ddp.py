@@ -23,7 +23,6 @@ import numpy as np
 
 from faabric_tpu.models import (
     ModelConfig,
-    data_sharding,
     init_train_state,
     make_optimizer,
     make_train_step,
@@ -45,15 +44,22 @@ def main() -> None:
                                          opt)
     step = make_train_step(cfg, mesh, opt)
 
-    rng = np.random.RandomState(0)
-    batch = max(4, 2 * mesh.shape["dp"])
-    tokens = jax.device_put(
-        rng.randint(0, cfg.vocab_size, (batch, 64), dtype=np.int32),
-        data_sharding(mesh))
+    # Input pipeline: deterministic shuffled windows, prefetched onto the
+    # mesh one batch ahead (swap the array for TokenDataset.from_file to
+    # stream a memmap'd corpus)
+    from faabric_tpu.data import DataLoader, TokenDataset
 
-    for i in range(5):
-        params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    rng = np.random.RandomState(0)
+    corpus = rng.randint(0, cfg.vocab_size, 50_000, dtype=np.int32)
+    dp = mesh.shape["dp"]
+    loader = DataLoader(TokenDataset(corpus, seq_len=64),
+                        batch_size=dp * 4, mesh=mesh, seed=0)
+
+    for i, (tokens, targets) in enumerate(loader):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
         print(f"step {i}: loss {float(loss):.4f}")
+        if i == 4:
+            break
 
 
 if __name__ == "__main__":
