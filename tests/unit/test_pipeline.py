@@ -186,3 +186,38 @@ def test_pipeline_deep_config_pp4_tp2():
     tgt = jax.device_put(microbatch(targets, 4), pp_data_sharding(mesh))
     loss = float(jax.jit(make_pp_loss(cfg, mesh))(pp_params, tok, tgt))
     assert abs(loss - ref) < 1e-5
+
+
+def test_pipeline_checkpoint_interop(tmp_path):
+    """pp params round-trip through the standard checkpoint path via
+    unstack/stack — one checkpoint format serves both layouts."""
+    from faabric_tpu.models import make_optimizer
+    from faabric_tpu.models.checkpoint import (
+        restore_train_state,
+        save_train_state,
+    )
+
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=4, pp=2))
+    opt = make_optimizer()
+    pp_params, pp_opt = init_pp_train_state(jax.random.PRNGKey(6), CFG,
+                                            mesh, opt)
+    step = make_pp_train_step(CFG, mesh, opt, n_microbatches=4)
+    tokens, targets = data(seed=7)
+    pp_params, pp_opt, loss0 = step(pp_params, pp_opt, tokens, targets)
+
+    # Save in the DENSE layout (the interchange format)
+    dense = unstack_block_params(jax.device_get(pp_params))
+    save_train_state(str(tmp_path / "ck"), dense, None, step=1)
+    r_dense, _, st = restore_train_state(str(tmp_path / "ck"))
+    assert st == 1
+
+    restored = jax.device_put(stack_block_params(r_dense),
+                              pp_param_shardings(mesh, CFG))
+    # Same params → same next loss on the same data
+    opt2 = make_optimizer()
+    step2 = make_pp_train_step(CFG, mesh, opt2, n_microbatches=4)
+    _, _, loss_a = step(pp_params, pp_opt, tokens, targets)
+    _, _, loss_b = step2(restored, opt2.init(restored), tokens, targets)
+    # Optimizer states differ (fresh vs stepped), but the LOSS is a pure
+    # function of params+data and must match
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
