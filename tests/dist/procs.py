@@ -72,6 +72,30 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:{int(out[0])}".encode()
         return int(ReturnValue.SUCCESS)
 
+    def fn_mpi_big(self, msg, req):
+        """12 MiB-per-rank allreduce: exercises the chunk-pipelined
+        leader trees + bulk data plane inside a planner-scheduled world
+        across real worker processes."""
+        from faabric_tpu.mpi import MpiOp, get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7500
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        n = (12 << 20) // 4
+        out = world.allreduce(rank, np.full(n, rank + 1, np.int32),
+                              MpiOp.SUM)
+        world.barrier(rank)
+        ok = bool((out == 36).all())  # sum of 1..8, EVERY chunk
+        msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
+        return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
+
     def fn_mpi_status(self, msg, req):
         """Port of the reference example mpi_status
         (tests/dist/mpi/examples/mpi_status.cpp): rank 0 sends 40 ints;

@@ -69,6 +69,17 @@ def dist_cluster():
     clear_host_aliases()
 
 
+def wait_batch_finished(me, app_id, timeout=20.0):
+    """Poll the planner until every message of the app reported a result."""
+    deadline = time.time() + timeout
+    status = me.planner_client.get_batch_results(app_id)
+    while not status.finished and time.time() < deadline:
+        time.sleep(0.2)
+        status = me.planner_client.get_batch_results(app_id)
+    assert status.finished, f"batch {app_id} never finished"
+    return status
+
+
 def test_dist_function_batch(dist_cluster):
     me = dist_cluster
     req = batch_exec_factory("dist", "square", 8)
@@ -93,15 +104,30 @@ def test_dist_mpi_allreduce(dist_cluster):
     assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
     assert r.output_data == b"r0:28"  # sum of ranks 0..7
 
-    deadline = time.time() + 20
-    while time.time() < deadline:
-        status = me.planner_client.get_batch_results(req.app_id)
-        if status.finished:
-            break
-        time.sleep(0.2)
-    assert status.finished and status.expected_num_messages == 8
+    status = wait_batch_finished(me, req.app_id, timeout=20)
+    assert status.expected_num_messages == 8
     hosts = {m.executed_host for m in status.message_results}
     assert hosts == {"w1", "w2"}
+
+
+def test_dist_mpi_chunked_bulk_allreduce(dist_cluster):
+    """12 MiB per rank across 2 worker processes: the chunk-pipelined
+    collectives + bulk data plane inside the full planner-scheduled
+    stack."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", "mpi_big", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=60.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+    assert r.output_data == b"r0:ok"
+
+    status = wait_batch_finished(me, req.app_id, timeout=30)
+    assert status.expected_num_messages == 8
+    for m in status.message_results:
+        assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+    assert {m.executed_host for m in status.message_results} == {"w1", "w2"}
 
 
 def test_dist_mpi_status_example(dist_cluster):
@@ -115,14 +141,8 @@ def test_dist_mpi_status_example(dist_cluster):
                                              timeout=40.0)
     assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
 
-    deadline = time.time() + 20
-    status = None
-    while time.time() < deadline:
-        status = me.planner_client.get_batch_results(req.app_id)
-        if status.finished:
-            break
-        time.sleep(0.2)
-    assert status.finished and status.expected_num_messages == 8
+    status = wait_batch_finished(me, req.app_id, timeout=20)
+    assert status.expected_num_messages == 8
     outs = {m.mpi_rank: m.output_data for m in status.message_results}
     assert outs[1] == b"got:40"
     assert all(m.return_value == int(ReturnValue.SUCCESS)
@@ -140,14 +160,8 @@ def test_dist_mpi_isendrecv_example(dist_cluster):
                                              timeout=40.0)
     assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
 
-    deadline = time.time() + 20
-    status = None
-    while time.time() < deadline:
-        status = me.planner_client.get_batch_results(req.app_id)
-        if status.finished:
-            break
-        time.sleep(0.2)
-    assert status.finished and status.expected_num_messages == 8
+    status = wait_batch_finished(me, req.app_id, timeout=20)
+    assert status.expected_num_messages == 8
     for m in status.message_results:
         assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
         assert m.output_data.endswith(b"async-ok")
@@ -221,13 +235,8 @@ def test_dist_data_parallel_training(dist_cluster):
                                               timeout=60.0)
     assert r0.return_value == int(ReturnValue.SUCCESS), r0.output_data
 
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        status = me.planner_client.get_batch_results(req.app_id)
-        if status.finished:
-            break
-        time.sleep(0.2)
-    assert status.finished and status.expected_num_messages == 6
+    status = wait_batch_finished(me, req.app_id, timeout=30)
+    assert status.expected_num_messages == 6
     checksums = {m.output_data.split(b":")[1] for m in status.message_results}
     assert len(checksums) == 1, status.message_results  # ranks in sync
     hosts = {m.executed_host for m in status.message_results}
