@@ -23,7 +23,26 @@ from faabric_tpu.mpi.types import MpiOp, MpiStatus
 from faabric_tpu.mpi.world import MpiWorld
 
 MPI_COMM_WORLD = "MPI_COMM_WORLD"
+MPI_COMM_NULL = None
+MPI_UNDEFINED = -1
 MPI_SUCCESS = 0
+
+
+class MpiComm:
+    """A communicator handle: a (sub)world plus this thread's rank in it.
+    ``MPI_COMM_WORLD`` (the string sentinel) resolves to the thread's
+    bound world; handles from mpi_comm_split/dup/create pass as the
+    ``comm`` argument of every call here."""
+
+    __slots__ = ("world", "rank")
+
+    def __init__(self, world: MpiWorld, rank: int) -> None:
+        self.world = world
+        self.rank = rank
+
+    @property
+    def size(self) -> int:
+        return self.world.size
 
 # Re-exported op constants (reference faabric_op_t singletons)
 MPI_MAX = MpiOp.MAX
@@ -50,7 +69,13 @@ def _bind(world: MpiWorld, rank: int) -> None:
     _tls.start_time = time.monotonic()
 
 
-def _current() -> tuple[MpiWorld, int]:
+def _current(comm=MPI_COMM_WORLD) -> tuple[MpiWorld, int]:
+    if isinstance(comm, MpiComm):
+        return comm.world, comm.rank
+    if comm is MPI_COMM_NULL:
+        raise MpiError("Communication on MPI_COMM_NULL")
+    if comm != MPI_COMM_WORLD:
+        raise MpiError(f"Not a communicator: {comm!r}")
     world = getattr(_tls, "world", None)
     if world is None:
         raise MpiError("MPI not initialised on this thread (call mpi_init)")
@@ -98,11 +123,11 @@ def mpi_abort(comm=MPI_COMM_WORLD, errorcode: int = 1) -> None:
 # ---------------------------------------------------------------------------
 
 def mpi_comm_rank(comm=MPI_COMM_WORLD) -> int:
-    return _current()[1]
+    return _current(comm)[1]
 
 
 def mpi_comm_size(comm=MPI_COMM_WORLD) -> int:
-    return _current()[0].size
+    return _current(comm)[0].size
 
 
 def mpi_wtime() -> float:
@@ -119,56 +144,58 @@ def mpi_get_processor_name() -> str:
 # ---------------------------------------------------------------------------
 
 def mpi_send(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
-    world, rank = _current()
+    world, rank = _current(comm)
     world.send(rank, dest, np.asarray(buf))
     return MPI_SUCCESS
 
 
 def mpi_recv(source: int, comm=MPI_COMM_WORLD
              ) -> tuple[np.ndarray, MpiStatus]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.recv(source, rank)
 
 
 def mpi_sendrecv(sendbuf, dest: int, source: int, comm=MPI_COMM_WORLD
                  ) -> tuple[np.ndarray, MpiStatus]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.sendrecv(np.asarray(sendbuf), rank, dest, source, rank)
 
 
 def mpi_isend(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.isend(rank, dest, np.asarray(buf))
 
 
 def mpi_irecv(source: int, comm=MPI_COMM_WORLD) -> int:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.irecv(source, rank)
 
 
-def mpi_wait(request: int) -> Optional[tuple[np.ndarray, MpiStatus]]:
-    world, rank = _current()
+def mpi_wait(request: int, comm=MPI_COMM_WORLD
+             ) -> Optional[tuple[np.ndarray, MpiStatus]]:
+    world, rank = _current(comm)
     return world.await_async(rank, request)
 
 
-def mpi_waitall(requests: list[int]
+def mpi_waitall(requests: list[int], comm=MPI_COMM_WORLD
                 ) -> list[Optional[tuple[np.ndarray, MpiStatus]]]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.waitall(rank, requests)
 
 
-def mpi_waitany(requests: list[int]
+def mpi_waitany(requests: list[int], comm=MPI_COMM_WORLD
                 ) -> tuple[int, Optional[tuple[np.ndarray, MpiStatus]]]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.waitany(rank, requests)
 
 
-def mpi_test(request: int) -> tuple[bool, Optional[tuple]]:
+def mpi_test(request: int, comm=MPI_COMM_WORLD
+             ) -> tuple[bool, Optional[tuple]]:
     """MPI_Test: (flag, result). flag False → request still pending (the
     request stays live); True → completed, result as mpi_wait. Testing a
     handle that already completed is legal (MPI_REQUEST_NULL semantics)
     and reports (True, None)."""
-    world, rank = _current()
+    world, rank = _current(comm)
     try:
         if not world.request_ready(rank, request):
             return False, None
@@ -189,18 +216,18 @@ def mpi_type_size(dtype) -> int:
 
 def mpi_reduce_scatter(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD
                        ) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.reduce_scatter(rank, np.asarray(sendbuf), op)
 
 
 def mpi_probe(source: int, comm=MPI_COMM_WORLD) -> MpiStatus:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.probe(source, rank)
 
 
 def mpi_iprobe(source: int, comm=MPI_COMM_WORLD) -> Optional[MpiStatus]:
     """Non-blocking: pending-message status or None (flag=false)."""
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.iprobe(source, rank)
 
 
@@ -214,20 +241,20 @@ def mpi_get_count(status: MpiStatus) -> int:
 # ---------------------------------------------------------------------------
 
 def mpi_barrier(comm=MPI_COMM_WORLD) -> int:
-    world, rank = _current()
+    world, rank = _current(comm)
     world.barrier(rank)
     return MPI_SUCCESS
 
 
 def mpi_bcast(buf, root: int, comm=MPI_COMM_WORLD) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.broadcast(root, rank,
                            np.asarray(buf) if buf is not None else np.empty(0))
 
 
 def mpi_scatter(sendbuf, recv_count: int, root: int,
                 comm=MPI_COMM_WORLD) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.scatter(root, rank,
                          np.asarray(sendbuf) if sendbuf is not None
                          else np.empty(0), recv_count)
@@ -235,20 +262,20 @@ def mpi_scatter(sendbuf, recv_count: int, root: int,
 
 def mpi_gather(sendbuf, root: int, comm=MPI_COMM_WORLD
                ) -> Optional[np.ndarray]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.gather(rank, root, np.asarray(sendbuf))
 
 
 def mpi_gatherv(sendbuf, root: int, comm=MPI_COMM_WORLD
                 ) -> Optional[tuple[np.ndarray, list[int]]]:
     """Root returns (concatenated values in rank order, per-rank counts)."""
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.gatherv(rank, root, np.asarray(sendbuf))
 
 
 def mpi_scatterv(sendbuf, counts, root: int, comm=MPI_COMM_WORLD
                  ) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.scatterv(root, rank,
                           np.asarray(sendbuf) if sendbuf is not None
                           else None, counts)
@@ -256,33 +283,33 @@ def mpi_scatterv(sendbuf, counts, root: int, comm=MPI_COMM_WORLD
 
 def mpi_alltoallv(sendbuf, send_counts, comm=MPI_COMM_WORLD
                   ) -> tuple[np.ndarray, list[int]]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.alltoallv(rank, np.asarray(sendbuf), list(send_counts))
 
 
 def mpi_allgather(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.allgather(rank, np.asarray(sendbuf))
 
 
 def mpi_reduce(sendbuf, op: MpiOp, root: int, comm=MPI_COMM_WORLD
                ) -> Optional[np.ndarray]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.reduce(rank, root, np.asarray(sendbuf), op)
 
 
 def mpi_allreduce(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.allreduce(rank, np.asarray(sendbuf), op)
 
 
 def mpi_scan(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.scan(rank, np.asarray(sendbuf), op)
 
 
 def mpi_alltoall(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.alltoall(rank, np.asarray(sendbuf))
 
 
@@ -293,22 +320,78 @@ def mpi_alltoall(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
 def mpi_cart_create(dims=None, comm=MPI_COMM_WORLD) -> tuple[int, ...]:
     """MPI_Cart_create with user dims (all-periodic); None keeps the
     default near-square 2-D factorisation."""
-    world, _ = _current()
+    world, _ = _current(comm)
     return world.cart_create(dims)
 
 
 def mpi_cart_get(comm=MPI_COMM_WORLD) -> tuple[tuple[int, ...],
                                                tuple[int, ...]]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.cart_dims(), world.cart_coords(rank)
 
 
 def mpi_cart_rank(coords: tuple[int, int], comm=MPI_COMM_WORLD) -> int:
-    world, _ = _current()
+    world, _ = _current(comm)
     return world.cart_rank(coords)
 
 
 def mpi_cart_shift(direction: int, disp: int, comm=MPI_COMM_WORLD
                    ) -> tuple[int, int]:
-    world, rank = _current()
+    world, rank = _current(comm)
     return world.cart_shift(rank, direction, disp)
+
+
+# ---------------------------------------------------------------------------
+# Communicator / group management (reference mpi.h MPI_Comm_split_type,
+# MPI_Comm_dup, MPI_Comm_group/Group_incl/Comm_create_group, MPI_Comm_free)
+# ---------------------------------------------------------------------------
+
+def mpi_comm_split(color: int, key: int = 0,
+                   comm=MPI_COMM_WORLD) -> Optional[MpiComm]:
+    """Collective: ranks sharing ``color`` form a new communicator,
+    ordered by (key, rank). ``MPI_UNDEFINED`` color → MPI_COMM_NULL."""
+    world, rank = _current(comm)
+    sub, new_rank = world.split(rank, color, key)
+    if sub is None:
+        return MPI_COMM_NULL
+    return MpiComm(sub, new_rank)
+
+
+def mpi_comm_dup(comm=MPI_COMM_WORLD) -> MpiComm:
+    """Collective: same membership, isolated communication context."""
+    world, rank = _current(comm)
+    sub, new_rank = world.dup(rank)
+    return MpiComm(sub, new_rank)
+
+
+def mpi_comm_group(comm=MPI_COMM_WORLD) -> list[int]:
+    """MPI_Comm_group: the group is simply the rank list (local op)."""
+    world, _ = _current(comm)
+    return list(range(world.size))
+
+
+def mpi_group_incl(group: list[int], ranks: list[int]) -> list[int]:
+    """MPI_Group_incl (local op)."""
+    return [group[r] for r in ranks]
+
+
+def mpi_comm_create_group(group: list[int], tag: int = 0,
+                          comm=MPI_COMM_WORLD) -> Optional[MpiComm]:
+    """Collective over ``group``'s members only (MPI_Comm_create_group)."""
+    world, rank = _current(comm)
+    sub, new_rank = world.create_group_comm(rank, list(group), tag)
+    if sub is None:
+        return MPI_COMM_NULL
+    return MpiComm(sub, new_rank)
+
+
+def mpi_comm_free(comm: MpiComm) -> int:
+    """MPI_Comm_free — collective: barriers the sub-communicator so all
+    in-flight traffic lands, then stops its send workers. The (tiny)
+    per-host queue/mapping stubs stay until the app's groups clear at
+    batch teardown: clearing them here would race co-located ranks still
+    draining their last messages."""
+    if isinstance(comm, MpiComm):
+        comm.world.barrier(comm.rank)
+        comm.world.close()
+    return MPI_SUCCESS

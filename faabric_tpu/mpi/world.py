@@ -148,6 +148,7 @@ class MpiWorld:
         self._device_collectives = None
         self._send_workers: dict[int, _SendWorker] = {}
         self._in_send_pool = threading.local()
+        self._split_seq = 0  # split-generation draws (see _split_draw)
 
     # ------------------------------------------------------------------
     # Topology
@@ -970,6 +971,96 @@ class MpiWorld:
         src_coords[dim] -= disp
         dst_coords[dim] += disp
         return self.cart_rank(src_coords), self.cart_rank(dst_coords)
+
+    # ------------------------------------------------------------------
+    # Sub-communicators (reference mpi.h MPI_Comm_split_type /
+    # MPI_Comm_create / MPI_Comm_dup / MPI_Group_incl)
+    # ------------------------------------------------------------------
+    # Split-generation draws: ranks co-located on a host SHARE this world
+    # object, so a plain per-world counter would hand concurrent callers
+    # different values. Each rank draws a locally-unique number and the
+    # split's allgather agrees on max(draws) — monotonic per collective
+    # call and identical on every rank
+    def _split_draw(self) -> int:
+        with self._lock:
+            self._split_seq += 1
+            return self._split_seq
+
+    @staticmethod
+    def _derive_group_id(parent: int, seq: int, color: int) -> int:
+        # Stable arithmetic (NOT Python hash(): randomized per process);
+        # folded into a distinct high range so derived ids can't collide
+        # with planner-generated GIDs
+        mixed = (parent * 1_000_003 + seq * 8191 + (color + 7)) \
+            & ((1 << 62) - 1)
+        return (1 << 126) | mixed
+
+    def make_subworld(self, member_ranks: list[int], sub_group_id: int
+                      ) -> "MpiWorld":
+        """A real MpiWorld whose rank i is parent rank member_ranks[i]:
+        every member host derives the SAME mappings from the parent's, so
+        no planner round-trip is needed. All existing point-to-point and
+        collective machinery works unchanged on the result."""
+        from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+
+        self.broker.wait_for_mappings(self.group_id)
+        d = SchedulingDecision(app_id=sub_group_id, group_id=sub_group_id)
+        for new_idx, parent_rank in enumerate(member_ranks):
+            host = self.broker.get_host_for_receiver(self.group_id,
+                                                     parent_rank)
+            port = self.broker.get_mpi_port_for_receiver(self.group_id,
+                                                         parent_rank)
+            dev = self.broker.get_device_for_idx(self.group_id, parent_rank)
+            d.add_message(host, sub_group_id + new_idx + 1, new_idx,
+                          new_idx, mpi_port=port, device_id=dev)
+        # Installed by every local member; idempotent per host
+        self.broker.set_up_local_mappings_from_decision(d)
+        sub = MpiWorld(self.broker, sub_group_id, len(member_ranks),
+                       sub_group_id, user=self.user, function=self.function)
+        sub.record_exec_graph = self.record_exec_graph
+        return sub
+
+    def split(self, rank: int, color: int, key: int = 0
+              ) -> tuple[Optional["MpiWorld"], int]:
+        """MPI_Comm_split: ranks with the same ``color`` form a subworld,
+        ordered by (key, parent rank). color < 0 (MPI_UNDEFINED) opts
+        out → (None, -1). Collective over the PARENT world."""
+        triple = np.array([color, key, rank, self._split_draw()],
+                          dtype=np.int64)
+        gathered = self.allgather(rank, triple).reshape(self.size, 4)
+        seq = int(gathered[:, 3].max())
+        if color < 0:
+            return None, -1
+        members = sorted((int(k), int(r)) for c, k, r, _ in gathered
+                         if int(c) == color)
+        member_ranks = [r for _, r in members]
+        sub_group_id = self._derive_group_id(self.group_id, seq, color)
+        sub = self.make_subworld(member_ranks, sub_group_id)
+        return sub, member_ranks.index(rank)
+
+    def dup(self, rank: int) -> tuple["MpiWorld", int]:
+        """MPI_Comm_dup: same membership, fresh communication context
+        (a new group id → isolated queues/sequence state)."""
+        return self.split(rank, color=0, key=rank)
+
+    def create_group_comm(self, rank: int, member_ranks: list[int],
+                          tag: int = 0) -> tuple[Optional["MpiWorld"], int]:
+        """MPI_Comm_create_group: collective only over ``member_ranks``
+        (every member passes the same list); non-members just get None.
+        No parent-wide communication — the membership is given, so the
+        derived id comes from (parent, members, tag) rather than the
+        split counter (non-members never call this, and a shared counter
+        would desync). Reuse with identical arguments needs a distinct
+        ``tag``, as in MPI."""
+        if rank not in member_ranks:
+            return None, -1
+        mix = 0
+        for r in member_ranks:
+            mix = (mix * 131 + int(r) + 1) & ((1 << 62) - 1)
+        sub_group_id = self._derive_group_id(self.group_id, mix,
+                                             tag + (1 << 20))
+        sub = self.make_subworld(list(member_ranks), sub_group_id)
+        return sub, list(member_ranks).index(rank)
 
     def close(self) -> None:
         """Stop this world's send workers (registry teardown)."""

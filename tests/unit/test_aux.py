@@ -63,6 +63,15 @@ def test_mpi_api_surface_through_executor():
             assert rows * cols == 4
             assert mpi.mpi_cart_rank(coords) == rank
 
+            # Sub-communicators through the guest API: split by parity,
+            # allreduce within the halves, free
+            sub = mpi.mpi_comm_split(color=rank % 2, key=rank)
+            assert mpi.mpi_comm_size(sub) == 2
+            sub_total = mpi.mpi_allreduce(
+                np.array([rank], dtype=np.int64), mpi.MPI_SUM, comm=sub)
+            assert int(sub_total[0]) == (2 if rank % 2 == 0 else 4)
+            mpi.mpi_comm_free(sub)
+
             mpi.mpi_barrier()
             assert mpi.mpi_wtime() > 0
             mpi.mpi_finalize()

@@ -511,3 +511,82 @@ def test_reduce_scatter(mpi_cluster):
     for rank in range(6):
         np.testing.assert_array_equal(results[rank],
                                       total[rank * 2:(rank + 1) * 2])
+
+
+# ---------------------------------------------------------------------------
+# Sub-communicators (reference mpi.h MPI_Comm_split_type / Comm_dup /
+# Comm_create_group)
+# ---------------------------------------------------------------------------
+
+def test_comm_split_even_odd(mpi_cluster):
+    """Split the 6-rank world by parity: each subworld allreduces
+    independently with renumbered ranks."""
+    def fn(world, rank):
+        sub, new_rank = world.split(rank, color=rank % 2)
+        assert sub.size == 3
+        assert new_rank == rank // 2  # parity groups keep rank order
+        out = sub.allreduce(new_rank, np.full(4, rank, np.int64), MpiOp.SUM)
+        # evens sum 0+2+4=6, odds 1+3+5=9
+        return int(out[0])
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        assert results[rank] == (6 if rank % 2 == 0 else 9)
+
+
+def test_comm_split_key_reorders_and_undefined_opts_out(mpi_cluster):
+    def fn(world, rank):
+        if rank == 5:
+            sub, new_rank = world.split(rank, color=-1)  # MPI_UNDEFINED
+            assert sub is None and new_rank == -1
+            return None
+        # Same color, DESCENDING key: new rank order reverses
+        sub, new_rank = world.split(rank, color=7, key=-rank)
+        assert sub.size == 5
+        assert new_rank == 4 - rank
+        # p2p in the subworld with the new numbering
+        if new_rank == 0:
+            sub.send(0, 4, np.array([42], np.int64))
+        if new_rank == 4:
+            arr, _ = sub.recv(0, 4)
+            assert arr.tolist() == [42]
+        sub.barrier(new_rank)
+        return new_rank
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_comm_dup_is_isolated(mpi_cluster):
+    """Messages on a dup'd communicator never cross into the parent."""
+    def fn(world, rank):
+        dup, dr = world.dup(rank)
+        assert dup.size == world.size and dr == rank
+        if rank == 0:
+            dup.send(0, 1, np.array([111], np.int64))
+            world.send(0, 1, np.array([222], np.int64))
+        if rank == 1:
+            parent_val, _ = world.recv(0, 1)
+            dup_val, _ = dup.recv(0, 1)
+            assert parent_val.tolist() == [222]
+            assert dup_val.tolist() == [111]
+        world.barrier(rank)
+        return None
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_comm_create_group(mpi_cluster):
+    """Collective only over the member list; cross-host members included."""
+    members = [1, 3, 4]  # spans mpiA (1) and mpiB (3, 4)
+
+    def fn(world, rank):
+        sub, new_rank = world.create_group_comm(rank, members)
+        if rank not in members:
+            assert sub is None
+            return None
+        assert sub.size == 3 and new_rank == members.index(rank)
+        out = sub.allreduce(new_rank, np.full(2, rank, np.int64), MpiOp.SUM)
+        assert out[0] == sum(members)
+        return None
+
+    run_ranks(mpi_cluster, fn)
