@@ -63,6 +63,21 @@ class MpiError(Exception):
     pass
 
 
+class MpiRequest:
+    """Async request handle tagged with its communicator's world — so
+    MPI_Wait/Test (which take no comm in real MPI) always resolve
+    against the world the isend/irecv ran on, never the thread's bound
+    parent. Bare int ids (the world-level API) still work for
+    MPI_COMM_WORLD callers."""
+
+    __slots__ = ("world", "rank", "id")
+
+    def __init__(self, world: MpiWorld, rank: int, rid: int) -> None:
+        self.world = world
+        self.rank = rank
+        self.id = rid
+
+
 def _bind(world: MpiWorld, rank: int) -> None:
     _tls.world = world
     _tls.rank = rank
@@ -161,47 +176,69 @@ def mpi_sendrecv(sendbuf, dest: int, source: int, comm=MPI_COMM_WORLD
     return world.sendrecv(np.asarray(sendbuf), rank, dest, source, rank)
 
 
-def mpi_isend(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
+def mpi_isend(buf, dest: int, comm=MPI_COMM_WORLD) -> MpiRequest:
     world, rank = _current(comm)
-    return world.isend(rank, dest, np.asarray(buf))
+    return MpiRequest(world, rank, world.isend(rank, dest, np.asarray(buf)))
 
 
-def mpi_irecv(source: int, comm=MPI_COMM_WORLD) -> int:
+def mpi_irecv(source: int, comm=MPI_COMM_WORLD) -> MpiRequest:
     world, rank = _current(comm)
-    return world.irecv(source, rank)
+    return MpiRequest(world, rank, world.irecv(source, rank))
 
 
-def mpi_wait(request: int, comm=MPI_COMM_WORLD
+def _resolve_request(request, comm) -> tuple[MpiWorld, int, int]:
+    if isinstance(request, MpiRequest):
+        return request.world, request.rank, request.id
+    world, rank = _current(comm)
+    return world, rank, int(request)
+
+
+def mpi_wait(request, comm=MPI_COMM_WORLD
              ) -> Optional[tuple[np.ndarray, MpiStatus]]:
-    world, rank = _current(comm)
-    return world.await_async(rank, request)
+    world, rank, rid = _resolve_request(request, comm)
+    return world.await_async(rank, rid)
 
 
-def mpi_waitall(requests: list[int], comm=MPI_COMM_WORLD
+def mpi_waitall(requests: list, comm=MPI_COMM_WORLD
                 ) -> list[Optional[tuple[np.ndarray, MpiStatus]]]:
-    world, rank = _current(comm)
-    return world.waitall(rank, requests)
+    return [mpi_wait(r, comm) for r in requests]
 
 
-def mpi_waitany(requests: list[int], comm=MPI_COMM_WORLD
+def mpi_waitany(requests: list, comm=MPI_COMM_WORLD
                 ) -> tuple[int, Optional[tuple[np.ndarray, MpiStatus]]]:
-    world, rank = _current(comm)
-    return world.waitany(rank, requests)
+    """First completable request across possibly-mixed communicators."""
+    resolved = [_resolve_request(r, comm) for r in requests]
+    deadline = time.monotonic() + 60.0
+    while True:
+        live = 0
+        for i, (world, rank, rid) in enumerate(resolved):
+            try:
+                ready = world.request_ready(rank, rid)
+            except KeyError:
+                continue  # completed by an earlier wait
+            live += 1
+            if ready:
+                return i, world.await_async(rank, rid)
+        if live == 0:
+            return -1, None
+        if time.monotonic() >= deadline:
+            raise TimeoutError("MPI_Waitany timed out")
+        time.sleep(0.0005)
 
 
-def mpi_test(request: int, comm=MPI_COMM_WORLD
+def mpi_test(request, comm=MPI_COMM_WORLD
              ) -> tuple[bool, Optional[tuple]]:
     """MPI_Test: (flag, result). flag False → request still pending (the
     request stays live); True → completed, result as mpi_wait. Testing a
     handle that already completed is legal (MPI_REQUEST_NULL semantics)
     and reports (True, None)."""
-    world, rank = _current(comm)
+    world, rank, rid = _resolve_request(request, comm)
     try:
-        if not world.request_ready(rank, request):
+        if not world.request_ready(rank, rid):
             return False, None
     except KeyError:
         return True, None  # completed by an earlier wait/test
-    return True, world.await_async(rank, request)
+    return True, world.await_async(rank, rid)
 
 
 def mpi_type_size(dtype) -> int:
@@ -395,3 +432,17 @@ def mpi_comm_free(comm: MpiComm) -> int:
         comm.world.barrier(comm.rank)
         comm.world.close()
     return MPI_SUCCESS
+
+
+MPI_COMM_TYPE_SHARED = 1
+
+
+def mpi_comm_split_type(split_type: int = MPI_COMM_TYPE_SHARED,
+                        key: int = 0, comm=MPI_COMM_WORLD) -> MpiComm:
+    """MPI_Comm_split_type: MPI_COMM_TYPE_SHARED groups co-located
+    (shared-memory) ranks — one subworld per host."""
+    if split_type != MPI_COMM_TYPE_SHARED:
+        raise MpiError(f"Unsupported split type {split_type}")
+    world, rank = _current(comm)
+    sub, new_rank = world.split_type_shared(rank, key)
+    return MpiComm(sub, new_rank)

@@ -988,11 +988,16 @@ class MpiWorld:
 
     @staticmethod
     def _derive_group_id(parent: int, seq: int, color: int) -> int:
-        # Stable arithmetic (NOT Python hash(): randomized per process);
-        # folded into a distinct high range so derived ids can't collide
-        # with planner-generated GIDs
-        mixed = (parent * 1_000_003 + seq * 8191 + (color + 7)) \
-            & ((1 << 62) - 1)
+        # Cryptographic mix (NOT Python hash(): randomized per process;
+        # NOT linear arithmetic: colors are arbitrary ints and a linear
+        # mix collides whenever color deltas cancel seq deltas), folded
+        # into a distinct high range so derived ids can't collide with
+        # planner-generated GIDs
+        import hashlib
+
+        digest = hashlib.sha256(
+            f"{parent}:{seq}:{color}".encode()).digest()
+        mixed = int.from_bytes(digest[:8], "little") & ((1 << 62) - 1)
         return (1 << 126) | mixed
 
     def make_subworld(self, member_ranks: list[int], sub_group_id: int
@@ -1037,6 +1042,17 @@ class MpiWorld:
         sub_group_id = self._derive_group_id(self.group_id, seq, color)
         sub = self.make_subworld(member_ranks, sub_group_id)
         return sub, member_ranks.index(rank)
+
+    def split_type_shared(self, rank: int, key: int = 0
+                          ) -> tuple["MpiWorld", int]:
+        """MPI_Comm_split_type(MPI_COMM_TYPE_SHARED): one subworld per
+        HOST — co-located ranks that can share memory (the reference's
+        split_type semantics, mpi.h:565)."""
+        host = self.host_for_rank(rank)
+        color = sorted(self.hosts()).index(host)
+        sub, new_rank = self.split(rank, color, key)
+        assert sub is not None
+        return sub, new_rank
 
     def dup(self, rank: int) -> tuple["MpiWorld", int]:
         """MPI_Comm_dup: same membership, fresh communication context

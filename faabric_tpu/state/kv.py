@@ -58,6 +58,10 @@ class StateKeyValue:
 
         self._lock = threading.RLock()
         self._data = np.zeros(size, dtype=np.uint8)
+        # Device-view cache keyed by (dtype, sharding), invalidated by
+        # host-image mutation (get_device_array)
+        self._version = 0
+        self._device_cache: dict = {}
         chunks = n_chunks(size)
         # Local-authority data is authoritative: everything is "pulled"
         self._pulled = np.full(chunks, self.is_master, dtype=bool)
@@ -85,6 +89,7 @@ class StateKeyValue:
             with self._lock:
                 self._data[lo:lo + len(data)] = np.frombuffer(data, np.uint8)
                 self._pulled[c] = True
+                self._bump_version()
 
     # ------------------------------------------------------------------
     # Reads
@@ -118,6 +123,7 @@ class StateKeyValue:
             self._data[:] = np.frombuffer(data, np.uint8)
             self._pulled[:] = True
             self._dirty[:] = True
+            self._bump_version()
 
     def set_chunk(self, offset: int, data: bytes) -> None:
         if offset + len(data) > self.size:
@@ -128,6 +134,7 @@ class StateKeyValue:
                                                                   np.uint8)
             self._dirty[first:last] = True
             self._pulled[first:last] = True
+            self._bump_version()
 
     # ------------------------------------------------------------------
     # Push / pull (non-master ↔ master)
@@ -193,6 +200,50 @@ class StateKeyValue:
     def unlock_global(self) -> None:
         self.authority.unlock()
 
+    # ------------------------------------------------------------------
+    # Device view (SURVEY §7 stage 6: "HBM-backed values with host↔device
+    # sync") — the host image stays authoritative; chips hold a cached
+    # jax array that refreshes when the host image changes
+    # ------------------------------------------------------------------
+    def get_device_array(self, dtype=None, sharding=None):
+        """The value as a device-resident jax array (optionally viewed as
+        ``dtype`` and placed with ``sharding``). Cached per (dtype,
+        sharding) and invalidated whenever the host image mutates — a
+        training step reading unchanged state pays zero transfers."""
+        import jax
+
+        self._ensure_pulled(0, self.size)
+        with self._lock:
+            version = self._version
+            # Normalized dtype + the (hashable) sharding itself: equal
+            # shardings hit one entry, and the dict keeps the sharding
+            # alive so a recycled object id can never alias a stale entry
+            key = (np.dtype(dtype).str if dtype is not None else None,
+                   sharding)
+            cached = self._device_cache.get(key)
+            if cached is not None and cached[0] == version:
+                return cached[1]
+            host = self._data.copy()
+        arr = host if dtype is None else host.view(dtype)
+        dev = jax.device_put(arr, sharding)
+        with self._lock:
+            self._device_cache[key] = (version, dev)
+        return dev
+
+    def set_from_device(self, arr) -> None:
+        """Write a device array's bytes back into the host image (device
+        → host sync); push_partial/push_full then moves it to the
+        authority."""
+        host = np.asarray(arr).reshape(-1).view(np.uint8)
+        if host.size != self.size:
+            raise ValueError(
+                f"device value is {host.size} bytes, KV holds {self.size}")
+        self.set(host.tobytes())
+
+    def _bump_version(self) -> None:
+        self._version += 1
+        self._device_cache.clear()
+
     # -- master-side entry points used by the StateServer ---------------
     def server_pull_chunk(self, offset: int, length: int) -> bytes:
         with self._lock:
@@ -206,6 +257,7 @@ class StateKeyValue:
             self._data[offset:offset + len(data)] = np.frombuffer(data,
                                                                   np.uint8)
             self._pulled[first:last] = True
+            self._bump_version()
 
     def server_append(self, data: bytes) -> None:
         self.authority.append(data)

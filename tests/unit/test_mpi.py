@@ -590,3 +590,47 @@ def test_comm_create_group(mpi_cluster):
         return None
 
     run_ranks(mpi_cluster, fn)
+
+
+def test_comm_split_type_shared(mpi_cluster):
+    """MPI_COMM_TYPE_SHARED: one subworld per host (3+3 split)."""
+    def fn(world, rank):
+        sub, new_rank = world.split_type_shared(rank)
+        assert sub.size == 3
+        assert new_rank == rank % 3  # ranks 0-2 on A, 3-5 on B
+        out = sub.allreduce(new_rank, np.array([rank], np.int64),
+                            MpiOp.SUM)
+        return int(out[0])
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        assert results[rank] == (3 if rank < 3 else 12)  # 0+1+2 / 3+4+5
+
+
+def test_subcomm_async_requests_resolve_correctly(mpi_cluster):
+    """isend/irecv on a sub-communicator through the guest-API handles:
+    MPI_Wait with NO comm argument still resolves against the subworld
+    (regression: int handles resolved against the TLS parent world)."""
+    from faabric_tpu.mpi.api import MpiRequest
+
+    def fn(world, rank):
+        sub, new_rank = world.split(rank, color=rank % 2, key=rank)
+        # Handle-style async through the subworld, mimicking the api
+        # layer's MpiRequest resolution
+        nxt = (new_rank + 1) % sub.size
+        prv = (new_rank - 1) % sub.size
+        recv_rid = sub.irecv(prv, new_rank)
+        send_rid = sub.isend(new_rank, nxt, np.array([rank], np.int64))
+        req = MpiRequest(sub, new_rank, recv_rid)
+        from faabric_tpu.mpi.api import mpi_wait
+
+        got = mpi_wait(req)  # no comm passed: the handle carries it
+        sub.await_async(new_rank, send_rid)
+        return int(got[0][0])
+
+    results = run_ranks(mpi_cluster, fn)
+    # In each parity subworld the ring neighbour's PARENT rank arrives
+    for rank in range(6):
+        parity = [r for r in range(6) if r % 2 == rank % 2]
+        prv_parent = parity[(parity.index(rank) - 1) % 3]
+        assert results[rank] == prv_parent

@@ -247,3 +247,30 @@ print("OK")
     kv.pull()
     assert kv.get_chunk(0, 10) == b"helloworld"
     assert kv.get_appended(1) == [b"from-child"]
+
+
+def test_device_array_view_caches_and_invalidates():
+    """HBM view of a KV: cached until the host image mutates; device
+    writes sync back through set_from_device."""
+    import jax
+    import numpy as _np
+
+    kv = StateKeyValue("demo", "dev", 64, True, "h")
+    kv.set((_np.arange(64, dtype=_np.uint8)).tobytes())
+
+    a = kv.get_device_array(dtype=_np.float32)
+    b = kv.get_device_array(dtype=_np.float32)
+    assert a is b  # cache hit, zero extra transfers
+    _np.testing.assert_array_equal(
+        _np.asarray(a).view(_np.uint8), _np.arange(64, dtype=_np.uint8))
+
+    kv.set_chunk(0, b"\xff")
+    c = kv.get_device_array(dtype=_np.float32)
+    assert c is not a  # mutation invalidated the cache
+    assert _np.asarray(c).view(_np.uint8)[0] == 0xFF
+
+    # Device → host: compute on chip, write back
+    updated = jax.numpy.asarray(_np.asarray(c)) * 0 + 1.0
+    kv.set_from_device(updated)
+    d = _np.frombuffer(kv.get(), dtype=_np.float32)
+    _np.testing.assert_array_equal(d, _np.ones(16, _np.float32))
