@@ -446,3 +446,18 @@ def mpi_comm_split_type(split_type: int = MPI_COMM_TYPE_SHARED,
     world, rank = _current(comm)
     sub, new_rank = world.split_type_shared(rank, key)
     return MpiComm(sub, new_rank)
+
+
+def mpi_comm_create(group: list[int], comm=MPI_COMM_WORLD
+                    ) -> Optional[MpiComm]:
+    """MPI_Comm_create — collective over ALL of ``comm`` (unlike
+    mpi_comm_create_group): members form the new communicator in group
+    order, everyone else gets MPI_COMM_NULL."""
+    world, rank = _current(comm)
+    in_group = rank in group
+    color = 0 if in_group else MPI_UNDEFINED
+    key = list(group).index(rank) if in_group else 0
+    sub, new_rank = world.split(rank, color, key)
+    if sub is None:
+        return MPI_COMM_NULL
+    return MpiComm(sub, new_rank)

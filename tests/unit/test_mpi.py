@@ -634,3 +634,25 @@ def test_subcomm_async_requests_resolve_correctly(mpi_cluster):
         parity = [r for r in range(6) if r % 2 == rank % 2]
         prv_parent = parity[(parity.index(rank) - 1) % 3]
         assert results[rank] == prv_parent
+
+
+def test_comm_create_collective_over_all(mpi_cluster):
+    """mpi-style comm_create via split: all 6 ranks participate, only
+    the group ([4, 0, 2], custom order) gets a communicator."""
+    group = [4, 0, 2]
+
+    def fn(world, rank):
+        in_group = rank in group
+        color = 0 if in_group else -1
+        key = group.index(rank) if in_group else 0
+        sub, new_rank = world.split(rank, color, key)
+        if not in_group:
+            assert sub is None
+            return None
+        assert sub.size == 3 and new_rank == group.index(rank)
+        out = sub.allreduce(new_rank, np.array([rank], np.int64),
+                            MpiOp.SUM)
+        assert int(out[0]) == 6  # 4+0+2
+        return new_rank
+
+    run_ranks(mpi_cluster, fn)
