@@ -461,3 +461,25 @@ def mpi_comm_create(group: list[int], comm=MPI_COMM_WORLD
     if sub is None:
         return MPI_COMM_NULL
     return MpiComm(sub, new_rank)
+
+
+def mpi_dims_create(nnodes: int, ndims: int) -> list[int]:
+    """MPI_Dims_create: balanced factorization of ``nnodes`` over
+    ``ndims`` dimensions (descending, as the standard requires)."""
+    if nnodes <= 0 or ndims <= 0:
+        raise MpiError("dims_create needs positive nnodes/ndims")
+    dims = [1] * ndims
+    remaining = nnodes
+    # Peel prime factors largest-first onto the smallest dimension
+    factors = []
+    f = 2
+    while f * f <= remaining:
+        while remaining % f == 0:
+            factors.append(f)
+            remaining //= f
+        f += 1
+    if remaining > 1:
+        factors.append(remaining)
+    for factor in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= factor
+    return sorted(dims, reverse=True)

@@ -656,3 +656,18 @@ def test_comm_create_collective_over_all(mpi_cluster):
         return new_rank
 
     run_ranks(mpi_cluster, fn)
+
+
+def test_dims_create():
+    from faabric_tpu.mpi.api import mpi_dims_create
+
+    assert mpi_dims_create(12, 2) == [4, 3]
+    assert mpi_dims_create(8, 3) == [2, 2, 2]
+    assert mpi_dims_create(7, 2) == [7, 1]
+    assert mpi_dims_create(16, 2) == [4, 4]
+    import numpy as _np
+    for n in range(1, 65):
+        for d in (1, 2, 3):
+            dims = mpi_dims_create(n, d)
+            assert _np.prod(dims) == n and len(dims) == d
+            assert dims == sorted(dims, reverse=True)
