@@ -96,6 +96,45 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
         return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
 
+    def fn_mpi_order(self, msg, req):
+        """Port of the reference example mpi_order
+        (tests/dist/mpi/examples/mpi_order.cpp): rank 0 sends to 1/2/3
+        and receives the echoes OUT OF ORDER (3, 1, 2) — per-pair
+        channels must not bleed into each other."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7600
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+
+        if rank == 0:
+            out = {1: 111, 2: 222, 3: 333}
+            for dst, v in out.items():
+                world.send(0, dst, np.array([v], np.int32))
+            got = {}
+            for src in (3, 1, 2):  # deliberately out of order
+                arr, _ = world.recv(src, 0)
+                got[src] = int(arr[0])
+            if got != out:
+                msg.output_data = f"mismatch:{got}".encode()
+                return int(ReturnValue.FAILED)
+            msg.output_data = b"order-ok"
+        elif rank <= 3:
+            arr, _ = world.recv(0, rank)
+            world.send(rank, 0, arr)
+            msg.output_data = f"echoed:{int(arr[0])}".encode()
+        else:
+            msg.output_data = b"idle"
+        world.barrier(rank)
+        return int(ReturnValue.SUCCESS)
+
     def fn_mpi_status(self, msg, req):
         """Port of the reference example mpi_status
         (tests/dist/mpi/examples/mpi_status.cpp): rank 0 sends 40 ints;

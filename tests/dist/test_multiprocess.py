@@ -130,6 +130,22 @@ def test_dist_mpi_chunked_bulk_allreduce(dist_cluster):
     assert {m.executed_host for m in status.message_results} == {"w1", "w2"}
 
 
+def test_dist_mpi_order_example(dist_cluster):
+    """Reference example port: mpi_order.cpp — out-of-order receives
+    across per-pair channels."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", "mpi_order", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=40.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+    assert r.output_data == b"order-ok"
+    status = wait_batch_finished(me, req.app_id)
+    assert all(m.return_value == int(ReturnValue.SUCCESS)
+               for m in status.message_results)
+
+
 def test_dist_mpi_status_example(dist_cluster):
     """Reference example port: mpi_status.cpp — probe + status count of a
     partial-buffer receive across hosts."""
