@@ -41,6 +41,10 @@ logger = get_logger(__name__)
 BULK_PORT = 8014
 # Below this the RPC plane wins (no extra connection, lower latency)
 BULK_THRESHOLD = 256 * 1024
+# Sanity ceiling per frame: legit traffic is chunk-pipelined well below
+# this, so anything bigger is a desynced/garbage stream — and the bound
+# must be small enough that np.empty(nbytes) can never OOM the host
+MAX_FRAME_BYTES = 1 << 30
 
 # group_hi, group_lo (group ids are 128-bit GIDs), send_idx, recv_idx,
 # channel, seq, nbytes
@@ -113,6 +117,17 @@ class BulkServer:
                 (group_hi, group_lo, send_idx, recv_idx, channel, seq,
                  nbytes) = _FRAME.unpack(head)
                 group_id = (group_hi << 64) | group_lo
+                # Garbage (port-scanner bytes, desynced stream) must not
+                # become a multi-GiB allocation or a dead thread: bound
+                # the frame and drop the connection on nonsense
+                if not (0 <= nbytes <= MAX_FRAME_BYTES
+                        and send_idx >= 0 and recv_idx >= 0
+                        and channel >= 0):
+                    logger.warning(
+                        "Dropping bulk connection: bad frame "
+                        "(nbytes=%d send=%d recv=%d chan=%d)",
+                        nbytes, send_idx, recv_idx, channel)
+                    return
                 # np.empty skips the 100 MiB-scale memset a bytearray pays
                 payload = np.empty(nbytes, dtype=np.uint8)
                 _recv_exact_into(conn, memoryview(payload).cast("B"))
@@ -122,6 +137,8 @@ class BulkServer:
                                     payload, seq, channel)
         except (ConnectionError, OSError):
             pass  # peer closed / server stopping
+        except Exception:  # noqa: BLE001 — one bad peer, not the server
+            logger.exception("Bulk connection handler failed")
         finally:
             try:
                 conn.close()

@@ -210,3 +210,33 @@ def test_interleaved_mixed_size_collectives_stress(bulk_pair):
         expected = (0 + i) + (1 + i)
         assert out[0][i] == (expected, expected)
         assert out[1][i] == (expected, expected)
+
+
+def test_bulk_server_survives_garbage(bulk_pair):
+    """Garbage bytes (bad frame: absurd nbytes, negative idxs) drop that
+    connection but the server keeps serving real traffic."""
+    import socket
+    import time
+
+    from faabric_tpu.transport.bulk import BULK_PORT, _FRAME
+    from faabric_tpu.transport.common import resolve_host
+
+    ip, port = resolve_host("bulkB", BULK_PORT)
+
+    # 1. Random junk shorter than a header, then close
+    s = socket.create_connection((ip, port), timeout=5)
+    s.sendall(b"\x01\x02garbage")
+    s.close()
+
+    # 2. A well-formed header with an absurd size claim
+    s = socket.create_connection((ip, port), timeout=5)
+    s.sendall(_FRAME.pack(0, 123, -5, 2, 0, 0, 1 << 62))
+    time.sleep(0.2)
+    s.close()
+
+    # Real traffic still flows
+    payload = b"q" * (BULK_THRESHOLD + 5)
+    bulk_pair["bulkA"].send_message(GROUP, 0, 1, payload, must_order=True)
+    got = bulk_pair["bulkB"].recv_message(GROUP, 0, 1, must_order=True,
+                                          timeout=10.0)
+    assert bytes(got) == payload
