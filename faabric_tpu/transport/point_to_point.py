@@ -153,10 +153,14 @@ class PointToPointBroker:
             # scatter-gather send straight from the source buffers,
             # recv_into preallocated buffers — transport/bulk.py); peers
             # without a bulk server fall back to the RPC plane
-            from faabric_tpu.transport.bulk import BULK_THRESHOLD
+            from faabric_tpu.transport.bulk import (
+                BULK_THRESHOLD,
+                MAX_FRAME_BYTES,
+            )
             from faabric_tpu.util.testing import is_mock_mode
 
-            if (len(data) >= BULK_THRESHOLD and not is_mock_mode()
+            if (BULK_THRESHOLD <= len(data) <= MAX_FRAME_BYTES
+                    and not is_mock_mode()
                     and not self._bulk_down(dst_host)):
                 bufs = (data.buffers() if hasattr(data, "buffers")
                         else [data])
