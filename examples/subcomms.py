@@ -1,0 +1,84 @@
+"""Hierarchical collectives with sub-communicators.
+
+Two logical hosts × 2 ranks: split by shared host
+(MPI_COMM_TYPE_SHARED), reduce within each host, then let the host
+leaders combine over a leaders-only communicator — the classic two-level
+reduction pattern, coordination-free (no planner involvement in comm
+creation).
+
+Run: python examples/subcomms.py
+"""
+
+import os
+import random
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.mpi import MpiOp, MpiWorld
+from faabric_tpu.transport.common import (
+    clear_host_aliases,
+    register_host_alias,
+)
+from faabric_tpu.transport.point_to_point import PointToPointBroker
+from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+GROUP = 4040
+
+
+def main() -> None:
+    base = random.randint(20, 120) * 100
+    register_host_alias("hA", "127.0.0.1", base)
+    register_host_alias("hB", "127.0.0.1", base + 1000)
+    brokers = {h: PointToPointBroker(h) for h in ("hA", "hB")}
+    servers = [PointToPointServer(b) for b in brokers.values()]
+    for s in servers:
+        s.start()
+    d = SchedulingDecision(app_id=GROUP, group_id=GROUP)
+    for r in range(4):
+        d.add_message("hA" if r < 2 else "hB", 100 + r, r, r)
+    for b in brokers.values():
+        b.set_up_local_mappings_from_decision(d)
+    worlds = {h: MpiWorld(b, GROUP, 4, GROUP) for h, b in brokers.items()}
+
+    def rank_fn(rank):
+        world = worlds["hA" if rank < 2 else "hB"]
+        world.refresh_rank_hosts()
+
+        # Level 1: per-host communicator (shared-memory ranks)
+        host_comm, host_rank = world.split_type_shared(rank)
+        local = host_comm.allreduce(host_rank,
+                                    np.array([rank + 1], np.int64),
+                                    MpiOp.SUM)
+
+        # Level 2: host leaders only
+        leaders = [0, 2]
+        leader_comm, lr = world.create_group_comm(rank, leaders)
+        if leader_comm is not None:
+            total = leader_comm.allreduce(lr, local, MpiOp.SUM)
+            print(f"rank {rank}: host sum {int(local[0])}, "
+                  f"global {int(total[0])}")
+        else:
+            print(f"rank {rank}: host sum {int(local[0])}")
+        world.barrier(rank)
+
+    try:
+        ts = [threading.Thread(target=rank_fn, args=(r,)) for r in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    finally:
+        for s in servers:
+            s.stop()
+        for b in brokers.values():
+            b.clear()
+        clear_host_aliases()
+
+
+if __name__ == "__main__":
+    main()
