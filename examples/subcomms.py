@@ -28,6 +28,12 @@ from faabric_tpu.transport.point_to_point import PointToPointBroker
 from faabric_tpu.transport.ptp_remote import PointToPointServer
 
 GROUP = 4040
+_print_lock = threading.Lock()
+
+
+def say(msg: str) -> None:
+    with _print_lock:
+        print(msg, flush=True)
 
 
 def main() -> None:
@@ -60,10 +66,10 @@ def main() -> None:
         leader_comm, lr = world.create_group_comm(rank, leaders)
         if leader_comm is not None:
             total = leader_comm.allreduce(lr, local, MpiOp.SUM)
-            print(f"rank {rank}: host sum {int(local[0])}, "
-                  f"global {int(total[0])}")
+            say(f"rank {rank}: host sum {int(local[0])}, "
+                f"global {int(total[0])}")
         else:
-            print(f"rank {rank}: host sum {int(local[0])}")
+            say(f"rank {rank}: host sum {int(local[0])}")
         world.barrier(rank)
 
     try:
