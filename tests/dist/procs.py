@@ -96,6 +96,73 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
         return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
 
+    def fn_mpi_reduce_many(self, msg, req):
+        """Port of the reference example mpi_reduce_many
+        (tests/dist/mpi/examples/mpi_reduce_many.cpp): 100 back-to-back
+        reduces of a 3-vector — collective state must not bleed between
+        repetitions."""
+        from faabric_tpu.mpi import MpiOp, get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7700
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        size = world.size
+
+        expected = np.array([sum(range(size)), 10 * sum(range(size)),
+                             100 * sum(range(size))], np.int64)
+        mine = np.array([rank, 10 * rank, 100 * rank], np.int64)
+        for _ in range(100):
+            res = world.reduce(rank, 0, mine, MpiOp.SUM)
+            if rank == 0 and not np.array_equal(res, expected):
+                msg.output_data = f"bad:{res.tolist()}".encode()
+                return int(ReturnValue.FAILED)
+        world.barrier(rank)
+        msg.output_data = b"reduce-many-ok"
+        return int(ReturnValue.SUCCESS)
+
+    def fn_mpi_sync_async(self, msg, req):
+        """Port of the reference example mpi_send_sync_async: rank 0
+        interleaves an isend and a blocking send to every rank; receivers
+        irecv twice and wait OUT OF ORDER."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7800
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+
+        if rank == 0:
+            for r in range(1, world.size):
+                rid = world.isend(0, r, np.array([r], np.int32))
+                world.send(0, r, np.array([r], np.int32))
+                world.await_async(0, rid)
+            msg.output_data = b"sent"
+        else:
+            r1 = world.irecv(0, rank)
+            r2 = world.irecv(0, rank)
+            v2 = world.await_async(rank, r2)  # out of order
+            v1 = world.await_async(rank, r1)
+            ok = int(v1[0][0]) == rank and int(v2[0][0]) == rank
+            msg.output_data = (b"sync-async-ok" if ok
+                               else f"got:{v1[0][0]},{v2[0][0]}".encode())
+            if not ok:
+                return int(ReturnValue.FAILED)
+        world.barrier(rank)
+        return int(ReturnValue.SUCCESS)
+
     def fn_mpi_order(self, msg, req):
         """Port of the reference example mpi_order
         (tests/dist/mpi/examples/mpi_order.cpp): rank 0 sends to 1/2/3

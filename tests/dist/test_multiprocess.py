@@ -130,6 +130,27 @@ def test_dist_mpi_chunked_bulk_allreduce(dist_cluster):
     assert {m.executed_host for m in status.message_results} == {"w1", "w2"}
 
 
+@pytest.mark.parametrize("behaviour,rank0_out", [
+    ("mpi_reduce_many", b"reduce-many-ok"),
+    ("mpi_sync_async", b"sent"),
+])
+def test_dist_mpi_more_examples(dist_cluster, behaviour, rank0_out):
+    """Further reference example ports: mpi_reduce_many.cpp (100
+    back-to-back reduces) and mpi_send_sync_async.cpp (interleaved
+    sync/async sends, out-of-order waits)."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", behaviour, 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=60.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+    assert r.output_data == rank0_out
+    status = wait_batch_finished(me, req.app_id, timeout=30)
+    for m in status.message_results:
+        assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+
+
 def test_dist_mpi_order_example(dist_cluster):
     """Reference example port: mpi_order.cpp — out-of-order receives
     across per-pair channels."""
