@@ -163,6 +163,37 @@ class DistExecutor(Executor):
         world.barrier(rank)
         return int(ReturnValue.SUCCESS)
 
+    def fn_mpi_cartesian(self, msg, req):
+        """Port of the reference example mpi_cartesian
+        (tests/dist/mpi/examples/mpi_cartesian.cpp): cart_create with a
+        square side, coords round-trip through cart_rank, and a shift."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7900
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+
+        side = int(np.floor(np.sqrt(world.size)))
+        world.cart_create((side, world.size // side))
+        coords = world.cart_coords(rank)
+        if world.cart_rank(coords) != rank:
+            msg.output_data = f"roundtrip:{coords}".encode()
+            return int(ReturnValue.FAILED)
+        src, dst = world.cart_shift(rank, 0, 1)
+        if not (0 <= src < world.size and 0 <= dst < world.size):
+            msg.output_data = f"shift:{src},{dst}".encode()
+            return int(ReturnValue.FAILED)
+        world.barrier(rank)
+        msg.output_data = f"cart-ok:{coords[0]}x{coords[1]}".encode()
+        return int(ReturnValue.SUCCESS)
+
     def fn_mpi_order(self, msg, req):
         """Port of the reference example mpi_order
         (tests/dist/mpi/examples/mpi_order.cpp): rank 0 sends to 1/2/3

@@ -133,6 +133,7 @@ def test_dist_mpi_chunked_bulk_allreduce(dist_cluster):
 @pytest.mark.parametrize("behaviour,rank0_out", [
     ("mpi_reduce_many", b"reduce-many-ok"),
     ("mpi_sync_async", b"sent"),
+    ("mpi_cartesian", b"cart-ok:0x0"),
 ])
 def test_dist_mpi_more_examples(dist_cluster, behaviour, rank0_out):
     """Further reference example ports: mpi_reduce_many.cpp (100
