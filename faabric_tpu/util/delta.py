@@ -46,28 +46,51 @@ class DeltaSettings:
         return out
 
 
-def serialize_delta(settings: DeltaSettings, old: bytes, new: bytes) -> bytes:
-    """Encode new relative to old."""
-    old_arr = np.frombuffer(old, dtype=np.uint8)
-    new_arr = np.frombuffer(new, dtype=np.uint8)
+def _dirty_runs(flags: np.ndarray) -> list[tuple[int, int]]:
+    """Consecutive dirty pages coalesced into (first_page, n_pages)."""
+    idx = np.where(flags)[0]
+    if idx.size == 0:
+        return []
+    breaks = np.where(np.diff(idx) > 1)[0]
+    starts = np.concatenate([[idx[0]], idx[breaks + 1]])
+    ends = np.concatenate([idx[breaks], [idx[-1]]])
+    return [(int(s), int(e - s + 1)) for s, e in zip(starts, ends)]
+
+
+def serialize_delta(settings: DeltaSettings, old: "bytes | np.ndarray",
+                    new: "bytes | np.ndarray") -> bytes:
+    """Encode new relative to old (arrays skip the bytes-conversion
+    copy). The dirty scan is one native/vectorized pass and consecutive
+    dirty pages emit as single runs, so sparse deltas over big images
+    cost ~a memcmp, not a Python loop."""
+    # Arrays pass through without the bytes-conversion copy
+    old_arr = (old.reshape(-1).view(np.uint8) if isinstance(old, np.ndarray)
+               else np.frombuffer(old, dtype=np.uint8))
+    new_arr = (new.reshape(-1).view(np.uint8) if isinstance(new, np.ndarray)
+               else np.frombuffer(new, dtype=np.uint8))
     ps = settings.page_size
+    n = new_arr.size
 
     body = bytearray()
-    n = len(new)
-    for off in range(0, n, ps):
-        end = min(off + ps, n)
-        new_page = new_arr[off:end]
-        old_page = old_arr[off:min(end, old_arr.size)]
-        if old_page.size == new_page.size and np.array_equal(old_page, new_page):
-            continue
-        if settings.use_xor and old_page.size == new_page.size:
-            payload = np.bitwise_xor(new_page, old_page).tobytes()
-            cmd = CMD_DELTA_XOR
-        else:
-            payload = new_page.tobytes()
-            cmd = CMD_DELTA_OVERWRITE
-        body += struct.pack("<BQQ", cmd, off, len(payload))
-        body += payload
+    from faabric_tpu.util.dirty import page_flags
+
+    for first_page, n_pages in _dirty_runs(page_flags(old_arr, new_arr,
+                                                      ps)):
+        off = first_page * ps
+        end = min((first_page + n_pages) * ps, n)
+        # XOR needs old coverage; split a run at the old-size boundary
+        xor_end = min(end, old_arr.size) if settings.use_xor else off
+        if settings.use_xor and xor_end > off:
+            payload = np.bitwise_xor(new_arr[off:xor_end],
+                                     old_arr[off:xor_end]).tobytes()
+            body += struct.pack("<BQQ", CMD_DELTA_XOR, off, len(payload))
+            body += payload
+            off = xor_end
+        if off < end:
+            payload = new_arr[off:end].tobytes()
+            body += struct.pack("<BQQ", CMD_DELTA_OVERWRITE, off,
+                                len(payload))
+            body += payload
     body += struct.pack("<B", CMD_END)
 
     out = bytearray()

@@ -41,6 +41,43 @@ def _as_array(mem) -> np.ndarray:
     return np.frombuffer(mem, dtype=np.uint8)
 
 
+def page_flags(old: np.ndarray, new: np.ndarray,
+               page_size: int = PAGE_SIZE) -> np.ndarray:
+    """Dirty flags per page of ``new`` vs ``old``: one native memcmp pass
+    when the C++ helper is available (numpy reshape-compare otherwise),
+    partial trailing page included, pages past ``old`` (growth) dirty by
+    definition. Shared by the dirty trackers and the delta codec."""
+    from faabric_tpu.util.native import get_pagediff_lib
+
+    n = new.size
+    n_pages_total = (n + page_size - 1) // page_size
+    flags = np.zeros(n_pages_total, dtype=bool)
+    common = min(old.size, n)
+    common_pages = (common + page_size - 1) // page_size
+
+    lib = get_pagediff_lib()
+    if common and lib is not None:
+        raw = np.zeros(common_pages, dtype=np.uint8)
+        old_c = np.ascontiguousarray(old[:common])
+        new_c = np.ascontiguousarray(new[:common])
+        lib.diff_pages(old_c.ctypes.data, new_c.ctypes.data, common,
+                       page_size, raw.ctypes.data)
+        flags[:common_pages] = raw.astype(bool)
+    elif common:
+        whole = common // page_size
+        if whole:
+            flags[:whole] = (
+                new[:whole * page_size].reshape(-1, page_size)
+                != old[:whole * page_size].reshape(-1, page_size)
+            ).any(axis=1)
+        if whole * page_size < common:
+            flags[whole] = not np.array_equal(
+                new[whole * page_size:common], old[whole * page_size:common])
+    if n > old.size:
+        flags[old.size // page_size:] = True
+    return flags
+
+
 def hint_page_indices(region_hints, total_pages: int) -> np.ndarray:
     """Page indices covered by (offset, length) byte extents, clipped to
     the image."""
@@ -174,26 +211,10 @@ class NativeCompareTracker(CompareTracker):
 
     def _diff(self, baseline: np.ndarray, mem,
               hint_idx: Optional[np.ndarray] = None) -> np.ndarray:
-        from faabric_tpu.util.native import get_pagediff_lib
-
-        lib = get_pagediff_lib()
-        cur = _as_array(mem)
-        if lib is None or hint_idx is not None:
+        if hint_idx is not None:
             # Hinted diffs are already O(hinted pages) in numpy
             return super()._diff(baseline, mem, hint_idx)
-        cmp_size = min(cur.size, baseline.size)
-        flags = np.zeros(n_pages(cur.size), dtype=np.uint8)
-        if cmp_size:
-            cur_c = np.ascontiguousarray(cur[:cmp_size])
-            base_c = np.ascontiguousarray(baseline[:cmp_size])
-            lib.diff_pages(base_c.ctypes.data, cur_c.ctypes.data, cmp_size,
-                           PAGE_SIZE, flags.ctypes.data)
-        out = flags.astype(bool)
-        # Pages past the baseline (memory grew mid-batch) are dirty by
-        # definition — mirrors CompareTracker._diff
-        if cur.size > baseline.size:
-            out[baseline.size // PAGE_SIZE:] = True
-        return out
+        return page_flags(baseline, _as_array(mem))
 
 
 # Random per-word-position multipliers for the vectorised page hash: a

@@ -180,14 +180,15 @@ class DistExecutor(Executor):
         rank = msg.mpi_rank
         world.refresh_rank_hosts()
 
-        side = int(np.floor(np.sqrt(world.size)))
-        world.cart_create((side, world.size // side))
+        world.cart_create(world.cart_dims())  # default near-square grid
         coords = world.cart_coords(rank)
         if world.cart_rank(coords) != rank:
             msg.output_data = f"roundtrip:{coords}".encode()
             return int(ReturnValue.FAILED)
         src, dst = world.cart_shift(rank, 0, 1)
-        if not (0 <= src < world.size and 0 <= dst < world.size):
+        # The actual neighbours along dim 0 (periodic)
+        if dst != world.cart_rank((coords[0] + 1, coords[1])) or \
+                src != world.cart_rank((coords[0] - 1, coords[1])):
             msg.output_data = f"shift:{src},{dst}".encode()
             return int(ReturnValue.FAILED)
         world.barrier(rank)
