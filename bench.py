@@ -611,6 +611,37 @@ def bench_dirty_tracker(quick: bool = False) -> dict:
     return out
 
 
+def bench_delta_codec(quick: bool = False) -> dict:
+    """Snapshot delta encode/apply over a sparse change (the freeze/thaw
+    and snapshot-transfer hot path): one native page scan + coalesced
+    runs, reference delta.cpp analog."""
+    import numpy as np
+
+    from faabric_tpu.util.delta import (
+        DeltaSettings,
+        apply_delta,
+        serialize_delta,
+    )
+
+    size = (32 if quick else 256) << 20
+    old = np.zeros(size, np.uint8)
+    new = old.copy()
+    new[np.random.RandomState(3).randint(0, size, 64)] = 9
+    s = DeltaSettings(page_size=4096, use_xor=True, zlib_level=1)
+    serialize_delta(s, old[:8], old[:8])  # warm the native lib
+
+    t0 = time.perf_counter()
+    d = serialize_delta(s, old, new)
+    enc_ms = 1000 * (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    out = apply_delta(d, old.tobytes())
+    app_ms = 1000 * (time.perf_counter() - t0)
+    assert bytes(out) == new.tobytes()
+    return {"image_mib": size >> 20, "dirty_pages": 64,
+            "encode_ms": enc_ms, "apply_ms": app_ms,
+            "delta_bytes": len(d)}
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     quick = os.environ.get("BENCH_QUICK") == "1"
@@ -625,6 +656,11 @@ def main() -> None:
         extras["dirty_tracker"] = bench_dirty_tracker(quick)
     except Exception as e:  # noqa: BLE001
         extras["dirty_tracker_error"] = str(e)[:200]
+
+    try:
+        extras["delta_codec"] = bench_delta_codec(quick)
+    except Exception as e:  # noqa: BLE001
+        extras["delta_codec_error"] = str(e)[:200]
 
     ptp = bench_ptp_dispatch(iters=100 if quick else 400)
     extras["ptp"] = ptp
