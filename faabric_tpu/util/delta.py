@@ -104,8 +104,9 @@ def serialize_delta(settings: DeltaSettings, old: "bytes | np.ndarray",
     return bytes(out)
 
 
-def apply_delta(delta: bytes, old: bytes) -> bytes:
-    """Reconstruct new from old + delta."""
+def apply_delta(delta: bytes, old: "bytes | np.ndarray") -> bytes:
+    """Reconstruct new from old + delta (one memory pass to build the
+    base image: empty + copy, zero-fill only for growth)."""
     pos = 0
     cmd, total = struct.unpack_from("<BQ", delta, pos)
     if cmd != CMD_TOTAL_SIZE:
@@ -120,9 +121,13 @@ def apply_delta(delta: bytes, old: bytes) -> bytes:
     else:
         body = delta[pos:]
 
-    out = np.zeros(total, dtype=np.uint8)
-    old_arr = np.frombuffer(old, dtype=np.uint8)
-    out[:min(total, old_arr.size)] = old_arr[:min(total, old_arr.size)]
+    old_arr = (old.reshape(-1).view(np.uint8) if isinstance(old, np.ndarray)
+               else np.frombuffer(old, dtype=np.uint8))
+    out = np.empty(total, dtype=np.uint8)
+    common = min(total, old_arr.size)
+    out[:common] = old_arr[:common]
+    if total > common:
+        out[common:] = 0
 
     pos = 0
     while True:
