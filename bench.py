@@ -634,7 +634,7 @@ def bench_delta_codec(quick: bool = False) -> dict:
     d = serialize_delta(s, old, new)
     enc_ms = 1000 * (time.perf_counter() - t0)
     t0 = time.perf_counter()
-    out = apply_delta(d, old.tobytes())
+    out = apply_delta(d, old)
     app_ms = 1000 * (time.perf_counter() - t0)
     assert bytes(out) == new.tobytes()
     return {"image_mib": size >> 20, "dirty_pages": 64,
