@@ -21,18 +21,22 @@ Layer map (== SURVEY.md §1; every layer is implemented — see README.md):
     scheduler/       per-host scheduler, function-call RPC, chaining
     executor/        pluggable executor w/ thread pool, snapshot restore
     mpi/             MPI-semantics world: host PTP path + XLA device path,
-                     guest mpi_* API
+                     sub-communicators, guest mpi_* API
     transport/       framed TCP endpoints, RPC servers/clients, PTP broker
                      with ordered delivery + group locks/barriers
     snapshot/        memory snapshots, typed merge regions, diffs, deltas
     state/           distributed KV (master-per-key, chunked pull/push)
-    parallel/        TPU mesh substrate: axes, collectives, ring attention
-    models/          dense + MoE families over dp/tp/sp/ep, checkpointing
-    ops/             Pallas kernels (flash attention, fused RMS norm)
+    parallel/        TPU mesh substrate: axes, collectives, device p2p,
+                     ring attention, pipeline parallelism
+    models/          dense + MoE families over dp/tp/sp/pp/ep, sampling
+                     decode, gradient accumulation, eval, checkpointing
+    data/            memmap token datasets + prefetching mesh loaders
+    ops/             Pallas kernels (flash attention fwd+bwd w/ lse,
+                     fused RMS norm)
     runner/          worker runtime assembly + deployment CLI
     util/            config, gids, queues, latches, dirty tracking, graphs,
                      CPU pinning, crash handler, native-lib loader
     native/          C++ page-diff/XOR kernels (repo root, ctypes-bound)
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
