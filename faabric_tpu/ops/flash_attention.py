@@ -29,6 +29,20 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# Per-row softmax statistics (lse, delta) ride through Pallas with a
+# broadcast 128-lane trailing dim: Mosaic requires the last two block
+# dims to be (8k, 128k)-tileable, so a (1, block_q) block of a 2-D
+# (B·H, S) array cannot lower on real TPU hardware (the official TPU
+# flash kernel uses the same layout for its m/l statistics).
+LANE = 128
+
+
+def _stat_cols(stat, n_cols: int):
+    """Expand a (rows, LANE) lane-broadcast statistic to (rows, n_cols)
+    (every lane holds the same per-row value; n_cols may be < LANE on the
+    CPU interpret path)."""
+    reps = max(1, -(-n_cols // LANE))
+    return jnp.tile(stat, (1, reps))[:, :n_cols]
 
 
 def _reference_attention(q, k, v, causal: bool = True):
@@ -103,7 +117,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     # Per-row log-sum-exp: the only softmax statistic the backward needs
-    lse_ref[0] = m + jnp.log(l)
+    # (broadcast across the LANE dim — see LANE comment above)
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                  (block_q, LANE))
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -119,8 +135,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0]
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]        # (block_q,) fp32
-    delta = delta_ref[0]    # (block_q,) fp32 = rowsum(dO · O)
+    # (block_q, LANE) lane-broadcast stats → expand across the k lanes
+    lse = _stat_cols(lse_ref[0], block_k)
+    delta = _stat_cols(delta_ref[0], block_k)
 
     def body(i, dq_acc):
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
@@ -136,11 +153,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
 
-        p = jnp.exp(scores - lse[:, None])  # masked entries underflow to 0
+        p = jnp.exp(scores - lse)  # masked entries underflow to 0
         dp = jax.lax.dot_general(
             do.astype(v_blk.dtype), v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq_acc + jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -174,8 +191,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = carry
         q_blk = q_ref[0, pl.ds(j * block_q, block_q), :]
         do_blk = do_ref[0, pl.ds(j * block_q, block_q), :]
-        lse_blk = lse_ref[0, pl.ds(j * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(j * block_q, block_q)]
+        lse_blk = _stat_cols(lse_ref[0, pl.ds(j * block_q, block_q), :],
+                             block_k)
+        delta_blk = _stat_cols(delta_ref[0, pl.ds(j * block_q, block_q), :],
+                               block_k)
 
         scores = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
@@ -187,14 +206,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
 
-        p = jnp.exp(scores - lse_blk[:, None])
+        p = jnp.exp(scores - lse_blk)
         dv_acc = dv_acc + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None]) * scale
+        ds = p * (dp - delta_blk) * scale
         dk_acc = dk_acc + jax.lax.dot_general(
             ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -224,6 +243,9 @@ def _uses_kernel(q_shape, k_shape, causal, block_q, block_k) -> bool:
         return False
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
+    # The lane-broadcast stats layout needs Mosaic-tileable blocks
+    if jax.default_backend() == "tpu" and (block_q % 8 or block_k % LANE):
+        return False
     # Ragged shapes — and the degenerate causal s_q > s_k case, where
     # fully-masked query rows need the reference's uniform-softmax
     # treatment rather than a 0/0 accumulator — use the reference path
@@ -246,7 +268,9 @@ def flash_attention(q, k, v, causal: bool = True,
 
 def _flash_forward(q, k, v, causal, block_q, block_k):
     """Returns (out, lse) — lse is None on the reference fallback path,
-    (B·H, S_q) fp32 otherwise."""
+    (B·H, S_q, LANE) lane-broadcast fp32 otherwise (slice ``[:, :, 0]``
+    for the per-row value; kept 3-D so the backward can feed it straight
+    back into the kernels without re-materializing the broadcast)."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if not _uses_kernel(q.shape, k.shape, causal, block_q, block_k):
@@ -270,11 +294,11 @@ def _flash_forward(q, k, v, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANE), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, LANE), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -286,11 +310,13 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _run_bwd_kernels(q, k, v, g_out, out, lse, causal, block_q, block_k,
+def _run_bwd_kernels(q, k, v, g_out, out, lse_l, causal, block_q, block_k,
                      g_lse=None):
-    """Launch the two-pass backward kernels. ``g_lse`` (the lse output's
-    cotangent, when the caller exposed lse) folds into the row correction:
-    ds = p·(dp − (Δ − g_lse)), since ∂lse/∂s = p."""
+    """Launch the two-pass backward kernels. ``lse_l`` is the forward
+    kernel's (B·H, S_q, LANE) lane-broadcast statistic, fed back verbatim.
+    ``g_lse`` (the lse output's cotangent, when the caller exposed lse)
+    folds into the row correction: ds = p·(dp − (Δ − g_lse)), since
+    ∂lse/∂s = p."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
@@ -302,6 +328,8 @@ def _run_bwd_kernels(q, k, v, g_out, out, lse, causal, block_q, block_k,
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
+    # Lane-broadcast layout for the in-kernel stats (see LANE comment)
+    delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, LANE))
 
     interpret = jax.default_backend() == "cpu"
     offset = s_k - s_q
@@ -316,13 +344,13 @@ def _run_bwd_kernels(q, k, v, g_out, out, lse, causal, block_q, block_k,
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANE), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse_l, delta_l)
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                                    causal=causal, causal_offset=offset)
@@ -334,8 +362,8 @@ def _run_bwd_kernels(q, k, v, g_out, out, lse, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, s_q, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, s_q), lambda bh, ki: (bh, 0)),
-            pl.BlockSpec((1, s_q), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, s_q, LANE), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, s_q, LANE), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -346,7 +374,7 @@ def _run_bwd_kernels(q, k, v, g_out, out, lse, causal, block_q, block_k,
             jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse_l, delta_l)
 
     def unfold(x, s):
         return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -397,13 +425,13 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     adjustment (ds = p·(dp − (Δ − g_lse)), since ∂lse/∂s = p)."""
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k)
     if lse is None:  # reference fallback path
-        lse = _reference_lse(q, k, causal)
-    return out, lse
+        return out, _reference_lse(q, k, causal)
+    return out, lse[:, :, 0]
 
 
 def _flash_lse_fwd(q, k, v, causal, block_q, block_k):
     out, kernel_lse = _flash_forward(q, k, v, causal, block_q, block_k)
-    lse = (kernel_lse if kernel_lse is not None
+    lse = (kernel_lse[:, :, 0] if kernel_lse is not None
            else _reference_lse(q, k, causal))
     return (out, lse), (q, k, v, out, kernel_lse)
 
