@@ -461,6 +461,45 @@ def bench_device_allreduce(tiny: bool = False) -> dict:
     return result
 
 
+def bench_device_attention(tiny: bool = False) -> dict:
+    """Flash vs reference attention, fwd+bwd at the flagship shape — the
+    kernel-level evidence for the Pallas path (cheaper than a whole train
+    step: one small compile each)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from faabric_tpu.ops import flash_attention
+    from faabric_tpu.ops.flash_attention import _reference_attention
+
+    b, s, h, d = (2, 256, 4, 64) if tiny else (8, 512, 8, 64)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+
+    out: dict = {"shape": [b, s, h, d]}
+    for name, fn in [
+        ("flash", flash_attention),
+        ("reference", lambda q, k, v: _reference_attention(q, k, v)),
+    ]:
+        f = jax.jit(jax.grad(
+            lambda q, k, v, fn=fn: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        g = f(q, k, v)
+        jax.block_until_ready(g)
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = f(q, k, v)
+        jax.block_until_ready(g)
+        out[name + "_fwdbwd_ms"] = 1000 * (time.perf_counter() - t0) / iters
+    if out["flash_fwdbwd_ms"] > 0:
+        out["flash_speedup"] = (out["reference_fwdbwd_ms"]
+                                / out["flash_fwdbwd_ms"])
+    return out
+
+
 def bench_hbm_bandwidth() -> dict:
     """HBM read+write bandwidth via a big on-device copy-scale (x·2 over
     256 MiB touches 512 MiB of HBM traffic per iter)."""
@@ -503,12 +542,16 @@ def bench_device_phase(tiny: bool = False, out_path: str | None = None) -> dict:
             os.replace(tmp, out_path)
 
     flush()
+    # Cheapest sections first: a slow model-step compile through the TPU
+    # tunnel must never starve the sections that need only one small
+    # compile — a stage timeout then still leaves TPU numbers on disk
     for name, fn in [
+        ("hbm", bench_hbm_bandwidth),
+        ("allreduce", lambda: bench_device_allreduce(tiny)),
+        ("attention", lambda: bench_device_attention(tiny)),
         ("step", lambda: bench_device_step(tiny)),
         ("step_reference", lambda: bench_device_step(
             tiny, attention_impl="reference", norm_impl="reference")),
-        ("allreduce", lambda: bench_device_allreduce(tiny)),
-        ("hbm", bench_hbm_bandwidth),
     ]:
         try:
             results[name] = fn()
@@ -733,7 +776,8 @@ def main() -> None:
             # never produced a number
             if partial is not None and any(
                     k in partial for k in
-                    ("step", "allreduce", "hbm", "step_reference")):
+                    ("step", "allreduce", "hbm", "attention",
+                     "step_reference")):
                 return partial, err
             return None, err or "no results produced"
 

@@ -1,0 +1,25 @@
+"""Redis layer: pure-Python RESP2 client + in-repo mini server.
+
+Reference analog: src/redis/Redis.cpp (hiredis wrapper) and the
+dockerised redis service its deployments assume. Here the client speaks
+RESP2 directly (no client lib in the image) and the mini server makes
+``STATE_MODE=redis`` self-contained for tests/single-host runs.
+"""
+
+from faabric_tpu.redis.client import (
+    RedisClient,
+    RedisConnectionError,
+    RedisError,
+    clear_thread_clients,
+    get_redis,
+)
+from faabric_tpu.redis.miniserver import MiniRedisServer
+
+__all__ = [
+    "RedisClient",
+    "RedisConnectionError",
+    "RedisError",
+    "MiniRedisServer",
+    "clear_thread_clients",
+    "get_redis",
+]

@@ -3,10 +3,13 @@
 
     python -m faabric_tpu.runner planner [--port-offset N] [--http-port P]
     python -m faabric_tpu.runner worker --host IP [--slots N] [--devices N]
+    python -m faabric_tpu.runner redis [--port P]
 
 The planner role serves RPC + its snapshot server + the REST endpoint; the
 worker boots a full WorkerRuntime (function/PTP/snapshot/state servers,
-keep-alive registration). Both run until SIGTERM/SIGINT.
+keep-alive registration); the redis role runs the in-repo RESP server
+(the docker-compose `redis` service analog for STATE_MODE=redis
+deployments without an external Redis). All run until SIGTERM/SIGINT.
 """
 
 from __future__ import annotations
@@ -30,6 +33,10 @@ def main(argv: list[str] | None = None) -> int:
     p_planner.add_argument("--port-offset", type=int, default=0)
     p_planner.add_argument("--http-port", type=int, default=0,
                            help="REST endpoint port (0 = config default)")
+
+    p_redis = sub.add_parser("redis")
+    p_redis.add_argument("--port", type=int, default=6379)
+    p_redis.add_argument("--bind", default="127.0.0.1")
 
     p_worker = sub.add_parser("worker")
     p_worker.add_argument("--host", default="",
@@ -59,6 +66,14 @@ def main(argv: list[str] | None = None) -> int:
         stop.wait()
         endpoint.stop()
         server.stop()
+    elif args.role == "redis":
+        from faabric_tpu.redis import MiniRedisServer
+
+        srv = MiniRedisServer(host=args.bind, port=args.port)
+        srv.start()
+        logger.info("Mini redis up on %s:%d", args.bind, srv.port)
+        stop.wait()
+        srv.stop()
     else:
         from faabric_tpu.runner import WorkerRuntime
 

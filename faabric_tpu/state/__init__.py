@@ -2,6 +2,7 @@
 
 from faabric_tpu.state.backend import (
     MasterMemoryAuthority,
+    RedisAuthority,
     RemoteAuthority,
     SharedFileAuthority,
     StateAuthority,
@@ -18,6 +19,7 @@ from faabric_tpu.state.remote import (
 
 __all__ = [
     "MasterMemoryAuthority",
+    "RedisAuthority",
     "RemoteAuthority",
     "STATE_CHUNK_SIZE",
     "SharedFileAuthority",

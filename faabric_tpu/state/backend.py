@@ -14,8 +14,11 @@ in-memory master (src/state/InMemoryStateKeyValue.cpp:90-260) and Redis
   records in a side file. No master election, no RPC: the TPU-pod
   single-host analog of the reference's Redis mode (an authority
   outside any worker process that survives worker restarts).
-- ``redis``: raises with guidance unless a redis client is importable
-  (not shipped in this image; the interface slot is here).
+- ``redis``: a Redis server is the authority (GETRANGE/SETRANGE for
+  chunks, a list for appends, SET-NX-PX token for the lock) via the
+  pure-Python RESP client in :mod:`faabric_tpu.redis`; tests and
+  single-host runs use the in-repo MiniRedisServer, production points
+  ``REDIS_STATE_HOST`` at a real Redis.
 
 StateKeyValue keeps the chunked lazy-pull / dirty-push / append protocol
 and delegates every authority interaction to one of these objects — the
@@ -49,6 +52,12 @@ class StateAuthority:
 
     def push_chunk(self, offset: int, data: bytes) -> None:
         raise NotImplementedError
+
+    def push_chunks(self, writes: list[tuple[int, bytes]]) -> None:
+        """Batched multi-chunk push; backends with a wire protocol that
+        supports it (redis pipelining) override to one round-trip."""
+        for offset, data in writes:
+            self.push_chunk(offset, data)
 
     def append(self, data: bytes) -> None:
         raise NotImplementedError
@@ -314,8 +323,99 @@ class SharedFileAuthority(StateAuthority):
                 pass
 
 
-def make_redis_authority(*_a, **_k):  # pragma: no cover — no client lib
-    raise RuntimeError(
-        "STATE_MODE=redis needs the 'redis' client library, which this "
-        "image does not ship; use STATE_MODE=inmemory (planner-elected "
-        "masters) or STATE_MODE=file (shared-memory files)")
+class RedisAuthority(StateAuthority):
+    """The authority is a Redis server (``redis`` mode): value bytes in a
+    string key (GETRANGE/SETRANGE — the reference's pull/push mapping,
+    src/state/RedisStateKeyValue.cpp), appends in a list key, the global
+    lock a SET-NX-PX token key with TTL (so a crashed holder cannot wedge
+    the cluster). Speaks RESP via :mod:`faabric_tpu.redis` — works
+    against a real Redis or the in-repo MiniRedisServer."""
+
+    local = False
+
+    LOCK_ACQUIRE_TIMEOUT = 30.0
+    LOCK_TTL_MS = 60_000
+
+    def __init__(self, user: str, key: str, size: int) -> None:
+        self.user = user
+        self.key = key
+        self._key = f"fstate:{user}/{key}".encode()
+        self._append_key = self._key + b":append"
+        self._lock_key = self._key + b":lock"
+        self._lock_token: Optional[bytes] = None
+
+        cli = self._cli()
+        cur = cli.strlen(self._key)
+        if size > cur:
+            # Grow to the requested size (zero-fill, first creator sizes)
+            cli.setrange(self._key, size - 1, b"\x00")
+            cur = size
+        elif size <= 0 and cur <= 0:
+            raise ValueError(
+                f"State key {user}/{key} does not exist in redis yet; "
+                "creation needs an explicit size")
+        self.size = cur
+
+    @staticmethod
+    def _cli():
+        from faabric_tpu.redis import get_redis
+
+        return get_redis("state")
+
+    def pull_chunk(self, offset: int, length: int) -> bytes:
+        return self._cli().getrange(self._key, offset, offset + length - 1)
+
+    def push_chunk(self, offset: int, data: bytes) -> None:
+        if offset + len(data) > self.size:
+            raise ValueError("Pushed chunk out of bounds")
+        self._cli().setrange(self._key, offset, data)
+
+    def push_chunks(self, writes: list[tuple[int, bytes]]) -> None:
+        """Pipelined multi-chunk push, one round-trip (reference
+        setRangePipeline); kv.push_partial sends all dirty chunks here."""
+        for offset, data in writes:
+            if offset + len(data) > self.size:
+                raise ValueError("Pushed chunk out of bounds")
+        self._cli().setrange_pipeline(self._key, writes)
+
+    def append(self, data: bytes) -> None:
+        self._cli().rpush(self._append_key, data)
+
+    def get_appended(self, n_values: int) -> list[bytes]:
+        if n_values <= 0:
+            return []  # LRANGE 0 -1 would mean "whole list"
+        vals = self._cli().lrange(self._append_key, 0, n_values - 1)
+        if len(vals) < n_values:
+            raise ValueError(f"Only {len(vals)} appended values")
+        return vals
+
+    def clear_appended(self) -> None:
+        self._cli().delete(self._append_key)
+
+    def lock(self) -> None:
+        import time as _time
+        import uuid
+
+        token = uuid.uuid4().bytes
+        cli = self._cli()
+        deadline = _time.monotonic() + self.LOCK_ACQUIRE_TIMEOUT
+        while not cli.set_nx_px(self._lock_key, token, self.LOCK_TTL_MS):
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"Timed out acquiring global lock on "
+                    f"{self.user}/{self.key}")
+            _time.sleep(0.01)
+        self._lock_token = token
+
+    def unlock(self) -> None:
+        token, self._lock_token = self._lock_token, None
+        if token is None:
+            raise RuntimeError("unlock without lock")
+        self._cli().del_if_eq(self._lock_key, token)
+
+    def delete_keys(self) -> None:
+        self._cli().delete(self._key, self._append_key, self._lock_key)
+
+
+def make_redis_authority(user: str, key: str, size: int) -> RedisAuthority:
+    return RedisAuthority(user, key, size)

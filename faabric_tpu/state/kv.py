@@ -156,15 +156,18 @@ class StateKeyValue:
             return
         with self._lock:
             dirty = [int(c) for c in np.where(self._dirty)[0]]
-        if not dirty:
+            writes = []
+            for c in dirty:
+                lo = c * STATE_CHUNK_SIZE
+                hi = min(self.size, lo + STATE_CHUNK_SIZE)
+                writes.append((lo, self._data[lo:hi].tobytes()))
+        if not writes:
             return
-        for c in dirty:
-            lo = c * STATE_CHUNK_SIZE
-            hi = min(self.size, lo + STATE_CHUNK_SIZE)
-            with self._lock:
-                payload = self._data[lo:hi].tobytes()
-            self.authority.push_chunk(lo, payload)
-            with self._lock:
+        # One batched push: backends that can pipeline (redis) do all
+        # chunks in a single round-trip
+        self.authority.push_chunks(writes)
+        with self._lock:
+            for c in dirty:
                 self._dirty[c] = False
 
     def pull(self) -> None:

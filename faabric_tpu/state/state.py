@@ -46,13 +46,11 @@ class State:
         if mode in ("file", "shm"):
             kv = self._make_file_kv(user, key, size, conf)
         elif mode == "redis":
-            from faabric_tpu.state.backend import make_redis_authority
+            from faabric_tpu.state.backend import RedisAuthority
 
-            # Currently raises with guidance; a future client-lib-backed
-            # authority slots in here
-            kv = StateKeyValue(user, key, size, False, "<redis>",
-                               authority=make_redis_authority(user, key,
-                                                              size))
+            authority = RedisAuthority(user, key, size)
+            kv = StateKeyValue(user, key, authority.size, False, "<redis>",
+                               authority=authority)
         elif mode != "inmemory":
             raise ValueError(f"Unknown STATE_MODE {mode!r}")
         else:

@@ -1,0 +1,237 @@
+"""Redis layer: RESP client <-> mini server, and STATE_MODE=redis.
+
+Reference analog: tests/test/redis/test_redis.cpp (wrapper ops) and
+tests/test/state/test_state.cpp redis-mode sections.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from faabric_tpu.redis import (
+    MiniRedisServer,
+    RedisClient,
+    RedisError,
+    clear_thread_clients,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = MiniRedisServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    cli = RedisClient("127.0.0.1", server.port)
+    yield cli
+    cli.close()
+
+
+def test_strings_ranges_counters(client):
+    assert client.ping()
+    assert client.get("missing") is None
+    client.set("k", b"hello world")
+    assert client.get("k") == b"hello world"
+    assert client.strlen("k") == 11
+    assert client.getrange("k", 0, 4) == b"hello"
+    assert client.getrange("k", -5, -1) == b"world"
+    client.setrange("k", 6, b"redis")
+    assert client.get("k") == b"hello redis"
+    # setrange beyond end zero-fills
+    client.setrange("k2", 4, b"xy")
+    assert client.get("k2") == b"\x00\x00\x00\x00xy"
+    assert client.append("k2", b"z") == 7
+    assert client.incr("n") == 1
+    assert client.incrby("n", 10) == 11
+    assert client.decr("n") == 10
+    assert client.exists("k")
+    assert client.delete("k", "n") == 2
+    assert not client.exists("k")
+
+
+def test_set_nx_px_and_expiry(client):
+    assert client.set_nx_px("lock", b"tok1", 100)
+    assert not client.set_nx_px("lock", b"tok2", 100)
+    time.sleep(0.15)
+    # TTL elapsed: the key is gone and NX succeeds again
+    assert client.set_nx_px("lock", b"tok3", 10_000)
+    assert client.get("lock") == b"tok3"
+    assert client.del_if_eq("lock", b"wrong") is False
+    assert client.del_if_eq("lock", b"tok3") is True
+    assert client.get("lock") is None
+
+
+def test_sets_and_lists(client):
+    assert client.sadd("s", b"a", b"b") == 2
+    assert client.sadd("s", b"a") == 0
+    assert client.smembers("s") == {b"a", b"b"}
+    assert client.sismember("s", b"a")
+    assert client.scard("s") == 2
+    assert client.srem("s", b"a") == 1
+
+    client.rpush("q", b"1", b"2")
+    client.lpush("q", b"0")
+    assert client.llen("q") == 3
+    assert client.lrange("q", 0, -1) == [b"0", b"1", b"2"]
+    assert client.lpop("q") == b"0"
+    assert client.rpop("q") == b"2"
+
+
+def test_blpop_blocks_until_push(server, client):
+    other = RedisClient("127.0.0.1", server.port)
+    got = {}
+
+    def consumer():
+        got["v"] = client.blpop("bq", timeout_s=5)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.1)
+    other.rpush("bq", b"payload")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["v"] == b"payload"
+    assert other.blpop("bq", timeout_s=0.1) is None
+    other.close()
+
+
+def test_wrongtype_and_unknown_command(client):
+    client.set("str", b"x")
+    with pytest.raises(RedisError):
+        client.rpush("str", b"y")
+    with pytest.raises(RedisError):
+        client.execute("NOSUCHCMD")
+    # connection still usable after errors
+    assert client.ping()
+
+
+def test_pipeline(client):
+    replies = client.pipeline([("SET", "p", b"abcdef"),
+                               ("GETRANGE", "p", 1, 3),
+                               ("STRLEN", "p")])
+    assert replies[1] == b"bcd"
+    assert replies[2] == 6
+    client.setrange_pipeline("p", [(0, b"XY"), (4, b"ZW")])
+    assert client.get("p") == b"XYcdZW"
+
+
+def test_pipeline_error_keeps_stream_in_sync(client):
+    client.set("pstr", b"x")
+    # Middle command errors (WRONGTYPE); all replies are still drained,
+    # so the connection stays usable and in sync afterwards
+    with pytest.raises(RedisError):
+        client.pipeline([("SET", "pk", b"1"),
+                         ("RPUSH", "pstr", b"y"),
+                         ("SET", "pk2", b"2")])
+    assert client.get("pk") == b"1"
+    assert client.get("pk2") == b"2"
+    assert client.ping()
+
+
+def test_eval_delifeq_and_unsupported_script(client):
+    client.set("lk", b"tok")
+    assert client.del_if_eq("lk", b"tok") is True
+    assert client.get("lk") is None
+    assert client.del_if_eq("lk", b"tok") is False
+    with pytest.raises(RedisError):
+        client.execute("EVAL", "return 1", 0)
+
+
+def test_server_survives_garbage(server, client):
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", server.port))
+    s.sendall(b"not resp at all\r\n")
+    s.close()
+    # truncated frame: header claims a bulk string, sender dies
+    s2 = socket.create_connection(("127.0.0.1", server.port))
+    s2.sendall(b"*2\r\n$3\r\nGET\r\n$100\r\nshort")
+    s2.close()
+    assert client.ping()
+
+
+@pytest.fixture()
+def redis_state_env(server):
+    os.environ["STATE_MODE"] = "redis"
+    os.environ["REDIS_STATE_HOST"] = "127.0.0.1"
+    os.environ["REDIS_PORT"] = str(server.port)
+    from faabric_tpu.util.config import get_system_config
+
+    get_system_config().reset()
+    yield server
+    for k in ("STATE_MODE", "REDIS_STATE_HOST", "REDIS_PORT"):
+        os.environ.pop(k, None)
+    get_system_config().reset()
+    clear_thread_clients()
+
+
+def test_state_mode_redis_end_to_end(redis_state_env):
+    from faabric_tpu.state import State
+
+    # Two "hosts" (separate State instances) sharing the redis authority
+    a = State("hostA")
+    b = State("hostB")
+
+    kv_a = a.get_kv("user", "key", 10_000)
+    data = (bytes(range(256)) * 40)[:10_000]
+    kv_a.set(data)
+    kv_a.push_full()
+
+    kv_b = b.get_kv("user", "key")  # size discovered from redis
+    assert kv_b.size == 10_000
+    assert kv_b.get() == data
+
+    # Partial push from B is visible to a fresh pull on A
+    kv_b.set_chunk(5000, b"HELLO")
+    kv_b.push_partial()
+    kv_a.pull()
+    assert kv_a.get_chunk(5000, 5) == b"HELLO"
+
+    # Appends travel through the list key
+    kv_a.append(b"one")
+    kv_b.append(b"two")
+    assert kv_a.get_appended(2) == [b"one", b"two"]
+    assert kv_a.get_appended(0) == []  # not "whole list" (LRANGE 0 -1)
+    kv_b.clear_appended()
+    with pytest.raises(ValueError):
+        kv_a.get_appended(1)
+
+    a.clear()
+    b.clear()
+
+
+def test_state_redis_global_lock_mutual_exclusion(redis_state_env):
+    from faabric_tpu.state import State
+
+    st = State("hostA")
+    kv = st.get_kv("user", "locked", 64)
+    order = []
+
+    def contender():
+        kv2 = State("hostB").get_kv("user", "locked")
+        kv2.lock_global()
+        order.append("B")
+        kv2.unlock_global()
+
+    kv.lock_global()
+    order.append("A")
+    t = threading.Thread(target=contender)
+    t.start()
+    time.sleep(0.2)
+    assert order == ["A"]  # B still waiting on the token
+    kv.unlock_global()
+    t.join(timeout=10)
+    assert order == ["A", "B"]
+
+
+def test_redis_authority_creation_needs_size(redis_state_env):
+    from faabric_tpu.state import State
+
+    with pytest.raises(ValueError, match="explicit size"):
+        State("hostA").get_kv("user", "never-created")
