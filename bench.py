@@ -472,6 +472,11 @@ def bench_device_attention(tiny: bool = False) -> dict:
     from faabric_tpu.ops import flash_attention
     from faabric_tpu.ops.flash_attention import _reference_attention
 
+    if jax.default_backend() != "tpu":
+        # Interpret-mode Pallas (CPU) is an emulator — timing it says
+        # nothing; the flash-vs-reference comparison is TPU-only
+        return {"skipped": "flash kernel micro-bench is TPU-only"}
+
     b, s, h, d = (2, 256, 4, 64) if tiny else (8, 512, 8, 64)
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)

@@ -3,21 +3,25 @@
 Long-context first-class: for sequences too large for one chip's HBM, Q/K/V
 shard along the sequence over the ``sp`` mesh axis. Each device keeps its Q
 shard resident and the K/V shards rotate around the ring with
-``jax.lax.ppermute`` — ICI neighbour hops — while an online-softmax
-accumulator (running max / sum / weighted values, all fp32) folds each
-block in. Communication overlaps compute in XLA's pipeline; the full
-(S, S) score matrix never exists anywhere.
+``jax.lax.ppermute`` — ICI neighbour hops. Each rotation step computes its
+(Q-block, KV-block) attention through the **Pallas flash kernel**
+(ops/flash_attention.py) and folds the partial result in with the
+flash-decoding (out, lse) merge: the diagonal block runs the causal
+kernel, fully-visible past blocks the non-causal kernel, and fully-masked
+future blocks skip both matmuls entirely via ``lax.switch`` (the previous
+jnp path materialized an (S_l, S_l) fp32 score block per step and spent
+half the ring's FLOPs computing scores it then masked). Communication
+overlaps compute in XLA's pipeline; the full (S, S) score matrix never
+exists anywhere, and per-step peak memory is the kernel's O(S_l·D).
 
 This is the sequence-parallel analog of the reference's "scale memory
 beyond one host" capability (SURVEY §5.7); same recurrence as the Pallas
-flash kernel (ops/flash_attention.py), one level up the hierarchy.
+flash kernel, one level up the hierarchy.
 """
 
 from __future__ import annotations
 
 import functools
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -25,8 +29,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
     from jax import shard_map
+
+    _NO_CHECK_KW = "check_vma"
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
+
+    _NO_CHECK_KW = "check_rep"
 
 NEG_INF = -1e30
 
@@ -78,67 +86,76 @@ def _compiled_ring(mesh: Mesh, axis: str, causal: bool,
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def local_fn(q_blk, k_blk, v_blk):
+        from faabric_tpu.ops.flash_attention import (
+            flash_attention_with_lse,
+            merge_attention_blocks,
+        )
+
         # shapes (B, S_l, H, D)
-        s_l = q_blk.shape[1]
+        b, s_l, h, d = q_blk.shape
         my_idx = jax.lax.axis_index(axis)
-        scale = 1.0 / np.sqrt(q_blk.shape[-1])
-        qf = q_blk.astype(jnp.float32) * scale
 
-        b, _, h, d = q_blk.shape
-        m0 = jnp.full((b, h, s_l), NEG_INF, dtype=jnp.float32)
-        l0 = jnp.zeros((b, h, s_l), dtype=jnp.float32)
-        acc0 = jnp.zeros((b, s_l, h, d), dtype=jnp.float32)
-        varying_axes = tuple(a for a in (axis, batch_axis, head_axis)
-                             if a is not None)
-        m0, l0, acc0 = (_mark_varying(x, varying_axes)
-                        for x in (m0, l0, acc0))
+        # (the shard_map runs with the varying check off, so fresh
+        # constants need no pcast marking here)
+        acc0 = jnp.zeros((b, s_l, h, d), jnp.float32)
+        lse0 = jnp.full((b * h, s_l), NEG_INF, jnp.float32)
 
-        def fold(i, m_prev, l_prev, acc, k_cur, v_cur):
+        # Per-block attention: each branch returns (out (B,S_l,H,D) in
+        # the input dtype, lse (B·H, S_l) fp32)
+        def diag_block(q, k, v):
+            return flash_attention_with_lse(q, k, v, True)
+
+        def full_block(q, k, v):
+            return flash_attention_with_lse(q, k, v, False)
+
+        def skip_block(q, k, v):
+            # Fully-masked future block: neutral element of the merge
+            return (jnp.zeros_like(q),
+                    jnp.full((b * h, s_l), NEG_INF, jnp.float32))
+
+        def fold(i, acc, lse_acc, k_cur, v_cur):
             kv_idx = (my_idx - i) % n
-
-            scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                                k_cur.astype(jnp.float32))
             if causal:
-                q_pos = my_idx * s_l + jax.lax.broadcasted_iota(
-                    jnp.int32, (s_l, s_l), 0)
-                k_pos = kv_idx * s_l + jax.lax.broadcasted_iota(
-                    jnp.int32, (s_l, s_l), 1)
-                mask = q_pos >= k_pos
-                scores = jnp.where(mask[None, None], scores, NEG_INF)
-
-            m_cur = jnp.max(scores, axis=-1)
-            m_new = jnp.maximum(m_prev, m_cur)
-            correction = jnp.exp(m_prev - m_new)
-            p = jnp.exp(scores - m_new[..., None])
-            l_new = l_prev * correction + jnp.sum(p, axis=-1)
-            acc_new = acc * correction.transpose(0, 2, 1)[..., None] \
-                + jnp.einsum("bhqk,bkhd->bqhd", p,
-                             v_cur.astype(jnp.float32))
-            return m_new, l_new, acc_new
+                # 0: diagonal (causal kernel), 1: past (full kernel),
+                # 2: future (skip — no matmuls at all)
+                rel = jnp.where(kv_idx == my_idx, 0,
+                                jnp.where(kv_idx < my_idx, 1, 2))
+                out_blk, lse_blk = jax.lax.switch(
+                    rel, [diag_block, full_block, skip_block],
+                    q_blk, k_cur, v_cur)
+            else:
+                out_blk, lse_blk = full_block(q_blk, k_cur, v_cur)
+            # Flash-decoding combine (acc stays fp32: it's outs[0], and
+            # merge_attention_blocks casts to the first operand's dtype)
+            return merge_attention_blocks([acc, out_blk],
+                                          [lse_acc, lse_blk])
 
         def step(i, carry):
-            m_prev, l_prev, acc, k_cur, v_cur = carry
-            m_new, l_new, acc_new = fold(i, m_prev, l_prev, acc, k_cur, v_cur)
+            acc, lse_acc, k_cur, v_cur = carry
+            acc, lse_acc = fold(i, acc, lse_acc, k_cur, v_cur)
             # Rotate K/V to the next ring neighbour (ICI hop)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return m_new, l_new, acc_new, k_nxt, v_nxt
+            return acc, lse_acc, k_nxt, v_nxt
 
         # Steps 0..n-2 fold-then-rotate; the final block folds outside the
         # loop so no rotation result is ever discarded (2 ICI hops saved)
-        m, l, acc, k_last, v_last = jax.lax.fori_loop(
-            0, n - 1, step, (m0, l0, acc0, k_blk, v_blk))
-        m, l, acc = fold(n - 1, m, l, acc, k_last, v_last)
-        # Guard fully-masked rows (l == 0 cannot happen causally for row 0
-        # of block 0 since the diagonal is unmasked, but stay safe)
-        l = jnp.maximum(l, 1e-30)
-        out = acc / l.transpose(0, 2, 1)[..., None]
-        return out.astype(q_blk.dtype)
+        acc, lse_acc, k_last, v_last = jax.lax.fori_loop(
+            0, n - 1, step, (acc0, lse0, k_blk, v_blk))
+        acc, _ = fold(n - 1, acc, lse_acc, k_last, v_last)
+        # acc is the normalized union already (merge of normalized
+        # partials); causal rows always see their diagonal, so no
+        # fully-masked rows exist
+        return acc.astype(q_blk.dtype)
 
     spec = P(batch_axis, axis, head_axis, None)
+    # Varying-check off: pallas_call's out_shape carries no varying-mesh-
+    # axes annotation (same trade as the model's flash path,
+    # models/transformer.py)
     return jax.jit(shard_map(local_fn, mesh=mesh,
                              in_specs=(spec, spec, spec),
-                             out_specs=spec))
+                             out_specs=spec,
+                             **{_NO_CHECK_KW: False}))
 
 
 def shard_sequence(x, mesh: Mesh, axis: str = "sp"):

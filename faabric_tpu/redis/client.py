@@ -50,7 +50,7 @@ class RedisClient:
         self.port = port
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
-        self._buf = b""
+        self._buf = bytearray()
 
     # -- connection ----------------------------------------------------
     def _connect(self) -> socket.socket:
@@ -64,7 +64,7 @@ class RedisClient:
                 ) from e
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
-            self._buf = b""
+            self._buf = bytearray()
         return self._sock
 
     def close(self) -> None:
@@ -73,38 +73,63 @@ class RedisClient:
                 self._sock.close()
             finally:
                 self._sock = None
-                self._buf = b""
+                self._buf = bytearray()
 
-    # -- RESP parsing ---------------------------------------------------
+    # -- RESP parsing (bytearray accumulation + recv_into for bulk
+    # payloads — naive `bytes += chunk` would be O(n^2) on the
+    # multi-hundred-MiB state pulls this backend exists for) -----------
     def _read_line(self) -> bytes:
         sock = self._connect()
-        while b"\r\n" not in self._buf:
+        buf = self._buf
+        while True:
+            idx = buf.find(b"\r\n")
+            if idx >= 0:
+                line = bytes(buf[:idx])
+                del buf[:idx + 2]
+                return line
             chunk = sock.recv(65536)
             if not chunk:
                 self.close()
                 raise RedisConnectionError("redis connection closed")
-            self._buf += chunk
-        line, self._buf = self._buf.split(b"\r\n", 1)
-        return line
+            buf.extend(chunk)
 
     def _read_exact(self, n: int) -> bytes:
+        buf = self._buf
+        if len(buf) >= n:
+            out = bytes(buf[:n])
+            del buf[:n]
+            return out
         sock = self._connect()
-        while len(self._buf) < n:
-            chunk = sock.recv(65536)
-            if not chunk:
+        out = bytearray(n)
+        got = len(buf)
+        out[:got] = buf
+        buf.clear()
+        mv = memoryview(out)
+        while got < n:
+            k = sock.recv_into(mv[got:])
+            if not k:
                 self.close()
                 raise RedisConnectionError("redis connection closed")
-            self._buf += chunk
-        out, self._buf = self._buf[:n], self._buf[n:]
-        return out
+            got += k
+        return bytes(out)
 
     def _read_reply(self):
+        reply = self._read_reply_any()
+        if isinstance(reply, RedisError):
+            raise reply
+        return reply
+
+    def _read_reply_any(self):
+        """Parse one reply, returning errors as RedisError VALUES — the
+        whole reply (including every element of an array that embeds an
+        error) is always consumed, so the stream stays in sync; only the
+        top level raises."""
         line = self._read_line()
         kind, rest = line[:1], line[1:]
         if kind == b"+":
             return rest
         if kind == b"-":
-            raise RedisError(rest.decode(errors="replace"))
+            return RedisError(rest.decode(errors="replace"))
         if kind == b":":
             return int(rest)
         if kind == b"$":
@@ -118,7 +143,12 @@ class RedisClient:
             n = int(rest)
             if n < 0:
                 return None
-            return [self._read_reply() for _ in range(n)]
+            items = [self._read_reply_any() for _ in range(n)]
+            for it in items:
+                if isinstance(it, RedisError):
+                    return it  # array fully drained; surface the error
+            return items
+        self.close()  # unparseable stream — cannot stay in sync
         raise RedisError(f"Bad RESP type byte {kind!r}")
 
     # -- command execution ---------------------------------------------
@@ -147,10 +177,7 @@ class RedisClient:
         try:
             self._connect().sendall(payload)
             for _ in commands:
-                try:
-                    replies.append(self._read_reply())
-                except RedisError as e:
-                    replies.append(e)
+                replies.append(self._read_reply_any())
         except (OSError, RedisConnectionError):
             self.close()
             raise

@@ -122,34 +122,49 @@ class MiniRedisServer:
 
     # -- RESP parsing ---------------------------------------------------
     def _serve_conn(self, conn: socket.socket) -> None:
-        buf = b""
+        # bytearray accumulation + recv_into for bulk payloads (bytes +=
+        # would be O(n^2) on multi-MiB SETRANGE bodies)
+        buf = bytearray()
 
         def read_more() -> bool:
-            nonlocal buf
             try:
                 chunk = conn.recv(65536)
             except OSError:
                 return False
             if not chunk:
                 return False
-            buf += chunk
+            buf.extend(chunk)
             return True
 
         def read_line() -> Optional[bytes]:
-            nonlocal buf
-            while b"\r\n" not in buf:
+            while True:
+                idx = buf.find(b"\r\n")
+                if idx >= 0:
+                    line = bytes(buf[:idx])
+                    del buf[:idx + 2]
+                    return line
                 if not read_more():
                     return None
-            line, buf = buf.split(b"\r\n", 1)
-            return line
 
         def read_exact(n: int) -> Optional[bytes]:
-            nonlocal buf
-            while len(buf) < n:
-                if not read_more():
+            if len(buf) >= n:
+                out = bytes(buf[:n])
+                del buf[:n]
+                return out
+            out = bytearray(n)
+            got = len(buf)
+            out[:got] = buf
+            buf.clear()
+            mv = memoryview(out)
+            while got < n:
+                try:
+                    k = conn.recv_into(mv[got:])
+                except OSError:
                     return None
-            out, buf = buf[:n], buf[n:]
-            return out
+                if not k:
+                    return None
+                got += k
+            return bytes(out)
 
         try:
             while not self._stop.is_set():

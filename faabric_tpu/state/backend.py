@@ -342,7 +342,12 @@ class RedisAuthority(StateAuthority):
         self._key = f"fstate:{user}/{key}".encode()
         self._append_key = self._key + b":append"
         self._lock_key = self._key + b":lock"
-        self._lock_token: Optional[bytes] = None
+        # Token is thread-local: authorities are shared across threads
+        # through the cached StateKeyValue, and a TTL expiry means two
+        # threads can hold (what they think is) the lock concurrently —
+        # a shared token slot would let one thread's unlock delete the
+        # other's live lock
+        self._lock_tls = threading.local()
 
         cli = self._cli()
         cur = cli.strlen(self._key)
@@ -405,10 +410,11 @@ class RedisAuthority(StateAuthority):
                     f"Timed out acquiring global lock on "
                     f"{self.user}/{self.key}")
             _time.sleep(0.01)
-        self._lock_token = token
+        self._lock_tls.token = token
 
     def unlock(self) -> None:
-        token, self._lock_token = self._lock_token, None
+        token = getattr(self._lock_tls, "token", None)
+        self._lock_tls.token = None
         if token is None:
             raise RuntimeError("unlock without lock")
         self._cli().del_if_eq(self._lock_key, token)

@@ -156,19 +156,25 @@ class StateKeyValue:
             return
         with self._lock:
             dirty = [int(c) for c in np.where(self._dirty)[0]]
-            writes = []
-            for c in dirty:
-                lo = c * STATE_CHUNK_SIZE
-                hi = min(self.size, lo + STATE_CHUNK_SIZE)
-                writes.append((lo, self._data[lo:hi].tobytes()))
-        if not writes:
+        if not dirty:
             return
-        # One batched push: backends that can pipeline (redis) do all
-        # chunks in a single round-trip
-        self.authority.push_chunks(writes)
-        with self._lock:
-            for c in dirty:
-                self._dirty[c] = False
+        # Batched pushes (backends that can pipeline — redis — send each
+        # group in one round-trip), bounded to a few MiB per group so a
+        # fully-dirty multi-GiB value neither doubles peak memory nor
+        # holds the kv lock for the whole payload copy
+        group_chunks = max(1, (4 << 20) // STATE_CHUNK_SIZE)
+        for g in range(0, len(dirty), group_chunks):
+            group = dirty[g:g + group_chunks]
+            with self._lock:
+                writes = []
+                for c in group:
+                    lo = c * STATE_CHUNK_SIZE
+                    hi = min(self.size, lo + STATE_CHUNK_SIZE)
+                    writes.append((lo, self._data[lo:hi].tobytes()))
+            self.authority.push_chunks(writes)
+            with self._lock:
+                for c in group:
+                    self._dirty[c] = False
 
     def pull(self) -> None:
         """Re-pull the whole value from the master."""
