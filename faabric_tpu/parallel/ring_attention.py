@@ -27,14 +27,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-
-    _NO_CHECK_KW = "check_vma"
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
-    _NO_CHECK_KW = "check_rep"
+# Single source for the shard_map version shim (check_vma vs check_rep)
+from faabric_tpu.parallel.collectives import (
+    _SHARD_MAP_NO_CHECK_KW as _NO_CHECK_KW,
+    shard_map,
+)
 
 NEG_INF = -1e30
 

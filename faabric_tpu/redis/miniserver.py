@@ -194,6 +194,12 @@ class MiniRedisServer:
                     except ValueError:
                         ok = False
                         break
+                    # Bulk length is client-supplied and read_exact
+                    # preallocates it — cap before a bogus $1099511627776
+                    # header turns into a TiB allocation
+                    if ln < 0 or ln > 1 << 30:
+                        conn.sendall(b"-ERR protocol: bulk too large\r\n")
+                        return
                     body = read_exact(ln)
                     if body is None or read_exact(2) is None:
                         ok = False

@@ -9,6 +9,10 @@ from faabric_tpu.snapshot.snapshot import (
     SnapshotDiff,
     SnapshotMergeOperation,
 )
+from faabric_tpu.snapshot.device_snapshot import (
+    DEVICE_PAGE_SIZE,
+    DeviceSnapshot,
+)
 from faabric_tpu.snapshot.registry import SnapshotRegistry
 from faabric_tpu.snapshot.remote import (
     SnapshotCalls,
@@ -21,7 +25,9 @@ from faabric_tpu.snapshot.remote import (
 )
 
 __all__ = [
+    "DEVICE_PAGE_SIZE",
     "DIFF_CHUNK",
+    "DeviceSnapshot",
     "MergeRegion",
     "SnapshotCalls",
     "SnapshotClient",
