@@ -5,10 +5,12 @@ from faabric_tpu.mpi.types import (
     MpiMessageType,
     MpiOp,
     MpiStatus,
+    UserOp,
     apply_op,
     mpi_dtype_for,
     np_dtype_for,
 )
+from faabric_tpu.mpi.window import MpiWindow
 from faabric_tpu.mpi.world import MAIN_RANK, MpiWorld
 from faabric_tpu.mpi.registry import MpiContext, MpiWorldRegistry, get_mpi_context
 
@@ -19,8 +21,10 @@ __all__ = [
     "MpiMessageType",
     "MpiOp",
     "MpiStatus",
+    "MpiWindow",
     "MpiWorld",
     "MpiWorldRegistry",
+    "UserOp",
     "apply_op",
     "get_mpi_context",
     "mpi_dtype_for",

@@ -19,7 +19,7 @@ from typing import Optional
 
 import numpy as np
 
-from faabric_tpu.mpi.types import MpiOp, MpiStatus
+from faabric_tpu.mpi.types import MpiOp, MpiStatus, UserOp
 from faabric_tpu.mpi.world import MpiWorld
 
 MPI_COMM_WORLD = "MPI_COMM_WORLD"
@@ -82,6 +82,7 @@ def _bind(world: MpiWorld, rank: int) -> None:
     _tls.world = world
     _tls.rank = rank
     _tls.start_time = time.monotonic()
+    _tls.finalized = False
 
 
 def _current(comm=MPI_COMM_WORLD) -> tuple[MpiWorld, int]:
@@ -126,7 +127,38 @@ def mpi_initialized() -> bool:
 
 def mpi_finalize() -> int:
     _tls.world = None
+    _tls.finalized = True
     return MPI_SUCCESS
+
+
+def mpi_finalized() -> bool:
+    return bool(getattr(_tls, "finalized", False))
+
+
+# Thread-support levels (reference mpi.h MPI_THREAD_*)
+MPI_THREAD_SINGLE = 0
+MPI_THREAD_FUNNELED = 1
+MPI_THREAD_SERIALIZED = 2
+MPI_THREAD_MULTIPLE = 3
+
+
+def mpi_init_thread(required: int = MPI_THREAD_SERIALIZED,
+                    world_size: int | None = None,
+                    world_id: int | None = None) -> int:
+    """MPI_Init_thread: ranks here are one-thread-per-rank with TLS world
+    binding, so the provided level is SERIALIZED."""
+    mpi_init(world_size, world_id)
+    return min(required, MPI_THREAD_SERIALIZED)
+
+
+def mpi_query_thread() -> int:
+    return MPI_THREAD_SERIALIZED
+
+
+def mpi_get_version() -> tuple[int, int]:
+    """The MPI standard version this subset tracks (as the reference's
+    header does): 3.1."""
+    return (3, 1)
 
 
 def mpi_abort(comm=MPI_COMM_WORLD, errorcode: int = 1) -> None:
@@ -162,6 +194,14 @@ def mpi_send(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
     world, rank = _current(comm)
     world.send(rank, dest, np.asarray(buf))
     return MPI_SUCCESS
+
+
+def mpi_rsend(buf, dest: int, comm=MPI_COMM_WORLD) -> int:
+    """MPI_Rsend: ready-mode send — the 'receiver is already posted'
+    contract adds nothing over the buffered channel, so it is a plain
+    send (the reference shim throws; OpenMPI treats rsend == send on
+    most transports too)."""
+    return mpi_send(buf, dest, comm)
 
 
 def mpi_recv(source: int, comm=MPI_COMM_WORLD
@@ -241,14 +281,75 @@ def mpi_test(request, comm=MPI_COMM_WORLD
     return True, world.await_async(rank, rid)
 
 
+def mpi_request_free(request, comm=MPI_COMM_WORLD) -> int:
+    """MPI_Request_free: drop the handle without waiting. Sends complete
+    in their worker; a freed irecv's already-arrived message is consumed
+    and discarded so it can't leak into a later unrelated recv."""
+    world, rank, rid = _resolve_request(request, comm)
+    world.request_free(rank, rid)
+    return MPI_SUCCESS
+
+
+class MpiContiguousType:
+    """Derived datatype from MPI_Type_contiguous: ``count`` elements of a
+    base type. mpi_type_size resolves it; commit/free are lifecycle
+    no-ops (the reference shim logs and returns for these)."""
+
+    __slots__ = ("base", "count", "committed")
+
+    def __init__(self, base, count: int) -> None:
+        self.base = base
+        self.count = count
+        self.committed = False
+
+
+def mpi_type_contiguous(count: int, oldtype) -> MpiContiguousType:
+    return MpiContiguousType(oldtype, count)
+
+
+def mpi_type_commit(newtype: MpiContiguousType) -> int:
+    newtype.committed = True
+    return MPI_SUCCESS
+
+
+def mpi_type_free(newtype: MpiContiguousType) -> int:
+    newtype.committed = False
+    return MPI_SUCCESS
+
+
 def mpi_type_size(dtype) -> int:
-    """MPI_Type_size over the framework's datatype enum or a numpy
-    dtype."""
+    """MPI_Type_size over the framework's datatype enum, a numpy dtype,
+    or a derived contiguous type."""
     from faabric_tpu.mpi.types import MpiDataType, np_dtype_for
 
+    if isinstance(dtype, MpiContiguousType):
+        return dtype.count * mpi_type_size(dtype.base)
     if isinstance(dtype, (int, MpiDataType)):
         return int(np_dtype_for(MpiDataType(int(dtype))).itemsize)
     return int(np.dtype(dtype).itemsize)
+
+
+def mpi_op_create(fn, commute: bool = True, name: str = "user_op") -> UserOp:
+    """MPI_Op_create: a user reduction ``fn(a, b) -> array`` usable in
+    reduce/allreduce/scan/reduce_scatter (the reference shim throws
+    notImplemented for user ops; here they ride the same leader-tree
+    collectives as the built-ins)."""
+    return UserOp(fn, commute, name)
+
+
+def mpi_op_free(op: UserOp) -> int:
+    return MPI_SUCCESS
+
+
+def mpi_alloc_mem(nbytes: int) -> np.ndarray:
+    """MPI_Alloc_mem: page-aligned byte buffer (util.memory allocator)."""
+    from faabric_tpu.util.memory import allocate_buffer
+
+    return allocate_buffer(nbytes)
+
+
+def mpi_free_mem(buf) -> int:
+    return MPI_SUCCESS  # numpy buffers are GC-owned
 
 
 def mpi_reduce_scatter(sendbuf, op: MpiOp, comm=MPI_COMM_WORLD
@@ -327,6 +428,24 @@ def mpi_alltoallv(sendbuf, send_counts, comm=MPI_COMM_WORLD
 def mpi_allgather(sendbuf, comm=MPI_COMM_WORLD) -> np.ndarray:
     world, rank = _current(comm)
     return world.allgather(rank, np.asarray(sendbuf))
+
+
+def mpi_allgatherv(sendbuf, comm=MPI_COMM_WORLD
+                   ) -> tuple[np.ndarray, list[int]]:
+    """MPI_Allgatherv (the reference shim throws notImplemented):
+    variable-count gather to root + two broadcasts. Every rank returns
+    (concatenated values in rank order, per-rank counts)."""
+    world, rank = _current(comm)
+    res = world.gatherv(rank, 0, np.asarray(sendbuf))
+    if rank == 0:
+        data, counts = res
+        counts_arr = np.asarray(counts, np.int64)
+        world.broadcast(0, rank, counts_arr)
+        world.broadcast(0, rank, data)
+        return data, list(counts)
+    counts_arr = np.asarray(world.broadcast(0, rank, np.empty(0, np.int64)))
+    data = np.asarray(world.broadcast(0, rank, np.empty(0)))
+    return data, [int(c) for c in counts_arr]
 
 
 def mpi_reduce(sendbuf, op: MpiOp, root: int, comm=MPI_COMM_WORLD
@@ -461,6 +580,67 @@ def mpi_comm_create(group: list[int], comm=MPI_COMM_WORLD
     if sub is None:
         return MPI_COMM_NULL
     return MpiComm(sub, new_rank)
+
+
+# ---------------------------------------------------------------------------
+# One-sided (shared windows — mpi/window.py; the reference shim stubs all
+# of MPI_Win_*/Put/Get with notImplemented)
+# ---------------------------------------------------------------------------
+
+def mpi_win_allocate_shared(size: int, comm=MPI_COMM_WORLD):
+    """MPI_Win_allocate_shared: collective over a host-local communicator
+    (use mpi_comm_split_type(MPI_COMM_TYPE_SHARED) first on multi-host
+    worlds). Returns (window, own byte segment view)."""
+    from faabric_tpu.mpi.window import allocate_shared
+
+    world, rank = _current(comm)
+    win = allocate_shared(world, rank, size)
+    return win, win.segment()
+
+
+def mpi_win_shared_query(win, rank: int) -> tuple[np.ndarray, int]:
+    """(segment view, size) of another rank's share."""
+    return win.segment(rank), win.sizes[rank]
+
+
+def mpi_win_fence(win) -> int:
+    win.fence()
+    return MPI_SUCCESS
+
+
+def mpi_put(data, target_rank: int, target_disp: int, win) -> int:
+    win.put(data, target_rank, target_disp)
+    return MPI_SUCCESS
+
+
+def mpi_get(target_rank: int, nbytes: int, target_disp: int,
+            win) -> np.ndarray:
+    return win.get(target_rank, nbytes, target_disp)
+
+
+def mpi_win_get_attr(win, keyval: int):
+    return win.get_attr(keyval)
+
+
+def mpi_win_free(win) -> int:
+    win.free()
+    return MPI_SUCCESS
+
+
+def mpi_win_create(*_a, **_k):
+    raise MpiError(
+        "MPI_Win_create over caller-provided buffers cannot span "
+        "processes; use mpi_win_allocate_shared (the reference stubs "
+        "both with notImplemented)")
+
+
+# ---------------------------------------------------------------------------
+# Group management extras
+# ---------------------------------------------------------------------------
+
+def mpi_group_free(group) -> int:
+    """MPI_Group_free: groups are plain rank lists (local objects)."""
+    return MPI_SUCCESS
 
 
 def mpi_dims_create(nnodes: int, ndims: int) -> list[int]:

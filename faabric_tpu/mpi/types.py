@@ -123,9 +123,32 @@ def _minmaxloc(op: MpiOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out
 
 
+class UserOp:
+    """User-defined reduction (MPI_Op_create analog — the reference's
+    native shim throws notImplemented for it; here ``fn(a, b) -> array``
+    plugs into every host-path collective: reduce/allreduce/scan/
+    reduce_scatter). ``commute=False`` is accepted and recorded; the
+    leader-tree reduction applies contributions in rank order within
+    each level, which is what non-commutative ops get from the
+    reference's linear loops too."""
+
+    __slots__ = ("fn", "commute", "name")
+
+    def __init__(self, fn, commute: bool = True,
+                 name: str = "user_op") -> None:
+        self.fn = fn
+        self.commute = commute
+        self.name = name
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging nicety
+        return f"UserOp({self.name})"
+
+
 def apply_op(op: MpiOp, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Typed reduce (reference MpiWorld::op_reduce:1266-1388 — there hand
     rolled loops per dtype; numpy ufuncs vectorise the same semantics)."""
+    if isinstance(op, UserOp):
+        return np.asarray(op.fn(a, b)).astype(a.dtype, copy=False)
     if op in (MpiOp.MINLOC, MpiOp.MAXLOC):
         return _minmaxloc(op, a, b)
     fn = _NP_OPS.get(op)

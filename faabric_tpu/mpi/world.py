@@ -423,6 +423,24 @@ class MpiWorld:
         with self._lock:
             return len(self._requests.get(rank, {}))
 
+    def request_free(self, rank: int, request_id: int) -> None:
+        """MPI_Request_free: drop the handle without waiting. Sends
+        complete in their worker regardless. A freed irecv whose message
+        already arrived consumes and discards it (so it can't be handed
+        to a later unrelated recv); freeing a still-unmatched irecv just
+        drops the handle — the standard itself calls that erroneous on
+        the user's part (a message sent for it would go to the next
+        matching recv)."""
+        with self._lock:
+            entry = self._requests.get(rank, {}).pop(request_id, None)
+        if entry is None:
+            return  # already completed/freed — MPI_REQUEST_NULL no-op
+        if entry[0] == "recv":
+            _, send_rank, recv_rank = entry
+            if self.broker.try_probe_message(self.group_id, send_rank,
+                                             recv_rank) is not None:
+                self.recv(send_rank, recv_rank)  # consume + discard
+
     def request_ready(self, rank: int, request_id: int) -> bool:
         """True when await_async would complete without blocking (local
         sends at isend, remote isends when their send worker finishes,

@@ -9,6 +9,8 @@ growth, and shared memory via ``multiprocessing.shared_memory``.
 
 from __future__ import annotations
 
+import threading
+
 from multiprocessing import shared_memory
 from typing import Optional
 
@@ -74,20 +76,57 @@ class SharedBuffer:
         self._closed = False
 
     def close(self, unlink: bool = False) -> None:
-        """Idempotent. Raises BufferError while external views of .array
-        are still alive — release them and call close() again (the own
-        view is dropped on the first attempt either way)."""
+        """Idempotent and never raises for live external views: a mapping
+        still pinned by caller-held numpy views goes to a graveyard that
+        later close() calls (and atexit) drain once the views die —
+        otherwise SharedMemory.__del__ rattles off BufferError at
+        interpreter-decided destruction order. ``unlink`` removes the
+        name immediately either way (POSIX allows unlink while mapped)."""
+        _drain_shm_graveyard()
         if self._closed:
             return
-        self.array = None  # drop our own view
-        try:
-            self._shm.close()
-        except BufferError:
-            # External views still pin the mapping; retryable
-            raise
         self._closed = True
+        self.array = None  # drop our own view
         if unlink:
             try:
                 self._shm.unlink()
             except FileNotFoundError:  # pragma: no cover
                 pass
+        try:
+            self._shm.close()
+        except BufferError:
+            with _SHM_GRAVEYARD_LOCK:
+                _SHM_GRAVEYARD.append(self._shm)
+        self._shm = None
+
+
+# Mappings whose close() found live external views; kept referenced so
+# their __del__ can't fire early, retried as views die. Mutated from
+# every rank thread's close() — all access under the lock (and entries
+# are drained by one thread at a time, so no double-close).
+_SHM_GRAVEYARD: list = []
+_SHM_GRAVEYARD_LOCK = threading.Lock()
+
+
+def _drain_shm_graveyard() -> None:
+    with _SHM_GRAVEYARD_LOCK:
+        kept = []
+        for shm in _SHM_GRAVEYARD:
+            try:
+                shm.close()
+            except BufferError:
+                kept.append(shm)
+        _SHM_GRAVEYARD[:] = kept
+
+
+def _graveyard_atexit() -> None:  # pragma: no cover — interpreter exit
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _drain_shm_graveyard()
+
+
+import atexit  # noqa: E402  (registration belongs next to the graveyard)
+
+atexit.register(_graveyard_atexit)

@@ -671,3 +671,157 @@ def test_dims_create():
             dims = mpi_dims_create(n, d)
             assert _np.prod(dims) == n and len(dims) == d
             assert dims == sorted(dims, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 late surface: user ops, allgatherv, derived types, shared windows
+# (the reference native shim throws notImplemented for user ops, v-variant
+# allgather and all of MPI_Win_*/Put/Get — these are real here)
+# ---------------------------------------------------------------------------
+
+def test_user_op_allreduce_and_scan(mpi_cluster):
+    from faabric_tpu.mpi.types import UserOp
+
+    # Elementwise "absolute max keeping sign" — not a built-in op
+    absmax = UserOp(
+        lambda a, b: np.where(np.abs(b) > np.abs(a), b, a), name="absmax")
+    vals = [np.array([r - 3, 3 - r, r], np.int64) for r in range(6)]
+
+    def fn(world, rank):
+        out = world.allreduce(rank, vals[rank], absmax)
+        np.testing.assert_array_equal(out, np.array([-3, 3, 5], np.int64))
+        scan = world.scan(rank, np.array([rank + 1], np.int64),
+                          UserOp(np.add, name="sum"))
+        # inclusive prefix-sum of 1..rank+1
+        assert int(scan[0]) == (rank + 1) * (rank + 2) // 2
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_allgatherv_variable_counts(mpi_cluster):
+    from faabric_tpu.mpi.api import MpiComm, mpi_allgatherv
+
+    def fn(world, rank):
+        # Exercise the real public wrapper via an explicit comm handle
+        send = np.full(rank + 1, rank, np.int32)  # rank r sends r+1 elems
+        data, counts = mpi_allgatherv(send, comm=MpiComm(world, rank))
+        assert counts == [1, 2, 3, 4, 5, 6]
+        expect = np.concatenate(
+            [np.full(r + 1, r, np.int32) for r in range(6)])
+        np.testing.assert_array_equal(np.asarray(data, np.int32), expect)
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_request_free_discards_arrived_message(mpi_cluster):
+    from faabric_tpu.mpi.api import MpiComm, MpiRequest, mpi_request_free
+
+    def fn(world, rank):
+        if rank == 1:
+            world.send(1, 0, np.array([111], np.int32))  # for the freed req
+            world.send(1, 0, np.array([222], np.int32))  # for the real recv
+        elif rank == 0:
+            rid = world.irecv(1, 0)
+            # Give the messages time to land, then free the handle: its
+            # already-arrived message must be consumed and discarded
+            deadline = time.monotonic() + 5.0
+            while world.broker.try_probe_message(world.group_id, 1, 0) \
+                    is None and time.monotonic() < deadline:
+                time.sleep(0.005)
+            mpi_request_free(MpiRequest(world, 0, rid))
+            assert world.pending_requests(0) == 0  # no handle leak
+            data, _ = world.recv(1, 0)
+            assert int(data[0]) == 222  # not the freed request's 111
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_contiguous_type_and_version():
+    from faabric_tpu.mpi.api import (
+        MPI_THREAD_SERIALIZED,
+        mpi_get_version,
+        mpi_query_thread,
+        mpi_type_commit,
+        mpi_type_contiguous,
+        mpi_type_free,
+        mpi_type_size,
+    )
+    from faabric_tpu.mpi.types import MpiDataType
+
+    t = mpi_type_contiguous(5, MpiDataType.DOUBLE)
+    assert mpi_type_size(t) == 5 * 8
+    nested = mpi_type_contiguous(3, t)
+    assert mpi_type_size(nested) == 15 * 8
+    mpi_type_commit(t)
+    assert t.committed
+    mpi_type_free(t)
+    assert not t.committed
+    assert mpi_get_version() == (3, 1)
+    assert mpi_query_thread() == MPI_THREAD_SERIALIZED
+
+
+def test_shared_window_put_get_fence(mpi_cluster):
+    from faabric_tpu.mpi.window import (
+        MPI_WIN_BASE,
+        MPI_WIN_DISP_UNIT,
+        MPI_WIN_SIZE,
+        allocate_shared,
+    )
+
+    def fn(world, rank):
+        sub, subrank = world.split_type_shared(rank)
+        win = allocate_shared(sub, subrank, 16)
+        try:
+            # Every rank writes its subrank byte into EVERY co-located
+            # rank's segment at disp=subrank (one-sided, no recv)
+            for target in range(sub.size):
+                win.put(np.array([subrank], np.uint8), target,
+                        target_disp=subrank)
+            win.fence()
+            seg = win.segment()
+            assert list(seg[:sub.size]) == list(range(sub.size))
+            # shared_query sees a co-located rank's segment directly
+            other = (subrank + 1) % sub.size
+            peer_seg = win.segment(other)
+            assert list(peer_seg[:sub.size]) == list(range(sub.size))
+            # attributes
+            assert win.get_attr(MPI_WIN_SIZE) == 16
+            assert win.get_attr(MPI_WIN_DISP_UNIT) == 1
+            assert win.get_attr(MPI_WIN_BASE).size == 16
+            # one-sided read-back
+            got = win.get(other, 3, 0)
+            assert list(got) == [0, 1, 2]
+            win.fence()
+        finally:
+            win.free()
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_shared_window_rejects_cross_host_world(mpi_cluster):
+    from faabric_tpu.mpi.window import allocate_shared
+
+    def fn(world, rank):
+        if rank != 0:
+            return
+        with pytest.raises(RuntimeError, match="co-located"):
+            allocate_shared(world, rank, 16)  # full world spans 2 hosts
+
+    run_ranks(mpi_cluster, fn)
+
+
+def test_window_bounds_and_free_semantics(mpi_cluster):
+    from faabric_tpu.mpi.window import allocate_shared
+
+    def fn(world, rank):
+        sub, subrank = world.split_type_shared(rank)
+        win = allocate_shared(sub, subrank, 8)
+        with pytest.raises(ValueError, match="overruns"):
+            win.put(np.zeros(9, np.uint8), 0, 0)
+        with pytest.raises(ValueError, match="overruns"):
+            win.get(0, 4, 6)
+        win.free()
+        with pytest.raises(RuntimeError, match="freed"):
+            win.put(np.zeros(1, np.uint8), 0, 0)
+
+    run_ranks(mpi_cluster, fn)
