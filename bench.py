@@ -505,6 +505,37 @@ def bench_device_attention(tiny: bool = False) -> dict:
     return out
 
 
+def bench_device_snapshot(tiny: bool = False) -> dict:
+    """DeviceSnapshot dirty-page scan + diff extraction on the device
+    (snapshot/device_snapshot.py — the no-mprotect-on-HBM design): how
+    fast a sparse change in a big HBM value is detected and pulled."""
+    import jax.numpy as jnp
+
+    from faabric_tpu.snapshot import DeviceSnapshot
+
+    mib = 64 if tiny else 256
+    n = mib * (1 << 20) // 4
+    arr = jnp.arange(n, dtype=jnp.float32)
+    snap = DeviceSnapshot(arr)
+    new = arr.at[n // 2].set(0.0).at[7].set(-1.0).at[n - 1].set(3.0)
+
+    snap.dirty_pages(new)  # compile + warm the flags kernel
+    snap.diff(new)         # ...and the gather kernel
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        flags = snap.dirty_pages(new)
+    scan_ms = 1000 * (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        diffs = snap.diff(new)
+    diff_ms = 1000 * (time.perf_counter() - t0) / iters
+    return {"image_mib": mib, "dirty_pages": int(flags.sum()),
+            "scan_ms": scan_ms, "diff_ms": diff_ms,
+            "scan_gibs": mib / 1024 / (scan_ms / 1000),
+            "diff_bytes": sum(len(d.data) for d in diffs)}
+
+
 def bench_hbm_bandwidth() -> dict:
     """HBM read+write bandwidth via a big on-device copy-scale (x·2 over
     256 MiB touches 512 MiB of HBM traffic per iter)."""
@@ -553,6 +584,7 @@ def bench_device_phase(tiny: bool = False, out_path: str | None = None) -> dict:
     for name, fn in [
         ("hbm", bench_hbm_bandwidth),
         ("allreduce", lambda: bench_device_allreduce(tiny)),
+        ("device_snapshot", lambda: bench_device_snapshot(tiny)),
         ("attention", lambda: bench_device_attention(tiny)),
         ("step", lambda: bench_device_step(tiny)),
         ("step_reference", lambda: bench_device_step(

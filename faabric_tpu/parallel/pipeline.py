@@ -140,6 +140,33 @@ def pp_data_sharding(mesh: Mesh) -> NamedSharding:
 # In-stage compute (Megatron tp inside a pipeline stage)
 # ---------------------------------------------------------------------------
 
+def _head_nll(y, ln_f, lm_head, targets_m, cfg: ModelConfig):
+    """LM-head NLL for one microbatch — the single definition both
+    schedules (GPipe's loss_one, 1F1B's head) differentiate."""
+    h = _rms_norm(y, ln_f)
+    logits = (h @ lm_head.astype(cfg.compute_dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets_m[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def _validate_pp_mesh(cfg: ModelConfig, mesh: Mesh) -> int:
+    n_stages = mesh.shape["pp"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
+    if mesh.shape.get("sp", 1) > 1 or mesh.shape.get("ep", 1) > 1:
+        raise ValueError("pipeline path supports dp×tp×pp meshes "
+                         "(sp/ep must be 1)")
+    return n_stages
+
+
+def _pp_specs(cfg: ModelConfig, mesh: Mesh):
+    param_specs = jax.tree.map(lambda s: s.spec,
+                               pp_param_shardings(mesh, cfg))
+    return param_specs, P(None, "dp", None)
+
+
 def _pp_block(x, blk, positions, cfg: ModelConfig):
     """One transformer block on tp-local shards: qkv/w1 column-parallel,
     wo/w2 row-parallel with a psum over ``tp`` after each."""
@@ -220,13 +247,8 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
     # other stages' buffers are garbage and get masked out below.
     def loss_one(acc, y_t):
         y, targets_m = y_t
-        h = _rms_norm(y, pp_params["ln_f"])
-        logits = (h @ pp_params["lm_head"].astype(cfg.compute_dtype)
-                  ).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets_m[..., None],
-                                   axis=-1)[..., 0]
-        return acc + jnp.mean(nll), None
+        return acc + _head_nll(y, pp_params["ln_f"], pp_params["lm_head"],
+                               targets_m, cfg), None
 
     loss_sum, _ = jax.lax.scan(
         loss_one, _mark_varying(jnp.zeros((), jnp.float32), ("dp", "pp")),
@@ -242,22 +264,196 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
 def make_pp_loss(cfg: ModelConfig, mesh: Mesh):
     """Jittable ``loss(pp_params, tokens_mb, targets_mb)`` where tokens_mb
     is (n_microbatches, batch, seq)."""
-    n_stages = mesh.shape["pp"]
-    if cfg.n_layers % n_stages:
-        raise ValueError(
-            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
-    if mesh.shape.get("sp", 1) > 1 or mesh.shape.get("ep", 1) > 1:
-        raise ValueError("pipeline path supports dp×tp×pp meshes "
-                         "(sp/ep must be 1)")
-
-    param_specs = jax.tree.map(lambda s: s.spec,
-                               pp_param_shardings(mesh, cfg))
-    data_spec = P(None, "dp", None)
+    n_stages = _validate_pp_mesh(cfg, mesh)
+    param_specs, data_spec = _pp_specs(cfg, mesh)
 
     local = partial(_pipeline_loss_local, cfg=cfg, n_stages=n_stages)
     return shard_map(local, mesh=mesh,
                      in_specs=(param_specs, data_spec, data_spec),
                      out_specs=P())
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: hand-scheduled interleaved forward/backward
+# ---------------------------------------------------------------------------
+
+def n_ticks_1f1b(n_stages: int, n_microbatches: int) -> int:
+    """Wall ticks for the 1F1B schedule below (each tick = one fwd unit
+    + one bwd unit per stage)."""
+    return n_microbatches + 2 * (n_stages - 1)
+
+
+def ring_slots(n_stages: int) -> int:
+    """Saved-input slots a stage needs: in-flight microbatches are
+    bounded by the schedule depth 2(S−1)+1 — NOT by M (the GPipe-by-grad
+    path's backward holds O(M + S) per-tick activations)."""
+    return 2 * (n_stages - 1) + 1
+
+
+def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
+                         cfg: ModelConfig, n_stages: int, dp_size: int):
+    """Per-device 1F1B body: a FORWARD-ONLY scan that carries gradient
+    accumulators — no outer jax.grad, so XLA never materialises per-tick
+    saved activations. Schedule (branch-free, both units every tick):
+
+    - fwd: stage ``s`` forwards microbatch ``mf = t − s`` (GPipe fill),
+      saving its post-select INPUT in a ring buffer (recompute-style
+      residual — the cheapest carryable VJP state).
+    - bwd: stage ``s`` backwards ``mb = t − 2(S−1) + s``; for the last
+      stage ``mb == mf``, so the loss head's dy feeds its own vjp the
+      same tick. Invalid units run on clamped garbage with a ZERO dy —
+      vjp is linear in the cotangent, so their grad contribution is
+      exactly zero without a branch (collectives under device-varying
+      lax.cond deadlock).
+    - hops: activations ppermute forward, input-cotangents ppermute
+      backward; one tick = one ICI hop each way.
+
+    Returns (loss, grads) with grads in the pp-sharded param layout.
+    """
+    s_idx = jax.lax.axis_index("pp")
+    m_count, b_local, seq = tokens_mb.shape
+    d_model = cfg.d_model
+    ticks = n_ticks_1f1b(n_stages, m_count)
+    n_slots = ring_slots(n_stages)
+
+    positions = jnp.broadcast_to(jnp.arange(seq)[None], (b_local, seq))
+    embed = pp_params["embed"]
+    stacked = pp_params["stacked"]
+    is_first = s_idx == 0
+    is_last = s_idx == n_stages - 1
+
+    def stage_fn(slab, x):
+        def body(h, blk):
+            return _pp_block(h, blk, positions, cfg), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, slab)
+        return x
+
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        x_hop, dy_hop, ring, g_stacked, g_embed, g_lnf, g_lmh, loss_acc = \
+            carry
+
+        # ---- forward unit -------------------------------------------
+        mf = t - s_idx
+        fwd_valid = (mf >= 0) & (mf < m_count)
+        mf_c = jnp.clip(mf, 0, m_count - 1)
+        tokens_f = tokens_mb[mf_c]
+        emb = _mark_varying(embed.astype(cfg.compute_dtype)[tokens_f],
+                            ("dp", "pp"))
+        x_in = jnp.where(is_first, emb, x_hop)
+        slot_f = mf_c % n_slots
+        ring = ring.at[slot_f].set(
+            jnp.where(fwd_valid, x_in, ring[slot_f]))
+        y = stage_fn(stacked, x_in)
+
+        # Loss head each tick. The validity mask is INSIDE the
+        # differentiated function: ln_f/lm_head are invariant over dp AND
+        # pp, so the in-body vjp auto-psums their cotangents over both
+        # axes (transpose of the implicit invariant→varying casts) — an
+        # outside-the-grad mask would let other stages' garbage heads
+        # into that sum. Masked inside, the auto-psum delivers exactly
+        # the valid last-stage contribution, Σ'd over dp shards.
+        head_mask = fwd_valid & is_last
+        hm = jnp.where(head_mask, 1.0, 0.0)
+        (masked_loss, (dy_own, d_lnf, d_lmh)) = jax.value_and_grad(
+            lambda y_, lnf_, lmh_: hm * _head_nll(y_, lnf_, lmh_,
+                                                  targets_mb[mf_c], cfg),
+            argnums=(0, 1, 2))(y, pp_params["ln_f"], pp_params["lm_head"])
+        loss_acc = loss_acc + masked_loss
+        g_lnf = g_lnf + d_lnf
+        g_lmh = g_lmh + d_lmh
+
+        # ---- backward unit ------------------------------------------
+        mb = t - 2 * (n_stages - 1) + s_idx
+        bwd_valid = (mb >= 0) & (mb < m_count)
+        mb_c = jnp.clip(mb, 0, m_count - 1)
+        x_saved = ring[mb_c % n_slots]
+        dy_in = jnp.where(is_last, dy_own.astype(cfg.compute_dtype), dy_hop)
+        dy_eff = jnp.where(bwd_valid, dy_in, jnp.zeros_like(dy_in))
+        _, vjp = jax.vjp(stage_fn, stacked, x_saved)
+        d_slab, dx = vjp(dy_eff)
+        g_stacked = jax.tree.map(jnp.add, g_stacked, d_slab)
+        # dx is already the FULL input cotangent: under the vma-checked
+        # shard_map, transposing the invariant→tp-varying casts where x
+        # meets the tp-sharded matmuls inserts the psum('tp') (the
+        # Megatron f/g pattern) — an explicit psum here would double-
+        # count the tp-invariant residual path
+        # Stage 0's dx is the embedding-gather cotangent
+        tokens_b = tokens_mb[mb_c]
+        g_embed = g_embed.at[tokens_b].add(
+            jnp.where(is_first, dx, jnp.zeros_like(dx)).astype(g_embed.dtype))
+
+        # ---- hops ---------------------------------------------------
+        x_hop = jax.lax.ppermute(y, "pp", perm_fwd)
+        dy_hop = jax.lax.ppermute(dx, "pp", perm_bwd)
+        return (x_hop, dy_hop, ring, g_stacked, g_embed, g_lnf, g_lmh,
+                loss_acc), None
+
+    zeros_act = _mark_varying(
+        jnp.zeros((b_local, seq, d_model), cfg.compute_dtype), ("dp", "pp"))
+    ring0 = _mark_varying(
+        jnp.zeros((n_slots, b_local, seq, d_model), cfg.compute_dtype),
+        ("dp", "pp"))
+    # Accumulator vma types mirror what lands in them: g_stacked /
+    # g_lnf / g_lmh receive vjp cotangents already auto-psum'd over the
+    # axes their params are invariant on (zeros_like inherits the
+    # param's own type); g_embed takes the dp-local dx scatter and the
+    # loss the pp/dp-local masked head value
+    g_stacked0 = jax.tree.map(jnp.zeros_like, stacked)
+    g_embed0 = _mark_varying(jnp.zeros_like(embed), ("dp", "pp"))
+    g_lnf0 = jnp.zeros_like(pp_params["ln_f"])
+    g_lmh0 = jnp.zeros_like(pp_params["lm_head"])
+    loss0 = _mark_varying(jnp.zeros((), jnp.float32), ("dp", "pp"))
+
+    (x_hop, dy_hop, ring, g_stacked, g_embed, g_lnf, g_lmh,
+     loss_acc), _ = jax.lax.scan(
+        tick, (zeros_act, zeros_act, ring0, g_stacked0, g_embed0, g_lnf0,
+               g_lmh0, loss0), jnp.arange(ticks))
+
+    inv_m = 1.0 / m_count
+    # Loss value lives on the last stage (values are device-local, only
+    # cotangents of invariant leaves get auto-psum'd)
+    loss = jax.lax.psum(loss_acc * inv_m, "pp")
+    loss = jax.lax.pmean(loss, "dp")
+    loss = jax.lax.pmean(loss, "tp")
+
+    # Gradient normalization — two regimes:
+    # - manually-accumulated g_embed (scatter of the dp-LOCAL dx): combine
+    #   stages with psum('pp'), dp-average with pmean;
+    # - vjp-produced g_stacked / g_lnf / g_lmh: the in-body vjp already
+    #   psum'd them over every axis their param is invariant on (dp; pp
+    #   too for the head leaves) — they arrive as Σ over dp shards, so
+    #   the dp MEAN is a static division, and another psum/pmean would
+    #   double-count.
+    g_embed = jax.lax.pmean(jax.lax.psum(g_embed * inv_m, "pp"), "dp")
+    scale = inv_m / dp_size
+    g_stacked = jax.tree.map(lambda g: g * scale, g_stacked)
+    g_lnf = g_lnf * scale
+    g_lmh = g_lmh * scale
+
+    grads = {"embed": g_embed, "stacked": g_stacked,
+             "ln_f": g_lnf, "lm_head": g_lmh}
+    return loss, grads
+
+
+def make_pp_1f1b_value_and_grad(cfg: ModelConfig, mesh: Mesh):
+    """Jittable ``fn(pp_params, tokens_mb, targets_mb) → (loss, grads)``
+    — the 1F1B analog of ``jax.value_and_grad(make_pp_loss(...))``, with
+    activation memory bounded by the schedule depth instead of the tick
+    count."""
+    n_stages = _validate_pp_mesh(cfg, mesh)
+    param_specs, data_spec = _pp_specs(cfg, mesh)
+
+    local = partial(_pipeline_1f1b_local, cfg=cfg, n_stages=n_stages,
+                    dp_size=mesh.shape["dp"])
+    return shard_map(local, mesh=mesh,
+                     in_specs=(param_specs, data_spec, data_spec),
+                     out_specs=(P(), param_specs))
 
 
 def microbatch(tokens: jax.Array, n_microbatches: int) -> jax.Array:
@@ -270,22 +466,38 @@ def microbatch(tokens: jax.Array, n_microbatches: int) -> jax.Array:
 
 
 def make_pp_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
-                       n_microbatches: int = 4):
+                       n_microbatches: int = 4,
+                       schedule_name: str = "gpipe"):
     """Returns jitted ``step(pp_params, opt_state, tokens, targets) →
     (pp_params, opt_state, loss)``; tokens/targets are (B, S) and are
-    microbatched internally."""
+    microbatched internally. ``schedule_name``:
+
+    - ``"gpipe"``: the scan-based forward with ``jax.value_and_grad``
+      deriving the mirrored backward (activation memory O(M + S)
+      per-tick outputs, remat inside stages).
+    - ``"1f1b"``: the hand-scheduled interleaved forward/backward
+      (activation memory O(S) ring of saved stage inputs).
+    """
     from faabric_tpu.models.train import make_optimizer
 
     import optax
 
     optimizer = optimizer or make_optimizer()
-    loss_fn = make_pp_loss(cfg, mesh)
+    if schedule_name == "1f1b":
+        value_and_grad = make_pp_1f1b_value_and_grad(cfg, mesh)
+    elif schedule_name == "gpipe":
+        loss_fn = make_pp_loss(cfg, mesh)
+
+        def value_and_grad(pp_params, tok_mb, tgt_mb):
+            return jax.value_and_grad(
+                lambda p: loss_fn(p, tok_mb, tgt_mb))(pp_params)
+    else:
+        raise ValueError(f"Unknown pipeline schedule {schedule_name!r}")
 
     def step(pp_params, opt_state, tokens, targets):
         tok_mb = microbatch(tokens, n_microbatches)
         tgt_mb = microbatch(targets, n_microbatches)
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tok_mb, tgt_mb))(pp_params)
+        loss, grads = value_and_grad(pp_params, tok_mb, tgt_mb)
         updates, opt_state = optimizer.update(grads, opt_state, pp_params)
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss

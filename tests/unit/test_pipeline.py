@@ -221,3 +221,76 @@ def test_pipeline_checkpoint_interop(tmp_path):
     # Optimizer states differ (fresh vs stepped), but the LOSS is a pure
     # function of params+data and must match
     np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (hand-scheduled interleaved fwd/bwd, O(S) activations)
+# ---------------------------------------------------------------------------
+
+def test_1f1b_schedule_math():
+    from faabric_tpu.parallel.pipeline import n_ticks_1f1b, ring_slots
+
+    assert n_ticks_1f1b(1, 4) == 4
+    assert n_ticks_1f1b(4, 8) == 14
+    assert ring_slots(1) == 1
+    assert ring_slots(4) == 7
+    # Ring slots bound in-flight microbatches for every stage: the fwd/
+    # bwd index distance is 2(S-1) - 2s <= 2(S-1) < ring_slots(S)
+    for S in (2, 3, 4):
+        for s in range(S):
+            assert 2 * (S - 1) - 2 * s < ring_slots(S)
+
+
+@pytest.mark.parametrize("pp,tp,m", [(2, 1, 4), (4, 1, 8), (2, 2, 4)])
+def test_1f1b_loss_and_grads_match_autodiff_gpipe(pp, tp, m):
+    from faabric_tpu.parallel.pipeline import make_pp_1f1b_value_and_grad
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens, targets = data(seed=5)
+
+    mesh = build_mesh(jax.devices()[:8],
+                      MeshConfig(dp=8 // (pp * tp), tp=tp, pp=pp))
+    pp_params = jax.device_put(stack_block_params(params),
+                               pp_param_shardings(mesh, CFG))
+    tok = jax.device_put(microbatch(tokens, m), pp_data_sharding(mesh))
+    tgt = jax.device_put(microbatch(targets, m), pp_data_sharding(mesh))
+
+    loss_1f1b, g_1f1b = jax.jit(make_pp_1f1b_value_and_grad(CFG, mesh))(
+        pp_params, tok, tgt)
+
+    ploss = make_pp_loss(CFG, mesh)
+    loss_ref, g_ref = jax.jit(jax.value_and_grad(
+        lambda p: ploss(p, tok, tgt)))(pp_params)
+
+    assert abs(float(loss_1f1b) - float(loss_ref)) < 1e-5
+    assert jax.tree.structure(g_1f1b) == jax.tree.structure(g_ref)
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(g_1f1b), key=str),
+            sorted(jax.tree_util.tree_leaves_with_path(g_ref), key=str)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5,
+                                   err_msg=str(pa))
+
+
+def test_1f1b_train_step_matches_gpipe_schedule():
+    from faabric_tpu.parallel.pipeline import (
+        init_pp_train_state,
+        make_pp_train_step,
+    )
+
+    tokens, targets = data(seed=9)
+    mesh = build_mesh(jax.devices()[:8], MeshConfig(dp=4, pp=2))
+
+    losses = {}
+    for sched_name in ("gpipe", "1f1b"):
+        pp_params, opt_state = init_pp_train_state(
+            jax.random.PRNGKey(1), CFG, mesh)
+        step = make_pp_train_step(CFG, mesh, n_microbatches=4,
+                                  schedule_name=sched_name)
+        ls = []
+        for _ in range(3):
+            pp_params, opt_state, loss = step(pp_params, opt_state,
+                                              tokens, targets)
+            ls.append(float(loss))
+        losses[sched_name] = ls
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], atol=2e-5)
+    assert losses["1f1b"][-1] < losses["1f1b"][0]  # it actually learns
