@@ -17,10 +17,17 @@ bandwidth-bound elementwise+reduce that XLA already fuses into a single
 HBM pass; the win is architectural (never pulling the full image to the
 host), not micro-kernel-level.
 
-Byte-exactness: values are bitcast to a uint8 image on device, so page
-offsets/bytes match the host-side SnapshotData layout exactly and a
-device diff can be queued onto a host snapshot (checkpoint/freeze paths
-ride the existing machinery).
+Compares and gathers run on a **same-width integer view** of the value
+(bitcast, free on device), never on a uint8 byte image: a float32 page
+is 1024 uint32 words vs 4096 bytes, and TPU vector units tile 32-bit
+lanes natively, so the compare runs at HBM bandwidth instead of fighting
+an int8 relayout. Byte-exactness is preserved — the bitcast keeps bit
+patterns, so word equality is byte equality (unlike comparing floats,
+where NaN != NaN and -0.0 == 0.0 would both lie about the bytes), and
+diffs are emitted as the original little-endian byte ranges with offsets
+matching the host-side SnapshotData layout exactly, so a device diff can
+be queued onto a host snapshot (checkpoint/freeze paths ride the
+existing machinery).
 """
 
 from __future__ import annotations
@@ -33,45 +40,65 @@ from faabric_tpu.snapshot.snapshot import SnapshotData, SnapshotDiff
 
 DEVICE_PAGE_SIZE = 4096
 
+_WORD_FOR_SIZE = {1: "uint8", 2: "uint16", 4: "uint32", 8: "uint64"}
 
-def _as_byte_image(arr):
-    """Flatten any-dtype device array to its (nbytes,) uint8 image."""
+
+def _word_dtype(dtype) -> np.dtype:
+    """The unsigned-int dtype a value is compared as: same-width where
+    one exists (the fast path), uint8 otherwise. Complex dtypes are
+    rejected — XLA cannot bitcast them (view them as real pairs before
+    tracking)."""
+    dt = np.dtype(dtype)
+    if dt.kind == "c":
+        raise ValueError(
+            f"DeviceSnapshot does not support complex dtype {dt}; "
+            "bitcast/view the value as its real-pair components first")
+    return np.dtype(_WORD_FOR_SIZE.get(dt.itemsize, "uint8"))
+
+
+def _as_word_image(arr):
+    """Flatten a (real-dtype) device array to its unsigned-int word
+    image (a free bitcast — bit patterns, and therefore bytes, are
+    preserved)."""
     import jax
     import jax.numpy as jnp
 
     flat = arr.reshape(-1)
-    if flat.dtype == jnp.uint8:
+    if flat.dtype == jnp.bool_:
+        # No bitcast from bool; byte-equal for JAX's canonical 0/1 bools
+        return flat.astype(jnp.uint8)
+    word = _word_dtype(flat.dtype)
+    if flat.dtype == word:
         return flat
-    u8 = jax.lax.bitcast_convert_type(flat, jnp.uint8)
-    return u8.reshape(-1)
+    return jax.lax.bitcast_convert_type(flat, jnp.dtype(word)).reshape(-1)
 
 
 @functools.lru_cache(maxsize=32)
-def _flags_fn(n_bytes: int, page_size: int):
+def _flags_fn(n_words: int, page_words: int, word: str):
     import jax
     import jax.numpy as jnp
 
-    n_pages = -(-n_bytes // page_size)
-    pad = n_pages * page_size - n_bytes
+    n_pages = -(-n_words // page_words)
+    pad = n_pages * page_words - n_words
 
-    def flags(base_u8, cur_u8):
-        b = jnp.pad(base_u8, (0, pad))
-        c = jnp.pad(cur_u8, (0, pad))
-        return jnp.any((b != c).reshape(n_pages, page_size), axis=1)
+    def flags(base_w, cur_w):
+        b = jnp.pad(base_w, (0, pad))
+        c = jnp.pad(cur_w, (0, pad))
+        return jnp.any((b != c).reshape(n_pages, page_words), axis=1)
 
     return jax.jit(flags)
 
 
 @functools.lru_cache(maxsize=32)
-def _gather_fn(n_bytes: int, page_size: int):
+def _gather_fn(n_words: int, page_words: int, word: str):
     import jax
     import jax.numpy as jnp
 
-    n_pages = -(-n_bytes // page_size)
-    pad = n_pages * page_size - n_bytes
+    n_pages = -(-n_words // page_words)
+    pad = n_pages * page_words - n_words
 
-    def gather(cur_u8, idx):
-        c = jnp.pad(cur_u8, (0, pad)).reshape(n_pages, page_size)
+    def gather(cur_w, idx):
+        c = jnp.pad(cur_w, (0, pad)).reshape(n_pages, page_words)
         return jnp.take(c, idx, axis=0)
 
     return jax.jit(gather)
@@ -101,20 +128,27 @@ class DeviceSnapshot:
         self.page_size = page_size
         self.shape = arr.shape
         self.dtype = arr.dtype
-        self._baseline_u8 = jnp.copy(_as_byte_image(arr))
-        self.n_bytes = int(self._baseline_u8.size)
-        self.n_pages = -(-self.n_bytes // page_size)
+        self._baseline_w = jnp.copy(_as_word_image(arr))
+        self._word = np.dtype(self._baseline_w.dtype)
+        if page_size % self._word.itemsize:
+            raise ValueError(
+                f"page_size {page_size} not a multiple of item size "
+                f"{self._word.itemsize}")
+        self.page_words = page_size // self._word.itemsize
+        self.n_words = int(self._baseline_w.size)
+        self.n_bytes = self.n_words * self._word.itemsize
+        self.n_pages = -(-self.n_words // self.page_words)
 
     # ------------------------------------------------------------------
-    def _flags_u8(self, u8) -> np.ndarray:
-        return np.asarray(_flags_fn(self.n_bytes, self.page_size)(
-            self._baseline_u8, u8))
+    def _flags_w(self, w) -> np.ndarray:
+        return np.asarray(_flags_fn(self.n_words, self.page_words,
+                                    self._word.name)(self._baseline_w, w))
 
     def dirty_pages(self, arr) -> np.ndarray:
         """(n_pages,) bool host vector; the only device→host transfer is
         the flag vector itself."""
         self._check(arr)
-        return self._flags_u8(_as_byte_image(arr))
+        return self._flags_w(_as_word_image(arr))
 
     def diff(self, arr, update_baseline: bool = False
              ) -> list[SnapshotDiff]:
@@ -122,10 +156,10 @@ class DeviceSnapshot:
         gathered on device and transferred in one batch. Adjacent dirty
         pages coalesce into a single diff."""
         self._check(arr)
-        # One byte image serves the compare, the gather, and (optionally)
+        # One word image serves the compare, the gather, and (optionally)
         # the baseline refresh — not one transient full-size copy each
-        u8 = _as_byte_image(arr)
-        idx = np.flatnonzero(self._flags_u8(u8))
+        w = _as_word_image(arr)
+        idx = np.flatnonzero(self._flags_w(w))
         if idx.size == 0:
             return []
         # Pad the index list to a power-of-two bucket (repeating the last
@@ -134,8 +168,10 @@ class DeviceSnapshot:
         bucket = _bucket(idx.size)
         idx_padded = np.concatenate(
             [idx, np.full(bucket - idx.size, idx[-1], idx.dtype)])
-        pages = np.asarray(_gather_fn(self.n_bytes, self.page_size)(
-            u8, idx_padded))[:idx.size]
+        pages = np.asarray(_gather_fn(self.n_words, self.page_words,
+                                      self._word.name)(w, idx_padded))
+        # (bucket, page_words) words → (bucket, page_size) bytes
+        pages = pages[:idx.size].view(np.uint8).reshape(idx.size, -1)
         diffs: list[SnapshotDiff] = []
         run_start = 0
         for i in range(1, idx.size + 1):
@@ -151,25 +187,35 @@ class DeviceSnapshot:
         if update_baseline:
             import jax.numpy as jnp
 
-            self._baseline_u8 = jnp.copy(u8)  # reuse the computed image
+            self._baseline_w = jnp.copy(w)  # reuse the computed image
         return diffs
+
+    @property
+    def baseline_bytes(self) -> np.ndarray:
+        """Host uint8 view of the baseline image (host bridging, tests)."""
+        return np.asarray(self._baseline_w).view(np.uint8).reshape(-1)
 
     def update_baseline(self, arr) -> None:
         import jax.numpy as jnp
 
         self._check(arr)
-        self._baseline_u8 = jnp.copy(_as_byte_image(arr))
+        self._baseline_w = jnp.copy(_as_word_image(arr))
 
     def restore(self):
         """The baseline as a device array of the original shape/dtype."""
         import jax
         import jax.numpy as jnp
 
-        flat = self._baseline_u8
-        if self.dtype != jnp.uint8:
-            itemsize = np.dtype(self.dtype).itemsize
-            flat = jax.lax.bitcast_convert_type(
-                flat.reshape(-1, itemsize), self.dtype)
+        flat = self._baseline_w
+        if self.dtype == jnp.bool_:
+            return (flat != 0).reshape(self.shape)
+        if flat.dtype != self.dtype:
+            ratio = (np.dtype(self.dtype).itemsize // self._word.itemsize)
+            if ratio > 1:  # uint8-fallback words: group bytes per element
+                flat = flat.reshape(-1, ratio)
+            flat = jax.lax.bitcast_convert_type(flat, self.dtype)
+            if flat.ndim > 1:
+                flat = flat.reshape(-1)
         return flat.reshape(self.shape)
 
     # ------------------------------------------------------------------
@@ -178,26 +224,23 @@ class DeviceSnapshot:
     def to_host_snapshot(self) -> SnapshotData:
         """The baseline as a host SnapshotData — device diffs queue onto
         it with the exact same byte offsets."""
-        return SnapshotData(np.asarray(self._baseline_u8))
+        return SnapshotData(np.asarray(self._baseline_w).view(np.uint8))
 
     def apply_diffs(self, arr, diffs: list[SnapshotDiff]):
         """Apply byte-exact diffs to a device value (the restore
         direction: thaw a frozen device state, then replay diffs)."""
         import jax
-        import jax.numpy as jnp
 
         self._check(arr)
-        u8 = np.asarray(_as_byte_image(arr)).copy()
+        host = np.asarray(arr)
+        u8 = host.reshape(-1).view(np.uint8).copy()
         for d in diffs:
             u8[d.offset:d.offset + len(d.data)] = np.frombuffer(
                 d.data, np.uint8)
-        host = u8
-        if self.dtype != jnp.uint8:
-            host = host.view(self.dtype)
-        return jax.device_put(host.reshape(self.shape))
+        return jax.device_put(u8.view(host.dtype).reshape(self.shape))
 
     def _check(self, arr) -> None:
         if arr.shape != self.shape or arr.dtype != self.dtype:
             raise ValueError(
-                f"Device snapshot tracks {self.shape}/{self.dtype}, got "
-                f"{arr.shape}/{arr.dtype}")
+                f"snapshot tracks {self.shape}/{self.dtype}, "
+                f"got {arr.shape}/{arr.dtype}")

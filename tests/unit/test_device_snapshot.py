@@ -65,7 +65,7 @@ def test_typed_arrays_diff_byte_exact(dtype):
 
     # Replaying the diffs over the baseline byte image reproduces the
     # current value exactly
-    img = np.asarray(snap._baseline_u8).copy()
+    img = snap.baseline_bytes.copy()
     for d in diffs:
         img[d.offset:d.offset + len(d.data)] = np.frombuffer(d.data,
                                                              np.uint8)
@@ -92,7 +92,7 @@ def test_device_diffs_queue_onto_host_snapshot():
     snap = DeviceSnapshot(arr)
     cur = arr.at[5000].set(np.uint8(255))
 
-    host_snap = SnapshotData(np.asarray(snap._baseline_u8))
+    host_snap = SnapshotData(snap.baseline_bytes)
     host_snap.queue_diffs(snap.diff(cur))
     host_snap.write_queued_diffs()
     np.testing.assert_array_equal(
@@ -140,3 +140,8 @@ def test_many_dirty_counts_reuse_bucketed_gathers():
             cur = cur.at[DEVICE_PAGE_SIZE * (2 * p)].set(np.uint8(p + 1))
         diffs = snap.diff(cur)
         assert len(diffs) == k
+
+
+def test_complex_dtype_rejected_with_guidance():
+    with pytest.raises(ValueError, match="complex"):
+        DeviceSnapshot(jnp.zeros(8, jnp.complex64))
