@@ -9,7 +9,14 @@ point-to-point dispatch latency — the BASELINE.md north-star metric
 hosts.
 
 The device phase (run in a watchdog subprocess, staged full→tiny→CPU so a
-wedged TPU tunnel can never zero the round) times:
+wedged TPU tunnel can never zero the round) runs every measured loop ON
+the device (lax.scan/fori_loop inside one jit, iterations data-dependent)
+and fences completion with a scalar readback; per-iteration time is the
+two-point slope (t_N − t_1)/(N − 1), cancelling per-call dispatch. This
+matters because the TPU arrives through a remote PJRT tunnel where a
+dispatch costs milliseconds and block_until_ready can return before the
+device finishes — host-side timing loops measure the client, not the
+chip. It times:
 - the flagship compiled train step with the Pallas kernels (auto =
   flash attention + fused norm on TPU) AND with the reference jnp impls,
   reporting both and the MFU (6·N·tokens/s over platform peak FLOPs);
@@ -329,6 +336,34 @@ def _count_params(params) -> int:
     return sum(int(x.size) for x in jax.tree.leaves(params))
 
 
+def _fenced_loop_time(run, fence, n_hi: int, n_lo: int = 1):
+    """Wall-times ``fence(run(n))`` at two loop lengths and returns
+    (per_iter_s, overhead_s): the slope cancels the constant per-call
+    dispatch + fence cost, and overhead is that constant (t_lo minus
+    n_lo iterations' worth). ``run(n)`` must execute its n iterations ON
+    the device (a lax loop inside one jit, each iteration data-dependent
+    on the last) and ``fence`` must pull a scalar to the host — through
+    a remote PJRT tunnel, block_until_ready can return before the device
+    finishes and each dispatch costs milliseconds, so host-side timing
+    loops measure the client, not the chip.
+
+    A non-positive slope means timing jitter swamped the measurement:
+    per_iter_s comes back None (callers must mark the number invalid,
+    never fabricate throughput from a clamp)."""
+    fence(run(n_lo))  # compile both trip counts
+    fence(run(n_hi))
+    t0 = time.perf_counter()
+    fence(run(n_lo))
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fence(run(n_hi))
+    t_hi = time.perf_counter() - t0
+    per = (t_hi - t_lo) / (n_hi - n_lo)
+    if per <= 0:
+        return None, t_lo
+    return per, max(0.0, t_lo - n_lo * per)
+
+
 def bench_device_step(tiny: bool = False, attention_impl: str = "auto",
                       norm_impl: str = "auto") -> dict:
     """Flagship model compiled train step on the available device."""
@@ -339,7 +374,6 @@ def bench_device_step(tiny: bool = False, attention_impl: str = "auto",
         ModelConfig,
         data_sharding,
         init_train_state,
-        make_train_step,
     )
     from faabric_tpu.models.transformer import resolve_impls
     from faabric_tpu.parallel import MeshConfig, build_mesh
@@ -358,7 +392,6 @@ def bench_device_step(tiny: bool = False, attention_impl: str = "auto",
         batch, seq = 8 * n, 512
     mesh = build_mesh(devices, MeshConfig())
     params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
-    step = make_train_step(cfg, mesh)
 
     rng = np.random.RandomState(0)
     tokens = jax.device_put(
@@ -368,18 +401,32 @@ def bench_device_step(tiny: bool = False, attention_impl: str = "auto",
         rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32),
         data_sharding(mesh))
 
-    # Compile + warmup
-    params, opt_state, loss = step(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
+    # The n-steps-per-dispatch form: timing threads the (donated) state
+    # through each call, fencing on a loss readback; the (t8 − t1)/7
+    # slope cancels the per-call dispatch cost, which through the remote
+    # TPU tunnel is large and unfenced by block_until_ready
+    from faabric_tpu.models import make_multi_step
 
-    n_steps = 10
+    run = make_multi_step(cfg, mesh)
+    n_params = _count_params(params)
+    n_lo, n_hi = 1, 8
+    # Two warm passes per trip count: the first compiles, the second
+    # absorbs the relayout-recompile that donated carries can trigger
+    # when one variant's output layout feeds the other variant
+    for k in (n_lo, n_hi, n_lo, n_hi):
+        params, opt_state, loss = run(params, opt_state, tokens, targets, k)
+        float(loss)
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - t0
+    params, opt_state, loss = run(params, opt_state, tokens, targets, n_lo)
+    float(loss)
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    params, opt_state, loss = run(params, opt_state, tokens, targets, n_hi)
+    float(loss)
+    t_hi = time.perf_counter() - t0
+    per_step = (t_hi - t_lo) / (n_hi - n_lo)
+    invalid = per_step <= 0
 
-    tokens_per_s = batch * seq * n_steps / elapsed
     resolved = resolve_impls(cfg, mesh)
     out = {
         "platform": devices[0].platform,
@@ -387,14 +434,19 @@ def bench_device_step(tiny: bool = False, attention_impl: str = "auto",
         "n_devices": n,
         "attention_impl": resolved.attention_impl,
         "norm_impl": resolved.norm_impl,
-        "step_ms": 1000 * elapsed / n_steps,
-        "tokens_per_s": tokens_per_s,
+        "step_ms": None if invalid else 1000 * per_step,
+        "dispatch_ms": (1000 * t_lo if invalid
+                        else 1000 * max(0.0, t_lo - n_lo * per_step)),
+        "tokens_per_s": None if invalid else batch * seq / per_step,
         "loss": float(loss),
-        "n_params": _count_params(params),
+        "n_params": n_params,
     }
+    if invalid:
+        out["error"] = "timing jitter swamped the step slope"
+    tokens_per_s = out["tokens_per_s"]
     # MFU: train step ≈ 6·N FLOPs/token (2 fwd + 4 bwd), vs platform peak
     spec = _tpu_spec(out["device_kind"]) if out["platform"] == "tpu" else None
-    if spec:
+    if spec and tokens_per_s:
         model_flops = 6.0 * out["n_params"] * tokens_per_s
         out["mfu"] = model_flops / (spec["peak_flops"] * n)
     return out
@@ -427,19 +479,25 @@ def bench_device_allreduce(tiny: bool = False) -> dict:
         try:
             x = col.shard_stacked(
                 [np.full(elems, r, np.float32) for r in range(n)])
-            out = col.allreduce(x, MpiOp.SUM)  # compile + warmup
-            jax.block_until_ready(out)
-            iters = 2 if mib >= 1024 else 5
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = col.allreduce(x, MpiOp.SUM)
-            jax.block_until_ready(out)
-            dt = (time.perf_counter() - t0) / iters
+            # n chained collectives per dispatch (allreduce_loop), fenced
+            # by a scalar readback; the two-point slope cancels dispatch
+            # Bound total work at the GiB end: n_hi=3 keeps the slope
+            # while the stage watchdog budget stays safe
+            dt, over_s = _fenced_loop_time(
+                lambda k: col.allreduce_loop(x, k, MpiOp.SUM),
+                lambda y: float(y.reshape(-1)[0]),
+                3 if mib >= 1024 else 8)
             s_bytes = elems * 4
-            bus_bw = 2 * (n - 1) / n * s_bytes / dt if n > 1 else s_bytes / dt
-            entry = {"payload_mib": mib, "time_ms": dt * 1000,
-                     "bus_gibs": bus_bw / (1 << 30)}
-            del x, out
+            if dt is None:
+                entry = {"payload_mib": mib,
+                         "error": "timing jitter swamped the slope"}
+            else:
+                bus_bw = (2 * (n - 1) / n * s_bytes / dt if n > 1
+                          else s_bytes / dt)
+                entry = {"payload_mib": mib, "time_ms": dt * 1000,
+                         "dispatch_ms": over_s * 1000,
+                         "bus_gibs": bus_bw / (1 << 30)}
+            del x
             curve.append(entry)
         except Exception as e:  # noqa: BLE001 — OOM at the big end is data
             curve.append({"payload_mib": mib, "error": str(e)[:120]})
@@ -462,9 +520,14 @@ def bench_device_allreduce(tiny: bool = False) -> dict:
 
 
 def bench_device_attention(tiny: bool = False) -> dict:
-    """Flash vs reference attention, fwd+bwd at the flagship shape — the
-    kernel-level evidence for the Pallas path (cheaper than a whole train
-    step: one small compile each)."""
+    """Flash vs reference attention, fwd and fwd+bwd, at the flagship
+    shape AND a long-context shape (where the O(S²) reference starts
+    paying for its score matrix) — the kernel-level evidence for the
+    Pallas path. Iterations chain on device (scan feeding each output
+    back as the next input) so the timing sees the kernels, not the
+    tunnel dispatch."""
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -477,31 +540,73 @@ def bench_device_attention(tiny: bool = False) -> dict:
         # nothing; the flash-vs-reference comparison is TPU-only
         return {"skipped": "flash kernel micro-bench is TPU-only"}
 
-    b, s, h, d = (2, 256, 4, 64) if tiny else (8, 512, 8, 64)
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
-    k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
-    v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    shapes = [(2, 256, 4, 64)] if tiny else [(8, 512, 8, 64),
+                                             (1, 4096, 8, 64)]
+    impls = [("flash", flash_attention),
+             ("reference", lambda q, k, v: _reference_attention(q, k, v))]
+    out: dict = {"shapes": [list(s) for s in shapes]}
+    for b, s, h, d in shapes:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+        sec: dict = {}
+        for name, fn in impls:
+            # fwd chain: output shape == q shape, and attention outputs
+            # are convex combinations of v, so values stay bounded
+            @functools.partial(jax.jit, static_argnames="n")
+            def run_f(q, k, v, n, fn=fn):
+                def body(carry, _):
+                    return fn(carry, k, v).astype(carry.dtype), None
+                y, _ = jax.lax.scan(body, q, None, length=n)
+                return y
 
-    out: dict = {"shape": [b, s, h, d]}
-    for name, fn in [
-        ("flash", flash_attention),
-        ("reference", lambda q, k, v: _reference_attention(q, k, v)),
-    ]:
-        f = jax.jit(jax.grad(
-            lambda q, k, v, fn=fn: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
-            argnums=(0, 1, 2)))
-        g = f(q, k, v)
-        jax.block_until_ready(g)
-        iters = 10
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            g = f(q, k, v)
-        jax.block_until_ready(g)
-        out[name + "_fwdbwd_ms"] = 1000 * (time.perf_counter() - t0) / iters
-    if out["flash_fwdbwd_ms"] > 0:
-        out["flash_speedup"] = (out["reference_fwdbwd_ms"]
-                                / out["flash_fwdbwd_ms"])
+            grad_fn = jax.grad(
+                lambda q, k, v, fn=fn: jnp.sum(
+                    fn(q, k, v).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))
+
+            # fwd+bwd chain: feed normalized grads back as next inputs
+            # (normalization keeps values finite; its cost is O(S·D),
+            # noise next to the O(S²·D) attention)
+            @functools.partial(jax.jit, static_argnames="n")
+            def run_fb(q, k, v, n, grad_fn=grad_fn):
+                def norm(g):
+                    g32 = g.astype(jnp.float32)
+                    return (g32 / (1.0 + jnp.max(jnp.abs(g32))))
+
+                def body(carry, _):
+                    dq, dk, dv = grad_fn(*carry)
+                    return (norm(dq).astype(carry[0].dtype),
+                            norm(dk).astype(carry[1].dtype),
+                            norm(dv).astype(carry[2].dtype)), None
+                (q2, _, _), _ = jax.lax.scan(body, (q, k, v), None, length=n)
+                return q2
+
+            fence = lambda y: float(y.reshape(-1)[0])  # noqa: E731
+            # Per-impl isolation: an OOM at the long-context shape (the
+            # O(S²) reference's score matrices) must not discard the
+            # numbers already measured for the other impl/shape
+            try:
+                per_f, _ = _fenced_loop_time(
+                    lambda n: run_f(q, k, v, n), fence, 8)
+                sec[name + "_fwd_ms"] = (None if per_f is None
+                                         else per_f * 1000)
+            except Exception as e:  # noqa: BLE001
+                sec[name + "_fwd_error"] = str(e)[:120]
+            try:
+                per_fb, _ = _fenced_loop_time(
+                    lambda n: run_fb(q, k, v, n), fence, 8)
+                sec[name + "_fwdbwd_ms"] = (None if per_fb is None
+                                            else per_fb * 1000)
+            except Exception as e:  # noqa: BLE001
+                sec[name + "_fwdbwd_error"] = str(e)[:120]
+        for tag in ("fwd", "fwdbwd"):
+            fl = sec.get(f"flash_{tag}_ms")
+            ref = sec.get(f"reference_{tag}_ms")
+            if fl and ref:
+                sec[f"flash_speedup_{tag}"] = ref / fl
+        out[f"s{s}"] = sec
     return out
 
 
@@ -537,24 +642,29 @@ def bench_device_snapshot(tiny: bool = False) -> dict:
 
 
 def bench_hbm_bandwidth() -> dict:
-    """HBM read+write bandwidth via a big on-device copy-scale (x·2 over
-    256 MiB touches 512 MiB of HBM traffic per iter)."""
+    """HBM read+write bandwidth via an on-device scale chain (each
+    fori_loop iteration reads + writes the 256 MiB buffer, each
+    data-dependent on the last so the loop cannot be collapsed)."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
     n_bytes = 256 * (1 << 20)
     x = jnp.arange(n_bytes // 4, dtype=jnp.float32)
-    f = jax.jit(lambda a: a * 2.0)
-    jax.block_until_ready(f(x))
-    iters = 10
-    t0 = time.perf_counter()
-    y = x
-    for _ in range(iters):
-        y = f(y)
-    jax.block_until_ready(y)
-    dt = (time.perf_counter() - t0) / iters
-    return {"traffic_gibs": 2 * n_bytes / dt / (1 << 30),
-            "payload_mib": n_bytes >> 20}
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def run(x, n):
+        return jax.lax.fori_loop(
+            0, n, lambda i, y: y * jnp.float32(1.0000001), x)
+
+    per, over_s = _fenced_loop_time(lambda k: run(x, k),
+                                    lambda y: float(y[123_457]), 16)
+    if per is None:
+        return {"payload_mib": n_bytes >> 20,
+                "error": "timing jitter swamped the slope"}
+    return {"traffic_gibs": 2 * n_bytes / per / (1 << 30),
+            "payload_mib": n_bytes >> 20, "dispatch_ms": over_s * 1000}
 
 
 def bench_device_phase(tiny: bool = False, out_path: str | None = None) -> dict:

@@ -11,6 +11,7 @@ from faabric_tpu.models.transformer import (
 from faabric_tpu.models.train import (
     data_sharding,
     init_train_state,
+    make_multi_step,
     make_optimizer,
     make_train_step,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "init_params",
     "init_train_state",
     "loss_fn",
+    "make_multi_step",
     "make_optimizer",
     "make_train_step",
     "param_shardings",

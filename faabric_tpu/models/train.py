@@ -47,15 +47,11 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
     return tx
 
 
-def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
-                    optimizer=None, accum_steps: int = 1):
-    """Returns jitted ``step(params, opt_state, tokens, targets) →
-    (params, opt_state, loss)``. ``accum_steps > 1`` splits the batch
-    into that many microbatches and accumulates gradients with a
-    ``lax.scan`` before the single optimizer update — big effective
-    batches without the activation memory (means over equal microbatches
-    equal the full-batch gradient exactly)."""
-    optimizer = optimizer or make_optimizer()
+def _build_step(cfg: ModelConfig, mesh: Optional[Mesh],
+                optimizer, accum_steps: int):
+    """The un-jitted step body shared by :func:`make_train_step` (one
+    dispatch per step) and :func:`make_multi_step` (n steps per
+    dispatch)."""
 
     def grads_of(params, tokens, targets):
         return jax.value_and_grad(loss_fn)(params, tokens, targets,
@@ -96,7 +92,55 @@ def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return step
+
+
+def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
+                    optimizer=None, accum_steps: int = 1):
+    """Returns jitted ``step(params, opt_state, tokens, targets) →
+    (params, opt_state, loss)``. ``accum_steps > 1`` splits the batch
+    into that many microbatches and accumulates gradients with a
+    ``lax.scan`` before the single optimizer update — big effective
+    batches without the activation memory (means over equal microbatches
+    equal the full-batch gradient exactly)."""
+    optimizer = optimizer or make_optimizer()
+    return jax.jit(_build_step(cfg, mesh, optimizer, accum_steps),
+                   donate_argnums=(0, 1))
+
+
+def make_multi_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
+                    optimizer=None, accum_steps: int = 1):
+    """Returns jitted ``run(params, opt_state, tokens, targets, n) →
+    (params, opt_state, last_loss)`` executing ``n`` whole train steps
+    inside ONE compiled program (``lax.scan`` over the step body).
+
+    This puts the training loop itself on the device: one dispatch —
+    and, on a remote PJRT client, one network round-trip — per n steps
+    instead of per step. ``tokens``/``targets`` carry a leading step
+    axis of length n (a fresh batch per step), or the plain batch shape
+    to reuse one batch every step (benchmarking)."""
+    optimizer = optimizer or make_optimizer()
+    step = _build_step(cfg, mesh, optimizer, accum_steps)
+
+    @partial(jax.jit, static_argnums=(4,), donate_argnums=(0, 1))
+    def run(params, opt_state, tokens, targets, n: int):
+        per_step = tokens.ndim == 3
+        if per_step and tokens.shape[0] != n:
+            raise ValueError(
+                f"tokens carry {tokens.shape[0]} per-step batches, n={n}")
+
+        def body(carry, xs):
+            p, o = carry
+            tok, tgt = xs if per_step else (tokens, targets)
+            p, o, loss = step(p, o, tok, tgt)
+            return (p, o), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state),
+            (tokens, targets) if per_step else None, length=n)
+        return params, opt_state, losses[-1]
+
+    return run
 
 
 def init_train_state(key: jax.Array, cfg: ModelConfig,

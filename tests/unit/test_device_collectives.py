@@ -172,3 +172,29 @@ def test_world_device_send_recv():
     np.testing.assert_array_equal(out[0], np.full(8, 3, np.float32))
     np.testing.assert_array_equal(out[2], np.zeros(8, np.float32))
     broker.clear()
+
+
+def test_allreduce_loop_matches_single(coll):
+    """n chained allreduces + the post-loop rescale == one allreduce,
+    for any n (and exactly for integer dtypes)."""
+    bufs = per_rank()
+    x = coll.shard_stacked(bufs)
+    total = np.sum(np.stack(bufs), axis=0)
+    for n in (1, 4):
+        out = coll.allreduce_loop(x, n, MpiOp.SUM)
+        for shard in coll.to_per_rank(out):
+            np.testing.assert_allclose(shard, total, rtol=1e-5)
+    ibufs = [np.full(16, 8 * (r + 1), np.int32) for r in range(N)]
+    iout = coll.allreduce_loop(coll.shard_stacked(ibufs), 3, MpiOp.SUM)
+    expected = np.sum(np.stack(ibufs), axis=0)
+    for shard in coll.to_per_rank(iout):
+        np.testing.assert_array_equal(shard, expected)
+
+
+def test_allreduce_loop_max(coll):
+    bufs = per_rank()
+    x = coll.shard_stacked(bufs)
+    out = coll.allreduce_loop(x, 3, MpiOp.MAX)
+    expected = np.max(np.stack(bufs), axis=0)
+    for shard in coll.to_per_rank(out):
+        np.testing.assert_allclose(shard, expected, rtol=1e-6)

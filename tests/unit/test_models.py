@@ -86,6 +86,45 @@ def test_train_step_reduces_loss_on_mesh():
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_multi_step_matches_sequential_steps():
+    """n steps in one compiled scan == n sequential make_train_step
+    calls (same optimizer, same batch every step)."""
+    from faabric_tpu.models import make_multi_step, make_optimizer
+
+    mesh = build_mesh(config=MeshConfig(dp=2, tp=2, sp=2))
+    tokens, targets = tiny_batch()
+    tokens = jax.device_put(jnp.asarray(tokens), data_sharding(mesh))
+    targets = jax.device_put(jnp.asarray(targets), data_sharding(mesh))
+
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), CFG, mesh)
+    step = make_train_step(CFG, mesh, make_optimizer())
+    for _ in range(3):
+        params, opt_state, loss_seq = step(params, opt_state, tokens, targets)
+
+    params2, opt2 = init_train_state(jax.random.PRNGKey(0), CFG, mesh)
+    run = make_multi_step(CFG, mesh, make_optimizer())
+    params2, opt2, loss_scan = run(params2, opt2, tokens, targets, 3)
+    np.testing.assert_allclose(float(loss_scan), float(loss_seq), rtol=2e-5)
+
+
+def test_multi_step_per_step_batches():
+    """A leading step axis feeds a fresh batch each step; mismatched
+    length is rejected."""
+    from faabric_tpu.models import make_multi_step
+
+    mesh = build_mesh(config=MeshConfig(dp=4, tp=2))
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), CFG, mesh)
+    run = make_multi_step(CFG, mesh)
+    tokens, targets = tiny_batch()
+    tok3 = jnp.stack([jnp.asarray(tokens)] * 3)
+    tgt3 = jnp.stack([jnp.asarray(targets)] * 3)
+    _, _, loss = run(params, opt_state, tok3, tgt3, 3)
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="per-step batches"):
+        run(*init_train_state(jax.random.PRNGKey(0), CFG, mesh),
+            tok3, tgt3, 4)
+
+
 def test_param_shardings_cover_all_params():
     params = init_params(jax.random.PRNGKey(0), CFG)
     mesh = build_mesh(config=MeshConfig(tp=2))
