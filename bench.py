@@ -760,9 +760,43 @@ def bench_host_calibration() -> dict:
     th.join(timeout=10)
     loopback_gibs = (4 * len(payload)) / (time.perf_counter() - t0) / (1 << 30)
     srv.close()
-    return {"memcpy_gibs": round(memcpy_gibs, 2),
-            "int32_add_gibs": round(add_gibs, 2),
-            "loopback_tcp_gibs": round(loopback_gibs, 2)}
+    out = {"memcpy_gibs": round(memcpy_gibs, 2),
+           "int32_add_gibs": round(add_gibs, 2),
+           "loopback_tcp_gibs": round(loopback_gibs, 2)}
+
+    # Raw shm-ring plane (native/shm_ring.cpp) at the bulk chunk size —
+    # the same-machine alternative to that loopback number
+    try:
+        from faabric_tpu.transport.shm import ShmRing, shm_available
+
+        if shm_available():
+            ring = ShmRing.create("calib", 32 << 20)
+            cons = ShmRing.attach(ring.name)
+            frame = np.zeros(4 << 20, np.uint8)
+            n_frames = 64  # 256 MiB
+
+            def drain():
+                k = 0
+                while k < n_frames:
+                    if cons.try_pop() is None:
+                        cons.wait_data(20_000)
+                    else:
+                        k += 1
+
+            td = threading.Thread(target=drain)
+            t0 = time.perf_counter()
+            td.start()
+            for _ in range(n_frames):
+                ring.push([frame], timeout=30)
+            td.join(timeout=30)
+            out["shm_ring_gibs"] = round(
+                n_frames * frame.nbytes
+                / (time.perf_counter() - t0) / (1 << 30), 2)
+            cons.close()
+            ring.close()
+    except Exception as e:  # noqa: BLE001
+        out["shm_ring_error"] = str(e)[:120]
+    return out
 
 
 def bench_dirty_tracker(quick: bool = False) -> dict:

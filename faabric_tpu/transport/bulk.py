@@ -24,9 +24,11 @@ sequence numbers the RPC plane stamps, and land in the same broker queues
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -50,6 +52,11 @@ MAX_FRAME_BYTES = 1 << 30
 # channel, seq, nbytes
 _FRAME = struct.Struct("<QQiiiiq")
 _U64 = (1 << 64) - 1
+
+# Sentinel frame announcing a same-machine shm ring (transport/shm.py):
+# nbytes carries the marker, seq carries the ring-name length, and the
+# name follows as the payload. Real frames always have nbytes >= 0.
+SHM_ANNOUNCE = -2
 
 from faabric_tpu.transport.message import tune_socket as _tune  # noqa: E402
 
@@ -76,6 +83,13 @@ class BulkServer:
         self._stopping = False
 
     def start(self) -> None:
+        # Sweep rings orphaned by killed peers before accepting new ones
+        try:
+            from faabric_tpu.transport.shm import gc_stale_rings
+
+            gc_stale_rings()
+        except Exception:  # noqa: BLE001 — GC must never block startup
+            pass
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("0.0.0.0", self.port))
@@ -110,6 +124,8 @@ class BulkServer:
             self._threads.append(t)
 
     def _conn_loop(self, conn: socket.socket) -> None:
+        drain_stop = threading.Event()
+        drain_thread: threading.Thread | None = None
         try:
             head = bytearray(_FRAME.size)
             while True:
@@ -117,6 +133,16 @@ class BulkServer:
                 (group_hi, group_lo, send_idx, recv_idx, channel, seq,
                  nbytes) = _FRAME.unpack(head)
                 group_id = (group_hi << 64) | group_lo
+                if nbytes == SHM_ANNOUNCE and 0 < seq <= 256:
+                    # Same-machine peer: attach its ring and drain it
+                    # alongside this connection (ring + TCP frames are
+                    # seq-merged by the receiver's ordered path)
+                    name_raw = bytearray(seq)
+                    _recv_exact_into(conn, memoryview(name_raw))
+                    if drain_thread is None:
+                        drain_thread = self._start_ring_drain(
+                            name_raw.decode("utf-8", "replace"), drain_stop)
+                    continue
                 # Garbage (port-scanner bytes, desynced stream) must not
                 # become a multi-GiB allocation or a dead thread: bound
                 # the frame and drop the connection on nonsense
@@ -140,10 +166,55 @@ class BulkServer:
         except Exception:  # noqa: BLE001 — one bad peer, not the server
             logger.exception("Bulk connection handler failed")
         finally:
+            if drain_thread is not None:
+                drain_stop.set()
+                drain_thread.join(timeout=2.0)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _start_ring_drain(self, name: str,
+                          stop: threading.Event) -> threading.Thread | None:
+        from faabric_tpu.transport.shm import ShmRing
+
+        try:
+            ring = ShmRing.attach(name)
+        except (OSError, ValueError, RuntimeError) as e:
+            logger.warning("Cannot attach announced shm ring %s: %s",
+                           name, e)
+            return None
+        t = threading.Thread(target=self._ring_drain_loop,
+                             args=(ring, stop),
+                             name=f"bulk-shm-{name[-12:]}", daemon=True)
+        t.start()
+        return t
+
+    def _ring_drain_loop(self, ring, stop: threading.Event) -> None:
+        """Pop frames (inner bulk header + payload as one ring frame)
+        and deliver; blocks in the kernel (shared futex, woken by the
+        producer's pushes) when idle."""
+        try:
+            while True:
+                frame = ring.try_pop()
+                if frame is None:
+                    if stop.is_set():
+                        return  # producer gone AND ring drained
+                    ring.wait_data(20_000)
+                    continue
+                (group_hi, group_lo, send_idx, recv_idx, channel, seq,
+                 nbytes) = _FRAME.unpack_from(frame)
+                payload = frame[_FRAME.size:]
+                if nbytes != len(payload):
+                    logger.warning("Desynced shm ring %s; abandoning",
+                                   ring.name)
+                    return
+                self.broker.deliver((group_hi << 64) | group_lo, send_idx,
+                                    recv_idx, payload, seq, channel)
+        except Exception:  # noqa: BLE001 — one bad ring, not the server
+            logger.exception("Shm ring drain failed")
+        finally:
+            ring.close(unlink=True)  # single-use name; clean /dev/shm
 
     def stop(self) -> None:
         self._stopping = True
@@ -176,14 +247,35 @@ class BulkServer:
         self._threads.clear()
 
 
+def _is_local_ip(ip: str) -> bool:
+    if ip.startswith("127.") or ip == "localhost":
+        return True
+    from faabric_tpu.util.network import get_primary_ip_for_this_host
+
+    try:
+        return ip == get_primary_ip_for_this_host()
+    except OSError:
+        return False
+
+
 class BulkClient:
     """One tuned connection to a destination host's BulkServer; sends are
-    serialized per client (frames must not interleave)."""
+    serialized per client (frames must not interleave).
+
+    When the destination resolves to THIS machine, payloads switch to a
+    shared-memory ring (transport/shm.py — one memcpy in, one out, no
+    TCP stack): the client creates the ring, announces it over the TCP
+    connection, and keeps TCP for frames too large for the ring and as
+    the liveness signal. Ring capacity: SHM_RING_BYTES (default 32 MiB,
+    power of two); SHM_BULK=0 disables."""
 
     def __init__(self, host: str) -> None:
         self.host = host
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        self._ring = None
+        self._ring_refused = False
+        self.shm_frames = 0  # observability: frames that rode the ring
 
     def _dial(self) -> socket.socket:
         ip, port = resolve_host(self.host, BULK_PORT)
@@ -191,7 +283,28 @@ class BulkClient:
                                      timeout=DEFAULT_SOCKET_TIMEOUT)
         _tune(s)
         s.settimeout(None)
+        self._maybe_announce_ring(s, ip)
         return s
+
+    def _maybe_announce_ring(self, sock: socket.socket, ip: str) -> None:
+        from faabric_tpu.transport import shm
+
+        if self._ring_refused or not _is_local_ip(ip) \
+                or not shm.shm_available():
+            return
+        try:
+            cap = int(os.environ.get("SHM_RING_BYTES",
+                                     shm.DEFAULT_RING_BYTES))
+            ring = shm.ShmRing.create(self.host, cap)
+        except (OSError, ValueError, RuntimeError) as e:
+            logger.warning("Shm ring setup for %s failed (%s); "
+                           "staying on TCP", self.host, e)
+            self._ring_refused = True
+            return
+        name = ring.name.encode()
+        sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(name), SHM_ANNOUNCE)
+                     + name)
+        self._ring = ring
 
     def send(self, group_id: int, send_idx: int, recv_idx: int,
              bufs, seq: int, channel: int) -> None:
@@ -205,6 +318,14 @@ class BulkClient:
         with self._lock:
             if self._sock is None:
                 self._sock = self._dial()
+            ring = self._ring
+            if ring is not None and nbytes + _FRAME.size + 8 <= ring.capacity:
+                # Inner header + payload as ONE ring frame; a full ring
+                # that stays full (stalled consumer) falls back to TCP,
+                # seq-merged at the receiver
+                if ring.push([head, *views]):
+                    self.shm_frames += 1
+                    return
             try:
                 self._sock.sendall(head)
                 for v in views:
@@ -240,12 +361,12 @@ class BulkClient:
             except OSError:
                 pass
             self._sock = None
+        if self._ring is not None:
+            # The ring rides the connection: the server's drain stops
+            # with the old conn, so a redial re-announces a fresh ring
+            self._ring.close(unlink=True)
+            self._ring = None
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self._reset_sock_locked()

@@ -1,0 +1,223 @@
+"""Shared-memory rings: the same-machine bulk data plane.
+
+When a bulk sender and receiver live on one physical machine (worker
+processes co-located on a host, or the aliased multi-host test/bench
+topology), payloads cross as ONE memcpy into a /dev/shm ring and one out
+— no sockets, no kernel TCP stack, no loopback round-trips. The ring
+itself is native C++ (native/shm_ring.cpp): a lock-free SPSC byte queue
+whose head/tail are C++ atomics in the shared mapping — the reference
+keeps same-host MPI traffic off sockets the same way with its in-process
+spinlock queues (include/faabric/mpi/MpiWorld.h:29-33); this is that
+design point carried across process boundaries.
+
+Rendezvous rides the existing bulk TCP connection: the client creates
+the ring file, announces its name in a sentinel frame, and the server
+attaches and drains it (transport/bulk.py). The TCP connection stays
+open as liveness signal and as the path for frames too large for the
+ring; both planes stamp the same sequence numbers, so the receiver's
+ordered path merges them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+import time
+
+import numpy as np
+
+from faabric_tpu.util.native import get_shmring_lib
+
+SHM_DIR = "/dev/shm"
+HDR_BYTES = 192
+DEFAULT_RING_BYTES = 32 * (1 << 20)
+
+_counter_lock = threading.Lock()
+_counter = 0
+
+
+def shm_available() -> bool:
+    return (os.environ.get("SHM_BULK", "1") != "0"
+            and os.path.isdir(SHM_DIR)
+            and os.access(SHM_DIR, os.W_OK)
+            and get_shmring_lib() is not None)
+
+
+def gc_stale_rings() -> int:
+    """Unlink rings whose creator process is gone (workers killed before
+    close() leak their /dev/shm files — the name embeds the creator pid
+    precisely so survivors can sweep them). Returns the count removed."""
+    removed = 0
+    try:
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return 0
+    for n in names:
+        if not n.startswith("faabric-ring-"):
+            continue
+        parts = n.rsplit("-", 2)
+        try:
+            pid = int(parts[-2])
+        except (ValueError, IndexError):
+            continue
+        if not os.path.exists(f"/proc/{pid}"):
+            try:
+                os.unlink(os.path.join(SHM_DIR, n))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def _next_name(tag: str) -> str:
+    global _counter
+    with _counter_lock:
+        _counter += 1
+        n = _counter
+    safe = "".join(c if c.isalnum() else "-" for c in tag)[:48]
+    return f"faabric-ring-{safe}-{os.getpid()}-{n}"
+
+
+class ShmRing:
+    """One direction of a same-machine channel. The creating side is the
+    producer; the attaching side the consumer (SPSC — exactly one of
+    each, enforced by the bulk plane's one-ring-per-connection use)."""
+
+    def __init__(self, name: str, mm: mmap.mmap, capacity: int,
+                 created: bool) -> None:
+        self.name = name
+        self._mm = mm
+        self.capacity = capacity
+        self._created = created
+        self._lib = get_shmring_lib()
+        buf = (ctypes.c_char * (HDR_BYTES + capacity)).from_buffer(mm)
+        self._base = ctypes.addressof(buf)
+        self._buf = buf  # keeps the mapping pinned
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def create(cls, tag: str, capacity: int = DEFAULT_RING_BYTES
+               ) -> "ShmRing":
+        if capacity & (capacity - 1):
+            raise ValueError(f"ring capacity {capacity} not a power of two")
+        lib = get_shmring_lib()
+        if lib is None:
+            raise RuntimeError("native shm ring unavailable")
+        name = _next_name(tag)
+        path = os.path.join(SHM_DIR, name)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, HDR_BYTES + capacity)
+            mm = mmap.mmap(fd, HDR_BYTES + capacity)
+        finally:
+            os.close(fd)
+        ring = cls(name, mm, capacity, created=True)
+        if lib.ring_init(ring._base, capacity) != 0:
+            ring.close()
+            raise RuntimeError("ring_init failed")
+        # Touch every page now: ftruncate hands out zero pages lazily,
+        # and a fault storm inside the first big frame's memcpy would
+        # bill the allocation to the hot path. (Skip page 0 — it holds
+        # the just-initialized header; writing a zero would eat the
+        # magic. Zeros elsewhere are what the fresh file holds anyway.)
+        np.frombuffer(mm, np.uint8)[mmap.PAGESIZE::mmap.PAGESIZE] = 0
+        return ring
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        lib = get_shmring_lib()
+        if lib is None:
+            raise RuntimeError("native shm ring unavailable")
+        if "/" in name or name.startswith("."):
+            raise ValueError(f"bad ring name {name!r}")
+        path = os.path.join(SHM_DIR, name)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        probe = (ctypes.c_char * size).from_buffer(mm)
+        cap = lib.ring_check(ctypes.addressof(probe))
+        del probe
+        if cap < 0 or HDR_BYTES + cap != size:
+            mm.close()
+            raise ValueError(f"{path} is not a valid ring")
+        return cls(name, mm, int(cap), created=False)
+
+    # ------------------------------------------------------------------
+    def try_push(self, bufs) -> bool:
+        """One frame gathered from bytes-like segments; False when the
+        ring lacks space (caller waits or falls back). Raises ValueError
+        for frames that can NEVER fit."""
+        arrs = [b if isinstance(b, np.ndarray) and b.dtype == np.uint8
+                and b.ndim == 1 else np.frombuffer(b, np.uint8)
+                for b in bufs]
+        n = len(arrs)
+        segs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+        lens = (ctypes.c_uint64 * n)(*[a.nbytes for a in arrs])
+        rc = self._lib.ring_try_pushv(self._base, segs, lens, n)
+        if rc == -2:
+            raise ValueError("frame larger than ring capacity")
+        return rc == 0
+
+    def push(self, bufs, timeout: float = 10.0) -> bool:
+        """Blocking push; False on timeout (consumer stalled — caller
+        falls back to TCP). Waits in the kernel on the ring's shared
+        futex, woken by the consumer's pops — no polling."""
+        if self.try_push(bufs):
+            return True
+        need = sum(len(memoryview(b).cast("B")) for b in bufs) + 8
+        deadline = time.monotonic() + timeout
+        while True:
+            self._lib.ring_wait_space(self._base, need, 20_000)
+            if self.try_push(bufs):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+
+    def wait_data(self, timeout_us: int = 20_000) -> bool:
+        """Block (kernel futex) until a frame is likely available; True
+        when data is visible. Spurious wakes possible — loop try_pop."""
+        return self._lib.ring_wait_data(self._base, timeout_us) == 0
+
+    def try_pop(self) -> np.ndarray | None:
+        """The next frame as a uint8 array (exclusively owned by the
+        caller), or None when the ring is empty. Peek-then-pop is safe:
+        this side is the only consumer, so the frame cannot change in
+        between — one exact-size allocation, one copy out."""
+        n = self._lib.ring_peek(self._base)
+        if n < 0:
+            return None
+        out = np.empty(n, np.uint8)
+        self._lib.ring_pop(self._base, out.ctypes.data, n)
+        return out
+
+    def peek(self) -> int:
+        """Next frame's payload length, or -1 when empty."""
+        return int(self._lib.ring_peek(self._base))
+
+    def free_space(self) -> int:
+        return int(self._lib.ring_free_space(self._base))
+
+    # ------------------------------------------------------------------
+    def close(self, unlink: bool | None = None) -> None:
+        """Drop the mapping; unlink defaults to whether this side created
+        the file (either side may force it — the name is single-use)."""
+        if self._mm is not None:
+            # ctypes buffers pin the mmap; drop them first
+            self._buf = None
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # a stale export keeps the map; the unlink still runs
+            self._mm = None
+        if unlink is None:
+            unlink = self._created
+        if unlink:
+            try:
+                os.unlink(os.path.join(SHM_DIR, self.name))
+            except OSError:
+                pass

@@ -73,8 +73,70 @@ def get_pagediff_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+_SHM_SRC = os.path.join(_REPO_ROOT, "native", "shm_ring.cpp")
+_SHM_SO = os.path.join(_REPO_ROOT, "native", "build", "libshmring.so")
+
+_shm_lib: Optional[ctypes.CDLL] = None
+_shm_tried = False
+
+
+def get_shmring_lib() -> Optional[ctypes.CDLL]:
+    """The SPSC shared-memory ring (native/shm_ring.cpp) — the
+    same-machine bulk data plane's hot path. None when g++ or the source
+    is unavailable; callers fall back to the TCP plane."""
+    global _shm_lib, _shm_tried
+    with _lock:
+        if _shm_tried:
+            return _shm_lib
+        _shm_tried = True
+        if not os.path.exists(_SHM_SRC):
+            return None
+        if not os.path.exists(_SHM_SO) or (os.path.getmtime(_SHM_SO)
+                                           < os.path.getmtime(_SHM_SRC)):
+            os.makedirs(os.path.dirname(_SHM_SO), exist_ok=True)
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                   _SHM_SRC, "-o", _SHM_SO]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, OSError) as e:
+                logger.warning("Native shm_ring build failed (%s); "
+                               "same-machine bulk stays on TCP", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_SHM_SO)
+        except OSError as e:
+            logger.warning("Could not load %s: %s", _SHM_SO, e)
+            return None
+        lib.ring_init.restype = ctypes.c_int
+        lib.ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ring_check.restype = ctypes.c_int64
+        lib.ring_check.argtypes = [ctypes.c_void_p]
+        lib.ring_free_space.restype = ctypes.c_int64
+        lib.ring_free_space.argtypes = [ctypes.c_void_p]
+        lib.ring_try_pushv.restype = ctypes.c_int
+        lib.ring_try_pushv.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_void_p),
+                                       ctypes.POINTER(ctypes.c_uint64),
+                                       ctypes.c_uint64]
+        lib.ring_peek.restype = ctypes.c_int64
+        lib.ring_peek.argtypes = [ctypes.c_void_p]
+        lib.ring_pop.restype = ctypes.c_int64
+        lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_uint64]
+        lib.ring_wait_data.restype = ctypes.c_int
+        lib.ring_wait_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.ring_wait_space.restype = ctypes.c_int
+        lib.ring_wait_space.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                        ctypes.c_uint32]
+        _shm_lib = lib
+        return _shm_lib
+
+
 def reset_for_tests() -> None:
-    global _lib, _tried
+    global _lib, _tried, _shm_lib, _shm_tried
     with _lock:
         _lib = None
         _tried = False
+        _shm_lib = None
+        _shm_tried = False

@@ -240,3 +240,36 @@ def test_bulk_server_survives_garbage(bulk_pair):
     got = bulk_pair["bulkB"].recv_message(GROUP, 0, 1, must_order=True,
                                           timeout=10.0)
     assert bytes(got) == payload
+
+
+def test_same_machine_bulk_rides_shm_ring(bulk_pair):
+    """Both brokers resolve to 127.0.0.1, so bulk frames must switch to
+    the shared-memory ring after the announce — and still arrive intact,
+    in order, seq-merged with any TCP frames."""
+    from faabric_tpu.transport.shm import shm_available
+
+    if not shm_available():
+        pytest.skip("no /dev/shm or native build")
+    a, b = bulk_pair["bulkA"], bulk_pair["bulkB"]
+    payloads = [bytes(np.arange(BULK_THRESHOLD + i * 1000,
+                                dtype=np.uint8) % 251)
+                for i in range(4)]
+    for p in payloads:
+        a.send_message(GROUP, 0, 1, p, must_order=True)
+    for p in payloads:
+        got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+        assert bytes(got) == p
+    client = a._get_bulk_client("bulkB")
+    assert client._ring is not None, "ring never announced"
+    assert client.shm_frames >= len(payloads), (
+        f"only {client.shm_frames} frames rode the ring")
+
+
+def test_shm_disabled_env_falls_back_to_tcp(bulk_pair, monkeypatch):
+    monkeypatch.setenv("SHM_BULK", "0")
+    a, b = bulk_pair["bulkA"], bulk_pair["bulkB"]
+    payload = bytes(np.arange(BULK_THRESHOLD, dtype=np.uint8) % 251)
+    a.send_message(GROUP, 0, 1, payload, must_order=True)
+    got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    assert bytes(got) == payload
+    assert a._get_bulk_client("bulkB").shm_frames == 0

@@ -1,0 +1,139 @@
+"""Shared-memory bulk plane: the native SPSC ring (native/shm_ring.cpp
+via transport/shm.py) and its integration with the bulk data plane.
+
+Reference analog: faabric keeps same-host MPI traffic on in-memory
+spinlock queues instead of sockets (include/faabric/mpi/MpiWorld.h:29-33);
+here co-located ranks are separate processes, so the queue lives in
+/dev/shm with C++ atomics for the indices.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from faabric_tpu.transport.shm import (
+    DEFAULT_RING_BYTES,
+    ShmRing,
+    shm_available,
+)
+
+pytestmark = pytest.mark.skipif(not shm_available(),
+                                reason="no /dev/shm or native build")
+
+
+def test_push_pop_roundtrip_and_fifo():
+    r = ShmRing.create("t1", 1 << 16)
+    try:
+        c = ShmRing.attach(r.name)
+        assert c.try_pop() is None and c.peek() == -1
+        r.try_push([b"alpha ", b"beta"])
+        r.try_push([np.arange(100, dtype=np.uint8)])
+        assert c.peek() == 10
+        assert bytes(c.try_pop()) == b"alpha beta"
+        np.testing.assert_array_equal(c.try_pop(),
+                                      np.arange(100, dtype=np.uint8))
+        c.close()
+    finally:
+        r.close()
+    assert not os.path.exists("/dev/shm/" + r.name)
+
+
+def test_wraparound_many_frames():
+    """Frames totalling many times the capacity: modular copies must
+    reassemble exactly at every offset."""
+    r = ShmRing.create("t2", 1 << 14)
+    c = ShmRing.attach(r.name)
+    try:
+        rng = np.random.RandomState(0)
+        for i in range(200):
+            frame = rng.randint(0, 256, rng.randint(1, 5000),
+                                dtype=np.uint8).astype(np.uint8)
+            assert r.try_push([frame])
+            got = c.try_pop()
+            np.testing.assert_array_equal(got, frame), i
+    finally:
+        c.close()
+        r.close()
+
+
+def test_full_ring_rejects_then_drains():
+    r = ShmRing.create("t3", 1 << 12)
+    c = ShmRing.attach(r.name)
+    try:
+        pushed = 0
+        while r.try_push([b"z" * 100]):
+            pushed += 1
+        assert pushed > 0
+        assert not r.try_push([b"z" * 100])  # full
+        assert r.free_space() < 108
+        drained = 0
+        while c.try_pop() is not None:
+            drained += 1
+        assert drained == pushed
+        assert r.try_push([b"z" * 100])  # space again
+    finally:
+        c.close()
+        r.close()
+
+
+def test_oversize_frame_raises():
+    r = ShmRing.create("t4", 1 << 12)
+    try:
+        with pytest.raises(ValueError, match="larger than ring"):
+            r.try_push([b"x" * (1 << 13)])
+    finally:
+        r.close()
+
+
+def test_attach_rejects_garbage_file():
+    path = "/dev/shm/faabric-ring-garbage-test"
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 4096)
+    try:
+        with pytest.raises(ValueError, match="not a valid ring"):
+            ShmRing.attach(os.path.basename(path))
+    finally:
+        os.unlink(path)
+    with pytest.raises(ValueError, match="bad ring name"):
+        ShmRing.attach("../etc/passwd")
+
+
+def test_concurrent_producer_consumer_threads():
+    """SPSC under real concurrency: producer and consumer in separate
+    threads, every frame accounted for, bytes intact."""
+    r = ShmRing.create("t5", 1 << 16)
+    c = ShmRing.attach(r.name)
+    n_frames, got = 500, []
+    rng = np.random.RandomState(1)
+    frames = [rng.randint(0, 256, rng.randint(1, 2000), dtype=np.uint8)
+              .astype(np.uint8) for _ in range(n_frames)]
+
+    def produce():
+        for f in frames:
+            assert r.push([f], timeout=10.0)
+
+    def consume():
+        while len(got) < n_frames:
+            f = c.try_pop()
+            if f is not None:
+                got.append(f)
+
+    try:
+        tp = threading.Thread(target=produce)
+        tc = threading.Thread(target=consume)
+        tp.start(); tc.start()
+        tp.join(15); tc.join(15)
+        assert len(got) == n_frames
+        for a, b in zip(got, frames):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        c.close()
+        r.close()
+
+
+def test_default_capacity_is_power_of_two():
+    assert DEFAULT_RING_BYTES & (DEFAULT_RING_BYTES - 1) == 0
+    with pytest.raises(ValueError, match="power of two"):
+        ShmRing.create("t6", 1000)
