@@ -131,10 +131,13 @@ class DeviceCollectives:
 
         The loop body is the bare reduce (no per-hop work rides inside
         the timed region); for SUM the value grows ×ranks per extra hop
-        and ONE post-loop rescale by ranks^(n−1) — constant per call, so
-        a two-point timing slope cancels it — restores the plain sum.
-        Interim SUM values must stay within the dtype's range for the
-        chosen n (the caller bounds magnitudes; MAX/MIN are idempotent).
+        and ONE post-loop rescale by ranks^(n−1) restores the plain sum.
+        The rescale (a full elementwise HBM pass) exists only for n ≥ 2
+        (growth is 1 at n = 1), so a two-point timing slope cancels it
+        ONLY if both trip counts are ≥ 2 — time with n_lo=2, not 1, or
+        the slope charges that pass to per-hop time (ADVICE r3). Interim
+        SUM values must stay within the dtype's range for the chosen n
+        (the caller bounds magnitudes; MAX/MIN are idempotent).
         """
         prim = _PRIMITIVE_REDUCERS.get(op)
         if prim is None:

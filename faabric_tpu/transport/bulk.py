@@ -57,6 +57,11 @@ _U64 = (1 << 64) - 1
 # nbytes carries the marker, seq carries the ring-name length, and the
 # name follows as the payload. Real frames always have nbytes >= 0.
 SHM_ANNOUNCE = -2
+# Sentinel retiring the announced ring: the client abandoned it (push
+# timeout / unacked), so the server must stop the drain — otherwise the
+# drain thread spins on wait_data forever, pinning the unlinked mapping
+# for the connection's lifetime.
+SHM_RETIRE = -3
 
 from faabric_tpu.transport.message import tune_socket as _tune  # noqa: E402
 
@@ -81,6 +86,11 @@ class BulkServer:
         self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
         self._stopping = False
+        # Ring names with a live drain (ADVICE r3): a second connection
+        # announcing an already-attached name would put TWO consumers on
+        # an SPSC ring — peek/pop races corrupt frames for the legitimate
+        # owner, and the duplicate's cleanup unlinks the live ring
+        self._attached_rings: set[str] = set()
 
     def start(self) -> None:
         # Sweep rings orphaned by killed peers before accepting new ones
@@ -127,6 +137,10 @@ class BulkServer:
         drain_stop = threading.Event()
         drain_thread: threading.Thread | None = None
         try:
+            peer_ip = conn.getpeername()[0]
+        except OSError:
+            peer_ip = ""
+        try:
             head = bytearray(_FRAME.size)
             while True:
                 _recv_exact_into(conn, memoryview(head))
@@ -136,12 +150,29 @@ class BulkServer:
                 if nbytes == SHM_ANNOUNCE and 0 < seq <= 256:
                     # Same-machine peer: attach its ring and drain it
                     # alongside this connection (ring + TCP frames are
-                    # seq-merged by the receiver's ordered path)
+                    # seq-merged by the receiver's ordered path). A ring
+                    # is shared memory: only a LOCAL peer can legitimately
+                    # announce one (the port binds 0.0.0.0, unauthenticated)
                     name_raw = bytearray(seq)
                     _recv_exact_into(conn, memoryview(name_raw))
-                    if drain_thread is None:
+                    if drain_thread is None and _is_local_ip(peer_ip):
                         drain_thread = self._start_ring_drain(
                             name_raw.decode("utf-8", "replace"), drain_stop)
+                    # ACK/NACK the attach: the client must never push a
+                    # frame into a ring nothing drains (the frame would
+                    # be silently lost — a seq gap the TCP fallback then
+                    # cannot heal)
+                    conn.sendall(b"\x01" if drain_thread is not None
+                                 else b"\x00")
+                    continue
+                if nbytes == SHM_RETIRE:
+                    # Client abandoned the ring; the drain finishes what
+                    # is already buffered (stop is only honored once the
+                    # ring reads empty) then exits and unlinks
+                    if drain_thread is not None:
+                        drain_stop.set()
+                        drain_thread.join(timeout=5.0)
+                        drain_thread = None
                     continue
                 # Garbage (port-scanner bytes, desynced stream) must not
                 # become a multi-GiB allocation or a dead thread: bound
@@ -178,11 +209,21 @@ class BulkServer:
                           stop: threading.Event) -> threading.Thread | None:
         from faabric_tpu.transport.shm import ShmRing
 
+        with self._lock:
+            if name in self._attached_rings:
+                # SPSC ring: a second drain on the same name is never
+                # legitimate (duplicate/forged announce) — refuse
+                logger.warning("Refusing duplicate attach of live shm "
+                               "ring %s", name)
+                return None
+            self._attached_rings.add(name)
         try:
             ring = ShmRing.attach(name)
         except (OSError, ValueError, RuntimeError) as e:
             logger.warning("Cannot attach announced shm ring %s: %s",
                            name, e)
+            with self._lock:
+                self._attached_rings.discard(name)
             return None
         t = threading.Thread(target=self._ring_drain_loop,
                              args=(ring, stop),
@@ -215,6 +256,8 @@ class BulkServer:
             logger.exception("Shm ring drain failed")
         finally:
             ring.close(unlink=True)  # single-use name; clean /dev/shm
+            with self._lock:
+                self._attached_rings.discard(ring.name)
 
     def stop(self) -> None:
         self._stopping = True
@@ -304,7 +347,28 @@ class BulkClient:
         name = ring.name.encode()
         sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(name), SHM_ANNOUNCE)
                      + name)
-        self._ring = ring
+        # Wait for the server's attach ACK: only an acked ring carries
+        # frames (an unattached ring would swallow them silently)
+        try:
+            sock.settimeout(5.0)
+            ack = sock.recv(1)
+        except OSError:
+            ack = b""
+        finally:
+            sock.settimeout(None)
+        if ack == b"\x01":
+            self._ring = ring
+        else:
+            logger.warning("Bulk server did not ack shm ring for %s; "
+                           "staying on TCP", self.host)
+            # If the ACK was merely lost/late, a drain may exist: retire
+            # it so it never idles forever on an abandoned ring
+            try:
+                sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, 0, SHM_RETIRE))
+            except OSError:
+                pass
+            ring.close(unlink=True)
+            self._ring_refused = True
 
     def send(self, group_id: int, send_idx: int, recv_idx: int,
              bufs, seq: int, channel: int) -> None:
@@ -320,12 +384,30 @@ class BulkClient:
                 self._sock = self._dial()
             ring = self._ring
             if ring is not None and nbytes + _FRAME.size + 8 <= ring.capacity:
-                # Inner header + payload as ONE ring frame; a full ring
-                # that stays full (stalled consumer) falls back to TCP,
-                # seq-merged at the receiver
-                if ring.push([head, *views]):
+                # Inner header + payload as ONE ring frame. A push
+                # timeout means the server-side drain never started or
+                # died (the announce is fire-and-forget): treat it as
+                # ring DEATH and stay on TCP — retrying every send would
+                # stall each one the full timeout while holding the
+                # client lock (ADVICE r3). The first push gets a short
+                # leash because an unattached ring can never drain.
+                if ring.push([head, *views],
+                             timeout=2.0 if self.shm_frames == 0 else 5.0):
                     self.shm_frames += 1
                     return
+                logger.warning("Shm ring for %s stalled; abandoning ring, "
+                               "staying on TCP", self.host)
+                # Tell the server to stop the drain (if it is merely
+                # slow, it finishes the buffered frames first — their
+                # seqs precede this frame's, so ordering holds)
+                try:
+                    self._sock.sendall(
+                        _FRAME.pack(0, 0, 0, 0, 0, 0, SHM_RETIRE))
+                except OSError:
+                    pass
+                ring.close(unlink=True)
+                self._ring = None
+                self._ring_refused = True
             try:
                 self._sock.sendall(head)
                 for v in views:

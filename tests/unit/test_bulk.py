@@ -31,6 +31,8 @@ def bulk_pair():
     register_host_alias("bulkB", "127.0.0.1", base + 1000)
     brokers = {h: PointToPointBroker(h) for h in ("bulkA", "bulkB")}
     servers = [PointToPointServer(b) for b in brokers.values()]
+    for b, s in zip(brokers.values(), servers):
+        b.test_ptp_server = s  # white-box handle for the bulk tests
     for s in servers:
         s.start()
     d = SchedulingDecision(app_id=GROUP, group_id=GROUP)
@@ -273,3 +275,105 @@ def test_shm_disabled_env_falls_back_to_tcp(bulk_pair, monkeypatch):
     got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
     assert bytes(got) == payload
     assert a._get_bulk_client("bulkB").shm_frames == 0
+
+
+def test_duplicate_ring_attach_refused(bulk_pair):
+    """A second announce of an already-live ring name must NOT spawn a
+    second consumer on the SPSC ring (two drains race on peek/pop and
+    the loser's cleanup unlinks the live ring)."""
+    import socket
+    import threading
+    import time
+
+    from faabric_tpu.transport.bulk import BULK_PORT, SHM_ANNOUNCE, _FRAME
+    from faabric_tpu.transport.common import resolve_host
+    from faabric_tpu.transport.shm import shm_available
+
+    if not shm_available():
+        pytest.skip("no /dev/shm or native build")
+    a, b = bulk_pair["bulkA"], bulk_pair["bulkB"]
+    # Establish the legitimate ring
+    a.send_message(GROUP, 0, 1, b"x" * (BULK_THRESHOLD + 1),
+                   must_order=True)
+    b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    client = a._get_bulk_client("bulkB")
+    assert client._ring is not None
+    name = client._ring.name
+    server = b.test_ptp_server._bulk_server
+    assert name in server._attached_rings
+
+    # Forged second announce of the same name from another connection
+    ip, port = resolve_host("bulkB", BULK_PORT)
+    s = socket.create_connection((ip, port), timeout=5)
+    raw = name.encode()
+    s.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(raw), SHM_ANNOUNCE) + raw)
+    time.sleep(0.3)
+
+    # Still exactly one drain registered, and traffic still flows on it
+    assert list(server._attached_rings) == [name]
+    drains = [t for t in threading.enumerate()
+              if t.name == f"bulk-shm-{name[-12:]}"]
+    assert len(drains) == 1
+    payload = bytes(np.arange(BULK_THRESHOLD * 2, dtype=np.uint8) % 251)
+    a.send_message(GROUP, 0, 1, payload, must_order=True)
+    got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    assert bytes(got) == payload
+    s.close()
+
+
+def test_ring_attach_nack_falls_back_to_tcp(bulk_pair, monkeypatch):
+    """If the server cannot attach the announced ring, its NACK must put
+    the client on TCP immediately — a frame pushed into a ring nothing
+    drains would be silently lost (ADVICE r3)."""
+    import time
+
+    from faabric_tpu.transport.bulk import BulkServer
+    from faabric_tpu.transport.shm import shm_available
+
+    if not shm_available():
+        pytest.skip("no /dev/shm or native build")
+    a, b = bulk_pair["bulkA"], bulk_pair["bulkB"]
+    # Server refuses every attach => announce gets a NACK
+    monkeypatch.setattr(BulkServer, "_start_ring_drain",
+                        lambda self, name, stop: None)
+
+    payload = bytes(np.arange(BULK_THRESHOLD + 7, dtype=np.uint8) % 251)
+    t0 = time.perf_counter()
+    a.send_message(GROUP, 0, 1, payload, must_order=True)
+    first_s = time.perf_counter() - t0
+    got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    assert bytes(got) == payload
+    client = a._get_bulk_client("bulkB")
+    assert client._ring is None and client._ring_refused
+    assert first_s < 4.0
+    # Later sends pay no ring cost at all
+    t0 = time.perf_counter()
+    a.send_message(GROUP, 0, 1, payload, must_order=True)
+    assert time.perf_counter() - t0 < 1.0
+    got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    assert bytes(got) == payload
+
+
+def test_ring_push_timeout_declares_ring_dead(bulk_pair, monkeypatch):
+    """A push timeout after a successful attach (drain died later) must
+    abandon the ring and deliver the frame over TCP — not stall every
+    subsequent send for the full push timeout (ADVICE r3)."""
+    from faabric_tpu.transport.shm import shm_available
+
+    if not shm_available():
+        pytest.skip("no /dev/shm or native build")
+    a, b = bulk_pair["bulkA"], bulk_pair["bulkB"]
+    # Establish the ring
+    a.send_message(GROUP, 0, 1, b"y" * (BULK_THRESHOLD + 1),
+                   must_order=True)
+    b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    client = a._get_bulk_client("bulkB")
+    assert client._ring is not None
+    # Simulate a dead drain: every push times out
+    monkeypatch.setattr(client._ring, "push", lambda *args, **kw: False)
+
+    payload = bytes(np.arange(BULK_THRESHOLD + 3, dtype=np.uint8) % 251)
+    a.send_message(GROUP, 0, 1, payload, must_order=True)
+    got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
+    assert bytes(got) == payload
+    assert client._ring is None and client._ring_refused
