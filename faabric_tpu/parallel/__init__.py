@@ -41,3 +41,15 @@ from faabric_tpu.parallel.pipeline import (  # noqa: E402
 __all__ += ["init_pp_train_state", "make_pp_loss", "make_pp_train_step",
             "microbatch", "pp_data_sharding", "pp_param_shardings",
             "stack_block_params", "unstack_block_params"]
+
+from faabric_tpu.parallel.distributed import (  # noqa: E402
+    DevicePlaneSpec,
+    current_plane,
+    join_device_plane,
+    leave_device_plane,
+    plane_summary,
+    request_device_plane,
+)
+
+__all__ += ["DevicePlaneSpec", "current_plane", "join_device_plane",
+            "leave_device_plane", "plane_summary", "request_device_plane"]

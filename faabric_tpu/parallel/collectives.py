@@ -74,9 +74,47 @@ class DeviceCollectives:
 
     def shard_stacked(self, per_rank: Sequence[np.ndarray]) -> jax.Array:
         """Place one buffer per rank onto its device as a stacked global
-        array of shape (n_ranks, *buf)."""
+        array of shape (n_ranks, *buf). Single-controller form: this
+        process must hold every rank's buffer (all devices addressable
+        or the data replicated); on a multi-process plane use
+        :meth:`shard_stacked_addressable`."""
         stacked = jnp.stack([jnp.asarray(b) for b in per_rank])
         return jax.device_put(stacked, self.sharding())
+
+    def shard_stacked_addressable(self, local_per_rank,
+                                  buf_shape: tuple,
+                                  dtype) -> jax.Array:
+        """Multi-process form of :meth:`shard_stacked`: each process
+        supplies buffers ONLY for the ranks whose devices it owns
+        (``local_per_rank``: rank → buffer mapping), and the global
+        (n_ranks, *buf) array is assembled from the per-device shards —
+        no process ever materialises another process's data. This is
+        the construction every cross-process collective starts from
+        (jax multi-controller SPMD: same jitted call in every process,
+        one global array)."""
+        my_proc = jax.process_index()
+        shards = []
+        for rank, dev in enumerate(self.devices):
+            if dev.process_index != my_proc:
+                continue
+            if rank not in local_per_rank:
+                raise KeyError(
+                    f"process {my_proc} owns rank {rank} (device {dev}) "
+                    "but no buffer was supplied for it")
+            buf = np.asarray(local_per_rank[rank], dtype).reshape(buf_shape)
+            shards.append(jax.device_put(buf[None], dev))
+        return jax.make_array_from_single_device_arrays(
+            (self.n, *buf_shape), self.sharding(), shards)
+
+    def addressable_shard(self, x: jax.Array, rank: int) -> np.ndarray:
+        """This process's view of ``rank``'s shard (raises if the rank's
+        device belongs to another process)."""
+        dev = self.devices[rank]
+        for s in x.addressable_shards:
+            if s.device == dev:
+                return np.asarray(s.data)
+        raise KeyError(f"rank {rank} shard lives on {dev}, not in "
+                       f"process {jax.process_index()}")
 
     # ------------------------------------------------------------------
     def _compiled(self, key: tuple, build) -> Any:

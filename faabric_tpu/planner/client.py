@@ -248,6 +248,20 @@ class PlannerClient(MessageEndpointClient):
             return None
         return SchedulingDecision.from_dict(resp.header["decision"])
 
+    def join_device_plane(self, n_processes: int):
+        """One join/poll step for the multi-process device plane
+        (parallel/distributed.py): None until the roster is full, then
+        this host's DevicePlaneSpec. Idempotent — the planner remembers
+        this host's slot across polls."""
+        from faabric_tpu.parallel.distributed import DevicePlaneSpec
+
+        resp = self.sync_send(int(PlannerCalls.JOIN_DEVICE_PLANE), {
+            "host": self.this_host, "n_processes": n_processes,
+        }, idempotent=True)
+        if not resp.header.get("found"):
+            return None
+        return DevicePlaneSpec.from_dict(resp.header["spec"])
+
     def claim_state_master(self, user: str, key: str) -> str:
         resp = self.sync_send(int(PlannerCalls.CLAIM_STATE_MASTER), {
             "user": user, "key": key, "host": self.this_host,

@@ -133,6 +133,13 @@ class Planner:
         # service, so a claim is one RPC with no external dependency.
         self._state_masters: dict[str, str] = {}
 
+        # Multi-process device plane (parallel/distributed.py): workers
+        # join at boot; the planner assigns process ids in join order
+        # and elects the first joiner's host as jax.distributed
+        # coordinator. Worker-lifetime, not per-app — the TPU analog of
+        # claiming a pod slice.
+        self._device_plane: dict = {"roster": [], "size": 0, "port": 0}
+
     # ------------------------------------------------------------------
     # Host membership (reference Planner.cpp:267-392)
     # ------------------------------------------------------------------
@@ -179,6 +186,56 @@ class Planner:
     def set_next_evicted_host_ips(self, ips: list[str]) -> None:
         with self._lock:
             self._next_evicted_ips = set(ips)
+
+    # ------------------------------------------------------------------
+    # Multi-process device plane (parallel/distributed.py)
+    # ------------------------------------------------------------------
+    def join_device_plane(self, host: str,
+                          n_processes: int) -> Optional[dict]:
+        """Add ``host`` to the device-plane roster; once the roster is
+        full, return this host's spec (callers poll until then). Process
+        ids are assigned in join order and stay stable across polls; the
+        first joiner's host runs the jax.distributed coordination
+        service on a port claimed from its MPI pool. Reference analog:
+        the cross-host plane MpiWorld builds per world
+        (src/mpi/MpiWorld.cpp:1789-1934) — but formed ONCE per worker
+        lifetime, like claiming a TPU pod slice."""
+        with self._lock:
+            dp = self._device_plane
+            if dp["size"] == 0:
+                dp["size"] = n_processes
+            elif dp["size"] != n_processes:
+                raise ValueError(
+                    f"device plane already sized {dp['size']}, host "
+                    f"{host} asked for {n_processes}")
+            if host not in dp["roster"]:
+                if len(dp["roster"]) >= dp["size"]:
+                    raise ValueError(
+                        f"device plane full ({dp['size']}); {host} "
+                        "cannot join")
+                dp["roster"].append(host)
+            if len(dp["roster"]) < dp["size"]:
+                return None
+            if not dp["port"]:
+                coord = dp["roster"][0]
+                h = self._hosts.get(coord)
+                # Fall back to the pool's last port if the coordinator
+                # never registered (tests driving the planner directly)
+                dp["port"] = (h.claim_mpi_port() if h is not None
+                              else MPI_BASE_PORT + MPI_PORTS_PER_HOST - 1)
+            return {"coordinator_host": dp["roster"][0],
+                    "coordinator_port": dp["port"],
+                    "num_processes": dp["size"],
+                    "process_id": dp["roster"].index(host)}
+
+    def clear_device_plane(self) -> None:
+        with self._lock:
+            dp = self._device_plane
+            if dp["port"]:
+                h = self._hosts.get(dp["roster"][0]) if dp["roster"] else None
+                if h is not None:
+                    h.release_mpi_port(dp["port"])
+            self._device_plane = {"roster": [], "size": 0, "port": 0}
 
     # ------------------------------------------------------------------
     # The scheduling brain (reference Planner::callBatch)
@@ -847,6 +904,7 @@ class Planner:
             self._next_evicted_ips.clear()
             self._group_hosts.clear()
             self._state_masters.clear()
+            self._device_plane = {"roster": [], "size": 0, "port": 0}
             self._num_migrations = 0
             self._clients.close_all()
             self._snapshot_clients.close_all()

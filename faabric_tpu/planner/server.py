@@ -39,6 +39,7 @@ class PlannerCalls(enum.IntEnum):
     CLAIM_STATE_MASTER = 12
     DROP_STATE_MASTER = 13
     CHECK_MIGRATION = 14
+    JOIN_DEVICE_PLANE = 15
 
 
 class PlannerServer(MessageEndpointServer):
@@ -129,6 +130,13 @@ class PlannerServer(MessageEndpointServer):
         if code == int(PlannerCalls.GET_NUM_MIGRATIONS):
             return handler_response(
                 header={"num_migrations": self.planner.get_num_migrations()})
+
+        if code == int(PlannerCalls.JOIN_DEVICE_PLANE):
+            spec = self.planner.join_device_plane(h["host"],
+                                                  h["n_processes"])
+            if spec is None:
+                return handler_response(header={"found": False})
+            return handler_response(header={"found": True, "spec": spec})
 
         if code == int(PlannerCalls.CALL_BATCH):
             req = ber_from_wire(msg.header["ber"], msg.payload)

@@ -25,11 +25,16 @@ logger = get_logger(__name__)
 class WorkerRuntime:
     def __init__(self, host: str = "", slots: int = 0, n_devices: int = 0,
                  factory: Optional[ExecutorFactory] = None,
-                 planner_host: str | None = None) -> None:
+                 planner_host: str | None = None,
+                 device_plane_size: int = 0) -> None:
         conf = get_system_config()
         self.host = host or get_primary_ip_for_this_host()
         self.slots = slots or conf.get_usable_cores()
         self.n_devices = n_devices
+        # >1: join the multi-process device plane at boot — this worker
+        # contributes its local chips to ONE global jax mesh spanning
+        # device_plane_size worker processes (parallel/distributed.py)
+        self.device_plane_size = device_plane_size
 
         if factory is not None:
             set_executor_factory(factory)
@@ -90,6 +95,15 @@ class WorkerRuntime:
             self.planner_client.register_host(
                 self.slots, self.n_devices, overwrite=True,
                 start_keep_alive=True)
+        if self.device_plane_size > 1:
+            from faabric_tpu.parallel.distributed import (
+                join_device_plane,
+                request_device_plane,
+            )
+
+            spec = request_device_plane(self.planner_client,
+                                        self.device_plane_size)
+            join_device_plane(spec)
         logger.debug("Worker %s up (slots=%d chips=%d)", self.host,
                      self.slots, self.n_devices)
 
@@ -107,6 +121,10 @@ class WorkerRuntime:
                 self.planner_client.remove_host()
             except Exception:  # noqa: BLE001 — planner may already be gone
                 logger.debug("Could not deregister %s", self.host)
+        if self.device_plane_size > 1:
+            from faabric_tpu.parallel.distributed import leave_device_plane
+
+            leave_device_plane()
         self.scheduler.shutdown()
         for server in reversed(self.extra_servers):
             server.stop()

@@ -411,9 +411,101 @@ def run_worker(host: str) -> None:
     w.shutdown()
 
 
+def run_plane_worker(host: str, n_procs: int) -> None:
+    """Multi-process device plane worker (parallel/distributed.py): joins
+    the planner-coordinated plane at boot with 4 virtual CPU devices,
+    then proves a cross-process device collective — the shards of one
+    global array live in BOTH worker processes and each process verifies
+    its own shards of the result. Reference analog: the cross-host MPI
+    data plane (src/mpi/MpiWorld.cpp:1789-1934), replaced here by XLA
+    collectives over one jax.distributed plane."""
+    from faabric_tpu.parallel.distributed import force_cpu_virtual_devices
+
+    force_cpu_virtual_devices(4)
+
+    from faabric_tpu.runner import WorkerRuntime
+
+    # register=False: plane workers take no scheduled work (and must not
+    # linger in the planner's host table after this short-lived proc)
+    w = WorkerRuntime(host=host, slots=1, n_devices=4,
+                      factory=DistFactory(), planner_host="127.0.0.1",
+                      device_plane_size=n_procs)
+    w.start(register=False)
+    try:
+        import jax
+
+        from faabric_tpu.mpi import MpiOp
+        from faabric_tpu.parallel import DeviceCollectives, plane_summary
+
+        s = plane_summary()
+        col = DeviceCollectives(jax.devices())
+        local_ranks = [r for r, d in enumerate(col.devices)
+                       if d.process_index == jax.process_index()]
+        local = {r: np.full(4096, float(r + 1), np.float32)
+                 for r in local_ranks}
+        x = col.shard_stacked_addressable(local, (4096,), np.float32)
+        out = col.allreduce(x, MpiOp.SUM)
+        expected = col.n * (col.n + 1) / 2
+        ok = all(bool((col.addressable_shard(out, r) == expected).all())
+                 for r in local_ranks)
+
+        # Second collective shape: allgather a per-rank scalar row and
+        # check every process reconstructs the full plane-wide vector
+        g = col.allgather(col.shard_stacked_addressable(
+            {r: np.full(8, float(r), np.float32) for r in local_ranks},
+            (8,), np.float32))
+        got = np.asarray(g.addressable_shards[0].data).reshape(col.n, 8)
+        ok = ok and all((got[r] == r).all() for r in range(col.n))
+
+        # The big one: a FULL jitted train step over a (dp=4, tp=2) mesh
+        # whose devices span both worker processes — gradients allreduce
+        # across the process boundary inside one XLA program
+        import jax.numpy as jnp
+
+        from faabric_tpu.models import (
+            ModelConfig,
+            data_sharding,
+            init_train_state,
+            make_train_step,
+        )
+        from faabric_tpu.parallel import MeshConfig, build_mesh
+
+        cfg = ModelConfig(vocab_size=128, d_model=32, n_layers=2,
+                          n_heads=4, d_ff=64, max_seq=16,
+                          compute_dtype=jnp.float32, remat=False)
+        mesh = build_mesh(jax.devices(), MeshConfig(tp=2))
+        params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg,
+                                             mesh)
+        step = make_train_step(cfg, mesh)
+        rng = np.random.RandomState(0)  # same data in both controllers
+        tokens = jax.device_put(
+            rng.randint(0, 128, (8, 16)).astype(np.int32),
+            data_sharding(mesh))
+        targets = jax.device_put(
+            rng.randint(0, 128, (8, 16)).astype(np.int32),
+            data_sharding(mesh))
+        loss = None
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, tokens,
+                                           targets)
+        loss = float(loss)
+        ok = ok and np.isfinite(loss)
+
+        print(f"PLANE-{'OK' if ok else 'FAIL'} proc={s['process_index']}/"
+              f"{s['process_count']} gdev={s['global_devices']} "
+              f"ldev={s['local_devices']} ranks={local_ranks} "
+              f"loss={loss:.6f}", flush=True)
+    except Exception as e:  # noqa: BLE001 — report to the harness
+        print(f"PLANE-FAIL {type(e).__name__}: {e}"[:200], flush=True)
+    time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
+    w.shutdown()
+
+
 if __name__ == "__main__":
     role = sys.argv[1]
     if role == "planner":
         run_planner()
+    elif role == "planeworker":
+        run_plane_worker(sys.argv[2], int(sys.argv[3]))
     else:
         run_worker(sys.argv[2])
