@@ -303,6 +303,144 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:async-ok".encode()
         return int(ReturnValue.SUCCESS)
 
+    def fn_sleep(self, msg, req):
+        """Slot blocker: hold a scheduler slot for input_data seconds."""
+        time.sleep(float(msg.input_data.decode() or "1"))
+        msg.output_data = b"slept"
+        return int(ReturnValue.SUCCESS)
+
+    @staticmethod
+    def _all_to_all_round(world, rank, i) -> bool:
+        """The reference's doAllToAll (tests/dist/mpi/mpi_native.cpp):
+        every rank exchanges a distinct row with every rank and verifies
+        the full matrix."""
+        size = world.size
+        rows = np.array([rank * 1000 + r * 10 + i for r in range(size)],
+                        np.int64)
+        out = world.alltoall(rank, rows).reshape(size)
+        want = np.array([r * 1000 + rank * 10 + i for r in range(size)],
+                        np.int64)
+        return bool((out == want).all())
+
+    def fn_mpi_migrate(self, msg, req):
+        """Port of the reference example mpi_migration
+        (tests/dist/mpi/examples/mpi_migration.cpp) to REAL worker
+        processes: an MPI world spread over both workers loops
+        barrier + all-to-all; at the check iteration every rank hits a
+        migration point — the planner consolidates the freed cluster,
+        moved ranks prepare the world and vacate with
+        FunctionMigratedException, re-enter on the target host, and the
+        world finishes the remaining loops across the migration."""
+        from faabric_tpu.executor.executor import FunctionMigratedException
+        from faabric_tpu.mpi import get_mpi_context
+        from faabric_tpu.proto import BatchExecuteType
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7950
+            msg.mpi_world_size = 3
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        my_host = self.scheduler.host
+        pc = self.scheduler.planner_client
+
+        loops, check = 8, 3
+        migrated_entry = req.type == BatchExecuteType.MIGRATION
+        start = check + 1 if migrated_entry else 0
+        if migrated_entry:
+            # Complete the group's post-migration barrier: the stayed
+            # ranks are parked in their post_migration_hook waiting for
+            # every member — including this re-entered one — to re-sync
+            # on the new group id before anyone resumes the loop
+            self.scheduler.ptp_broker.post_migration_hook(
+                msg.group_id, msg.group_idx)
+            world.refresh_rank_hosts()
+        for i in range(start, loops):
+            world.barrier(rank)
+            if not self._all_to_all_round(world, rank, i):
+                msg.output_data = f"r{rank}:bad-alltoall@{i}".encode()
+                return int(ReturnValue.FAILED)
+
+            if i == check and not migrated_entry:
+                # Migration point (reference mpiMigrationPoint). Rank 0
+                # asks the planner; everyone learns the outcome through
+                # the world itself, then reads the new decision.
+                world.barrier(rank)
+                old_gid = world.group_id
+                if rank == 0:
+                    deadline = time.time() + 20
+                    dec = None
+                    while dec is None and time.time() < deadline:
+                        dec = pc.check_migration(msg.app_id)
+                        if dec is None:
+                            time.sleep(0.25)
+                    flag = np.array([1 if dec is not None else 0], np.int64)
+                    world.broadcast(0, 0, flag)
+                else:
+                    flag = world.broadcast(0, rank, np.zeros(1, np.int64))
+                if int(flag[0]) == 0:
+                    msg.output_data = f"r{rank}:no-migration".encode()
+                    return int(ReturnValue.FAILED)
+                # Fetch the post-migration decision (group id changed)
+                dec = pc.get_scheduling_decision(msg.app_id)
+                deadline = time.time() + 10
+                while (dec is None or dec.group_id == old_gid) \
+                        and time.time() < deadline:
+                    time.sleep(0.1)
+                    dec = pc.get_scheduling_decision(msg.app_id)
+                idx = dec.app_idxs.index(msg.app_idx)
+                target = dec.hosts[idx]
+                world.prepare_migration(rank, dec.group_id)
+                if target != my_host:
+                    raise FunctionMigratedException()
+                self.scheduler.ptp_broker.post_migration_hook(
+                    dec.group_id, dec.group_idxs[idx])
+                world.refresh_rank_hosts()
+
+        world.barrier(rank)
+        msg.output_data = f"r{rank}:migrate-ok:{my_host}".encode()
+        return int(ReturnValue.SUCCESS)
+
+    def fn_mpi_alltoall_sleep(self, msg, req):
+        """Port of the reference example mpi_alltoall_sleep
+        (tests/dist/mpi/examples/mpi_alltoall_sleep.cpp): many
+        barrier + all-to-all rounds, one rank goes to sleep mid-stream
+        (the straggler), then the rounds resume — overlap/buffering in
+        the data plane must absorb the stall without reordering."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7960
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+
+        rounds = 50
+        for i in range(rounds):
+            world.barrier(rank)
+            if not self._all_to_all_round(world, rank, i):
+                msg.output_data = f"r{rank}:bad@{i}".encode()
+                return int(ReturnValue.FAILED)
+        if rank == 3:
+            time.sleep(2.0)  # the straggler
+        for i in range(rounds):
+            world.barrier(rank)
+            if not self._all_to_all_round(world, rank, rounds + i):
+                msg.output_data = f"r{rank}:bad@{rounds + i}".encode()
+                return int(ReturnValue.FAILED)
+        world.barrier(rank)
+        msg.output_data = f"r{rank}:alltoall-sleep-ok".encode()
+        return int(ReturnValue.SUCCESS)
+
     def fn_threads(self, msg, req):
         counter = self.memory[:8].view(np.int64)
         # One executor runs all local threads; serialise the shared add
@@ -502,6 +640,11 @@ def run_plane_worker(host: str, n_procs: int) -> None:
 
 
 if __name__ == "__main__":
+    # Debugging aid: SIGUSR1 dumps every thread's stack to stderr
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
     role = sys.argv[1]
     if role == "planner":
         run_planner()

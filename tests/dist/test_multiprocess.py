@@ -337,3 +337,63 @@ def test_device_plane_cross_process_collectives(dist_cluster):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_dist_mpi_alltoall_sleep(dist_cluster):
+    """Reference example mpi_alltoall_sleep across real worker
+    processes: 100 barrier+alltoall rounds with a mid-stream straggler
+    (rank 3 sleeps 2 s) — the data plane absorbs the stall."""
+    me = dist_cluster
+    req = batch_exec_factory("dist", "mpi_alltoall_sleep", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=90.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+    status = wait_batch_finished(me, req.app_id, timeout=30)
+    assert status.expected_num_messages == 8
+    for m in status.message_results:
+        assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+        assert m.output_data.endswith(b"alltoall-sleep-ok")
+    assert {m.executed_host for m in status.message_results} == {"w1", "w2"}
+
+
+def test_dist_mpi_live_migration(dist_cluster):
+    """Reference example mpi_migration across REAL worker processes:
+    blockers force a 3-rank world to spread over both workers; when they
+    finish, the planner consolidates — the moved rank vacates mid-loop
+    via FunctionMigratedException, re-enters on the target worker
+    process, and the world completes its remaining all-to-all rounds
+    across the migration."""
+    me = dist_cluster
+
+    # Hold slots so the MPI world must spread (unit-test recipe,
+    # test_endpoint_and_migration.py): 2 + 3 blockers on 4+4 slots
+    blockers = []
+    for count in (2, 3):
+        b = batch_exec_factory("dist", "sleep", count)
+        for m in b.messages:
+            m.input_data = b"4.0"
+        me.planner_client.call_functions(b)
+        blockers.append(b)
+
+    req = batch_exec_factory("dist", "mpi_migrate", 1)
+    req.messages[0].mpi_rank = 0
+    me.planner_client.call_functions(req)
+
+    r = me.planner_client.get_message_result(req.app_id, req.messages[0].id,
+                                             timeout=90.0)
+    assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+
+    status = wait_batch_finished(me, req.app_id, timeout=45)
+    assert status.expected_num_messages == 3
+    final_hosts = set()
+    for m in status.message_results:
+        assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+        final_hosts.add(m.output_data.decode().rsplit(":", 1)[1])
+    # Consolidated: every rank finished on ONE worker process
+    assert len(final_hosts) == 1, final_hosts
+    assert me.planner_client.get_num_migrations() >= 1
+
+    for b in blockers:
+        wait_batch_finished(me, b.app_id, timeout=30)
