@@ -317,12 +317,286 @@ class NoneTracker(DirtyTracker):
         return np.ones(n_pages(_as_array(mem).size), dtype=bool)
 
 
+def _os_to_image_flags(os_flags: np.ndarray, page_off: int,
+                       n_img: int) -> np.ndarray:
+    """Map per-OS-page dirty flags to IMAGE pages when the buffer start
+    is not page-aligned (malloc'd numpy buffers rarely are): image page
+    j overlaps OS pages j and j+1, so it is dirty if either is."""
+    if page_off == 0:
+        return os_flags[:n_img].astype(bool)
+    padded = np.zeros(n_img + 1, dtype=bool)
+    padded[:min(os_flags.size, n_img + 1)] = \
+        os_flags[:n_img + 1].astype(bool)
+    return padded[:n_img] | padded[1:n_img + 1]
+
+
+class SegvTracker(DirtyTracker):
+    """Write-protection fault tracking — the reference's headline
+    precision mode (src/util/dirty.cpp segfault tracker): the image is
+    mprotect'd read-only at start; the first write to each page faults
+    into a C++ SIGSEGV handler (native/segv_tracker.cpp) that records
+    the page and restores write access. O(dirty) — no baseline copy, no
+    per-bracket memory scan; a 128 MiB image with 3 dirty pages costs 3
+    faults, not a 128 MiB compare.
+
+    Kernel-interface caveat (same as the reference's): writes into the
+    protected range from the KERNEL side (recv_into, read() into the
+    buffer) return EFAULT instead of faulting — guest code writing
+    through userspace (numpy ops, memoryviews) is the supported shape.
+
+    Thread-local tracking reports every page dirtied since tracking
+    began (page-fault attribution is per-process, not per-thread); the
+    THREADS merge path ORs per-thread sets, so over-reporting is
+    content-correct and merge-safe.
+
+    ``region_hints`` narrow the protected range to the hinted pages —
+    fewer protected pages, but writes outside the hints are undetected
+    (the same contract as the comparison trackers)."""
+
+    mode = "segv"
+
+    def __init__(self) -> None:
+        from faabric_tpu.util.native import get_segv_lib
+
+        self._lib = get_segv_lib()
+        if self._lib is None:
+            raise RuntimeError("segv dirty tracking unavailable "
+                               "(native build failed)")
+        self._region_ids: list[int] = []
+        self._os_flags: Optional[np.ndarray] = None
+        self._addr = 0
+        self._size = 0
+        self._page_off = 0
+
+    def start_tracking(self, mem, region_hints=None) -> None:
+        arr = _as_array(mem)
+        self._addr = arr.ctypes.data
+        self._size = arr.size
+        start_al = self._addr & ~(PAGE_SIZE - 1)
+        self._page_off = self._addr - start_al
+        end_al = -(-(self._addr + self._size) // PAGE_SIZE) * PAGE_SIZE
+        n_os = (end_al - start_al) // PAGE_SIZE
+        self._os_flags = np.zeros(n_os, dtype=np.uint8)
+        self._region_ids = []
+        if region_hints is not None:
+            # Protect only runs of OS pages covering the hinted extents
+            img_idx = hint_page_indices(region_hints, n_pages(self._size))
+            os_mask = np.zeros(n_os, dtype=bool)
+            for j in img_idx:
+                os_mask[j] = True
+                if self._page_off and j + 1 < n_os:
+                    os_mask[j + 1] = True
+            runs = _mask_runs(os_mask)
+        else:
+            runs = [(0, n_os)]
+        for lo, count in runs:
+            rid = self._lib.segv_start(
+                start_al + lo * PAGE_SIZE, count,
+                self._os_flags.ctypes.data + lo)
+            if rid < 0:
+                for r in self._region_ids:
+                    self._lib.segv_stop(r)
+                self._region_ids = []
+                raise RuntimeError(f"segv_start failed ({rid}) — "
+                                   "unprotectable mapping?")
+            self._region_ids.append(rid)
+
+    def stop_tracking(self, mem) -> None:
+        for rid in self._region_ids:
+            self._lib.segv_stop(rid)
+        self._region_ids = []
+
+    def get_dirty_pages(self, mem) -> np.ndarray:
+        arr = _as_array(mem)
+        if self._os_flags is None:
+            return np.zeros(0, dtype=bool)
+        if arr.ctypes.data != self._addr:
+            # Buffer reallocated (growth copies into a new allocation):
+            # every page of the new buffer is dirty by definition
+            return np.ones(n_pages(arr.size), dtype=bool)
+        n_img = n_pages(self._size)
+        flags = _os_to_image_flags(self._os_flags, self._page_off, n_img)
+        if arr.size > self._size:  # in-place growth: new pages dirty
+            out = np.ones(n_pages(arr.size), dtype=bool)
+            out[:n_img] = flags
+            return out
+        return flags
+
+    # Per-thread attribution is impossible with process-wide faults;
+    # report the full dirty set (merge-safe, see class docstring)
+    def start_thread_local_tracking(self, mem, region_hints=None) -> None:
+        pass
+
+    def stop_thread_local_tracking(self, mem) -> None:
+        pass
+
+    def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
+        return self.get_dirty_pages(mem)
+
+    def __del__(self):  # noqa: D105 — protections must not outlive us
+        try:
+            self.stop_tracking(None)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _mask_runs(mask: np.ndarray) -> list:
+    """Consecutive True runs of a bool mask as (start, count) pairs."""
+    idx = np.where(mask)[0]
+    if idx.size == 0:
+        return []
+    splits = np.where(np.diff(idx) > 1)[0] + 1
+    return [(int(g[0]), int(g.size))
+            for g in np.split(idx, splits)]
+
+
+# ---------------------------------------------------------------------------
+# Soft-dirty PTEs (reference src/util/dirty.cpp softpte tracker)
+# ---------------------------------------------------------------------------
+
+_SOFTPTE_LOCK = threading.Lock()
+_SOFTPTE_SESSIONS: list = []  # live _SoftPTESession objects
+_softpte_probe: Optional[bool] = None
+
+
+def _pagemap_softdirty(addr: int, size: int) -> np.ndarray:
+    """Soft-dirty bit (pagemap bit 55) per OS page over [addr, addr+size)."""
+    first = addr >> 12
+    n = ((addr + size - 1) >> 12) - first + 1
+    with open("/proc/self/pagemap", "rb") as f:
+        f.seek(first * 8)
+        data = f.read(n * 8)
+    words = np.frombuffer(data, dtype=np.uint64)
+    return ((words >> np.uint64(55)) & np.uint64(1)).astype(bool)
+
+
+def softpte_available() -> bool:
+    """One-time probe: CONFIG_MEM_SOFT_DIRTY kernels set pagemap bit 55
+    on the first write after a clear_refs(4). Containers and custom
+    kernels often ship without it — then the probe write succeeds but
+    the bit never appears, and softpte mode must fall back."""
+    global _softpte_probe
+    with _SOFTPTE_LOCK:
+        if _softpte_probe is not None:
+            return _softpte_probe
+        try:
+            probe = np.ones(PAGE_SIZE * 4, np.uint8)  # faulted-in pages
+            with open("/proc/self/clear_refs", "w") as f:
+                f.write("4")
+            probe[PAGE_SIZE * 2] = 7
+            bits = _pagemap_softdirty(probe.ctypes.data, probe.size)
+            _softpte_probe = bool(bits.any())
+        except OSError:
+            _softpte_probe = False
+        if not _softpte_probe:
+            logger.info("Soft-dirty PTEs not functional on this kernel; "
+                        "DIRTY_TRACKING_MODE=softpte falls back to segv/"
+                        "native")
+        return _softpte_probe
+
+
+class _SoftPTESession:
+    """One tracked image. clear_refs resets soft-dirty bits for the
+    WHOLE process, so starting any session first folds the current bits
+    of every other live session into its accumulator — sessions never
+    lose writes to each other's clears."""
+
+    def __init__(self, addr: int, size: int) -> None:
+        self.addr = addr
+        self.size = size
+        n_os = ((addr + size - 1) >> 12) - (addr >> 12) + 1
+        self.accum = np.zeros(n_os, dtype=bool)
+
+    def fold_current(self) -> None:
+        self.accum |= _pagemap_softdirty(self.addr, self.size)
+
+    def dirty_os_pages(self) -> np.ndarray:
+        return self.accum | _pagemap_softdirty(self.addr, self.size)
+
+
+class SoftPTETracker(DirtyTracker):
+    """Kernel soft-dirty PTE tracking (reference dirty.cpp softpte
+    tracker): clear_refs(4) write-protects every PTE; the kernel sets
+    pagemap bit 55 on the first write to each page. O(dirty) faults at
+    write time + an 8-bytes-per-page pagemap read at query time — no
+    baseline copy, no image scan. Requires CONFIG_MEM_SOFT_DIRTY
+    (``softpte_available()``); ``make_dirty_tracker`` falls back to the
+    segv tracker (or native compare) where the kernel lacks it.
+
+    Like the segv tracker, fault attribution is process-wide, so
+    thread-local queries report the full dirty set (merge-safe)."""
+
+    mode = "softpte"
+
+    def __init__(self) -> None:
+        if not softpte_available():
+            raise RuntimeError("soft-dirty PTEs not available")
+        self._sess: Optional[_SoftPTESession] = None
+        self._page_off = 0
+
+    def start_tracking(self, mem, region_hints=None) -> None:
+        arr = _as_array(mem)
+        sess = _SoftPTESession(arr.ctypes.data, arr.size)
+        self._page_off = arr.ctypes.data & (PAGE_SIZE - 1)
+        with _SOFTPTE_LOCK:
+            # Everyone else banks their bits before we clear them
+            for other in _SOFTPTE_SESSIONS:
+                other.fold_current()
+            with open("/proc/self/clear_refs", "w") as f:
+                f.write("4")
+            if self._sess in _SOFTPTE_SESSIONS:
+                _SOFTPTE_SESSIONS.remove(self._sess)
+            _SOFTPTE_SESSIONS.append(sess)
+        self._sess = sess
+
+    def stop_tracking(self, mem) -> None:
+        with _SOFTPTE_LOCK:
+            if self._sess in _SOFTPTE_SESSIONS:
+                _SOFTPTE_SESSIONS.remove(self._sess)
+        self._sess = None
+
+    def get_dirty_pages(self, mem) -> np.ndarray:
+        arr = _as_array(mem)
+        if self._sess is None:
+            return np.zeros(0, dtype=bool)
+        if arr.ctypes.data != self._sess.addr:
+            return np.ones(n_pages(arr.size), dtype=bool)
+        with _SOFTPTE_LOCK:
+            os_flags = self._sess.dirty_os_pages()
+        n_img = n_pages(self._sess.size)
+        flags = _os_to_image_flags(os_flags, self._page_off, n_img)
+        if arr.size > self._sess.size:
+            out = np.ones(n_pages(arr.size), dtype=bool)
+            out[:n_img] = flags
+            return out
+        return flags
+
+    def start_thread_local_tracking(self, mem, region_hints=None) -> None:
+        pass
+
+    def stop_thread_local_tracking(self, mem) -> None:
+        pass
+
+    def get_thread_local_dirty_pages(self, mem) -> np.ndarray:
+        return self.get_dirty_pages(mem)
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.stop_tracking(None)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 _TRACKERS = {
     "compare": CompareTracker,
     "native": NativeCompareTracker,
     "hash": HashTracker,
     "none": NoneTracker,
+    "segv": SegvTracker,
+    "softpte": SoftPTETracker,
 }
+
+_FALLBACK_WARNED: set = set()
 
 
 def make_dirty_tracker(mode: str | None = None) -> DirtyTracker:
@@ -330,4 +604,15 @@ def make_dirty_tracker(mode: str | None = None) -> DirtyTracker:
     cls = _TRACKERS.get(mode)
     if cls is None:
         raise ValueError(f"Unknown dirty tracking mode: {mode}")
-    return cls()
+    # Kernel-assisted modes degrade gracefully: softpte → segv → native
+    # (the reference's own fallback ladder, dirty.cpp getDirtyTracker)
+    for fallback in (cls, SegvTracker, NativeCompareTracker):
+        try:
+            return fallback()
+        except RuntimeError as e:
+            key = (mode, fallback.mode)
+            if key not in _FALLBACK_WARNED:
+                _FALLBACK_WARNED.add(key)
+                logger.warning("Dirty mode %s unavailable (%s); "
+                               "falling back", fallback.mode, e)
+    return NativeCompareTracker()

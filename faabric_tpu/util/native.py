@@ -133,6 +133,55 @@ def get_shmring_lib() -> Optional[ctypes.CDLL]:
         return _shm_lib
 
 
+_SEGV_SRC = os.path.join(_REPO_ROOT, "native", "segv_tracker.cpp")
+_SEGV_SO = os.path.join(_REPO_ROOT, "native", "build", "libsegvtracker.so")
+
+_segv_lib: Optional[ctypes.CDLL] = None
+_segv_tried = False
+
+
+def get_segv_lib() -> Optional[ctypes.CDLL]:
+    """The SIGSEGV write-fault dirty tracker (native/segv_tracker.cpp) —
+    O(dirty) page tracking with no baseline copy. None when g++ or the
+    source is unavailable; callers fall back to comparison tracking."""
+    global _segv_lib, _segv_tried
+    with _lock:
+        if _segv_tried:
+            return _segv_lib
+        _segv_tried = True
+        if not os.path.exists(_SEGV_SRC):
+            return None
+        if not os.path.exists(_SEGV_SO) or (os.path.getmtime(_SEGV_SO)
+                                            < os.path.getmtime(_SEGV_SRC)):
+            os.makedirs(os.path.dirname(_SEGV_SO), exist_ok=True)
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                   _SEGV_SRC, "-o", _SEGV_SO]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, OSError) as e:
+                logger.warning("Native segv_tracker build failed (%s); "
+                               "segv dirty mode unavailable", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_SEGV_SO)
+        except OSError as e:
+            logger.warning("Could not load %s: %s", _SEGV_SO, e)
+            return None
+        lib.segv_install.restype = ctypes.c_int
+        lib.segv_install.argtypes = []
+        lib.segv_start.restype = ctypes.c_int
+        lib.segv_start.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_void_p]
+        lib.segv_stop.restype = ctypes.c_int
+        lib.segv_stop.argtypes = [ctypes.c_int]
+        if lib.segv_install() != 0:
+            logger.warning("segv_tracker handler install failed")
+            return None
+        _segv_lib = lib
+        return _segv_lib
+
+
 def reset_for_tests() -> None:
     global _lib, _tried, _shm_lib, _shm_tried
     with _lock:
@@ -140,3 +189,5 @@ def reset_for_tests() -> None:
         _tried = False
         _shm_lib = None
         _shm_tried = False
+        # segv lib deliberately NOT reset: its SIGSEGV handler is
+        # process-wide state that must not be re-installed per test

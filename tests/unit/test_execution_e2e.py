@@ -345,10 +345,21 @@ class ThreadsExecutor(Executor):
         return int(ReturnValue.SUCCESS)
 
 
-def test_threads_batch_two_hosts_snapshot_merge(cluster):
+@pytest.mark.parametrize("dirty_mode", ["native", "segv"])
+def test_threads_batch_two_hosts_snapshot_merge(cluster, dirty_mode):
     """VERDICT item 7 'done' criterion: a THREADS batch across two hosts
-    restores from the main-thread snapshot and merges diffs back."""
+    restores from the main-thread snapshot and merges diffs back — under
+    both the comparison tracker and the kernel-assisted write-fault
+    tracker (the executor pool threads' writes are attributed by
+    SIGSEGV faults in segv mode)."""
     import numpy as np
+
+    from faabric_tpu.util.config import get_system_config
+    from faabric_tpu.util.native import get_segv_lib
+
+    if dirty_mode == "segv" and get_segv_lib() is None:
+        pytest.skip("segv tracker unavailable")
+    get_system_config().dirty_tracking_mode = dirty_mode
 
     from faabric_tpu.proto import BatchExecuteType
     from faabric_tpu.snapshot import (

@@ -261,3 +261,155 @@ def test_delta_grows_and_shrinks():
     settings = DeltaSettings.parse("pages=4096;zlib=1")
     delta = serialize_delta(settings, old.tobytes(), new.tobytes())
     assert apply_delta(delta, old.tobytes()) == new.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Kernel-assisted O(dirty) trackers (segv write-fault; softpte where the
+# kernel has CONFIG_MEM_SOFT_DIRTY) — reference dirty.cpp's headline modes
+# ---------------------------------------------------------------------------
+
+def _kernel_modes():
+    from faabric_tpu.util.dirty import softpte_available
+    from faabric_tpu.util.native import get_segv_lib
+
+    modes = []
+    if get_segv_lib() is not None:
+        modes.append("segv")
+    if softpte_available():
+        modes.append("softpte")
+    return modes or ["skip"]
+
+
+@pytest.mark.parametrize("mode", _kernel_modes())
+def test_kernel_tracker_detects_all_writes(mode):
+    """Fault-driven tracking is CONSERVATIVE (an unaligned buffer start
+    maps one OS page onto two image pages), so written pages must all be
+    flagged and untouched far pages must not be."""
+    if mode == "skip":
+        pytest.skip("no kernel-assisted tracker available")
+    mem = np.zeros(PAGE_SIZE * 64 + 100, dtype=np.uint8)
+    tracker = make_dirty_tracker(mode)
+    assert tracker.mode == mode
+    tracker.start_tracking(mem)
+    mem[10] = 1                      # page 0
+    mem[PAGE_SIZE * 20 + 5] = 2      # page 20
+    mem[PAGE_SIZE * 64 + 50] = 3     # trailing partial page
+    flags = tracker.get_dirty_pages(mem)
+    tracker.stop_tracking(mem)
+    assert flags.size == 65
+    dirty = set(np.where(flags)[0])
+    assert {0, 20, 64} <= dirty
+    # Conservatism is at most one neighbour page per write
+    assert dirty <= {0, 1, 19, 20, 21, 63, 64}
+    # Writes after stop are untracked and must not fault
+    mem[PAGE_SIZE * 40] = 9
+
+
+@pytest.mark.parametrize("mode", _kernel_modes())
+def test_kernel_tracker_o_dirty_sparse_cost(mode):
+    """The point of fault tracking: a sparse write set in a big image
+    costs faults, not scans — and reports only the touched pages."""
+    if mode == "skip":
+        pytest.skip("no kernel-assisted tracker available")
+    import time as _time
+
+    mem = np.zeros(64 << 20, dtype=np.uint8)  # 16384 pages
+    tracker = make_dirty_tracker(mode)
+    t0 = _time.perf_counter()
+    tracker.start_tracking(mem)
+    for p in (7, 4000, 12000):
+        mem[PAGE_SIZE * p + 1] = 5
+    flags = tracker.get_dirty_pages(mem)
+    bracket_s = _time.perf_counter() - t0
+    tracker.stop_tracking(mem)
+    assert int(flags.sum()) <= 6  # 3 writes, at most 1 neighbour each
+    for p in (7, 4000, 12000):
+        assert flags[p] or flags[p - 1] or flags[p + 1]
+    # Generous bound: native compare of 64 MiB costs ~tens of ms; the
+    # fault path must be orders cheaper (no O(image) work at all)
+    assert bracket_s < 0.25, f"bracket took {bracket_s * 1000:.0f}ms"
+
+
+@pytest.mark.parametrize("mode", _kernel_modes())
+def test_kernel_tracker_reallocation_is_all_dirty(mode):
+    """A grown (reallocated) buffer cannot be attributed page-by-page:
+    everything is dirty by definition (same contract the comparison
+    trackers apply to beyond-baseline pages)."""
+    if mode == "skip":
+        pytest.skip("no kernel-assisted tracker available")
+    mem = np.zeros(PAGE_SIZE * 2, dtype=np.uint8)
+    tracker = make_dirty_tracker(mode)
+    tracker.start_tracking(mem)
+    grown = np.concatenate([mem, np.zeros(PAGE_SIZE * 2, np.uint8)])
+    grown[PAGE_SIZE] = 7
+    flags = tracker.get_dirty_pages(grown)
+    tracker.stop_tracking(mem)
+    assert flags.size == 4 and flags.all()
+
+
+def test_segv_region_hints_protect_only_hinted_pages():
+    """Hinted segv tracking protects just the hinted pages; writes
+    outside the hints are undetected (the hint contract) and free."""
+    if "segv" not in _kernel_modes():
+        pytest.skip("segv tracker unavailable")
+    mem = np.zeros(PAGE_SIZE * 64, np.uint8)
+    tracker = make_dirty_tracker("segv")
+    hints = [(PAGE_SIZE * 2, PAGE_SIZE), (PAGE_SIZE * 10, 100)]
+    tracker.start_tracking(mem, region_hints=hints)
+    mem[PAGE_SIZE * 2 + 5] = 1     # inside hint 1
+    mem[PAGE_SIZE * 10 + 50] = 2   # inside hint 2
+    mem[PAGE_SIZE * 30] = 3        # OUTSIDE hints: unprotected, untracked
+    flags = tracker.get_dirty_pages(mem)
+    tracker.stop_tracking(mem)
+    dirty = set(np.where(flags)[0])
+    assert {2, 10} <= dirty
+    assert 30 not in dirty
+    assert dirty <= {1, 2, 3, 9, 10, 11}
+
+
+def test_segv_concurrent_thread_writes_tracked():
+    """Faults from many threads land in one flags array (the handler is
+    lock-free over a fixed region table)."""
+    if "segv" not in _kernel_modes():
+        pytest.skip("segv tracker unavailable")
+    import threading as _threading
+
+    mem = np.zeros(8 << 20, dtype=np.uint8)
+    tracker = make_dirty_tracker("segv")
+    tracker.start_tracking(mem)
+    pages_per_thread = {t: list(range(t * 100, t * 100 + 20))
+                        for t in range(8)}
+
+    def writer(pages):
+        for p in pages:
+            mem[PAGE_SIZE * p + 3] = 7
+
+    threads = [_threading.Thread(target=writer, args=(pp,))
+               for pp in pages_per_thread.values()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    flags = tracker.get_dirty_pages(mem)
+    tracker.stop_tracking(mem)
+    for pages in pages_per_thread.values():
+        for p in pages:
+            assert flags[p] or flags[p - 1] or flags[p + 1], p
+
+
+def test_make_dirty_tracker_softpte_falls_back():
+    """DIRTY_TRACKING_MODE=softpte must yield a WORKING tracker on every
+    kernel: the real one with CONFIG_MEM_SOFT_DIRTY, else segv/native."""
+    from faabric_tpu.util.dirty import softpte_available
+
+    tracker = make_dirty_tracker("softpte")
+    if softpte_available():
+        assert tracker.mode == "softpte"
+    else:
+        assert tracker.mode in ("segv", "native")
+    mem = np.zeros(PAGE_SIZE * 4, np.uint8)
+    tracker.start_tracking(mem)
+    mem[PAGE_SIZE * 2] = 1
+    flags = tracker.get_dirty_pages(mem)
+    tracker.stop_tracking(mem)
+    assert flags[2] or flags[1] or flags[3]
