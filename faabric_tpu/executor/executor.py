@@ -329,6 +329,16 @@ class Executor:
         # Last task of the batch returns the executor to the pool
         # (reference Executor.cpp:520-570).
         if last_in_batch:
+            if is_threads and self._batch_tracker is not None:
+                # Unprotect/retire the batch-level bracket now rather than
+                # at the next batch's reassignment: segv mode would
+                # otherwise leave untouched pages PROT_READ and charge
+                # later non-THREADS work a fault per page
+                if mem is None:
+                    mem = self.get_memory_view()
+                if mem is not None:
+                    self._batch_tracker.stop_tracking(mem)
+                self._batch_tracker = None
             if not is_threads:
                 self.reset(self.bound_msg)
             self.release_claim()

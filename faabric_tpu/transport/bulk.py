@@ -156,6 +156,10 @@ class BulkServer:
                     name_raw = bytearray(seq)
                     _recv_exact_into(conn, memoryview(name_raw))
                     if drain_thread is None and _is_local_ip(peer_ip):
+                        # Fresh Event per announce: a retired drain's set()
+                        # stop flag must not make a later announce's drain
+                        # exit the first time the ring reads empty
+                        drain_stop = threading.Event()
                         drain_thread = self._start_ring_drain(
                             name_raw.decode("utf-8", "replace"), drain_stop)
                     # ACK/NACK the attach: the client must never push a

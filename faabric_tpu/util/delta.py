@@ -104,9 +104,21 @@ def serialize_delta(settings: DeltaSettings, old: "bytes | np.ndarray",
     return bytes(out)
 
 
-def apply_delta(delta: bytes, old: "bytes | np.ndarray") -> bytes:
-    """Reconstruct new from old + delta (one memory pass to build the
-    base image: empty + copy, zero-fill only for growth)."""
+def apply_delta(delta: bytes, old: "bytes | np.ndarray",
+                out: "np.ndarray | None" = None) -> np.ndarray:
+    """Reconstruct new from old + delta, returning a uint8 array.
+
+    Cost model (reference src/util/delta.cpp applyDelta writes straight
+    into the destination buffer; this matches it):
+      - default: ONE pass to build the base image (empty + copy of old,
+        zero-fill only for growth), then O(delta) patching — no trailing
+        ``tobytes`` copy.
+      - ``out=`` a preallocated uint8 array of the right size: the base
+        copy lands there (steady-state memcpy, no allocation/page-fault
+        cost on the hot freeze/thaw path).
+      - ``out`` aliasing ``old`` (patch the resident image in place):
+        the base copy is skipped entirely — apply is O(delta).
+    """
     pos = 0
     cmd, total = struct.unpack_from("<BQ", delta, pos)
     if cmd != CMD_TOTAL_SIZE:
@@ -123,11 +135,27 @@ def apply_delta(delta: bytes, old: "bytes | np.ndarray") -> bytes:
 
     old_arr = (old.reshape(-1).view(np.uint8) if isinstance(old, np.ndarray)
                else np.frombuffer(old, dtype=np.uint8))
-    out = np.empty(total, dtype=np.uint8)
     common = min(total, old_arr.size)
-    out[:common] = old_arr[:common]
-    if total > common:
-        out[common:] = 0
+    if out is None:
+        out = np.empty(total, dtype=np.uint8)
+        out[:common] = old_arr[:common]
+        if total > common:
+            out[common:] = 0
+    else:
+        out = out.reshape(-1).view(np.uint8)
+        if out.size != total:
+            raise ValueError(
+                f"out buffer is {out.size} bytes, delta target is {total}")
+        if np.shares_memory(out, old_arr):
+            # In-place patch: out already IS the old image (XOR payloads
+            # are new^old at their offsets, so patching over old content
+            # is exactly right; overwrites don't read it at all)
+            if total > common:
+                out[common:] = 0
+        else:
+            out[:common] = old_arr[:common]
+            if total > common:
+                out[common:] = 0
 
     pos = 0
     while True:
@@ -141,8 +169,8 @@ def apply_delta(delta: bytes, old: "bytes | np.ndarray") -> bytes:
         if cmd == CMD_DELTA_OVERWRITE:
             out[off:off + length] = payload
         elif cmd == CMD_DELTA_XOR:
-            out[off:off + length] = np.bitwise_xor(out[off:off + length],
-                                                   payload)
+            np.bitwise_xor(out[off:off + length], payload,
+                           out=out[off:off + length])
         else:
             raise ValueError(f"Unknown delta command {cmd}")
-    return out.tobytes()
+    return out

@@ -604,9 +604,12 @@ def make_dirty_tracker(mode: str | None = None) -> DirtyTracker:
     cls = _TRACKERS.get(mode)
     if cls is None:
         raise ValueError(f"Unknown dirty tracking mode: {mode}")
-    # Kernel-assisted modes degrade gracefully: softpte → segv → native
-    # (the reference's own fallback ladder, dirty.cpp getDirtyTracker)
-    for fallback in (cls, SegvTracker, NativeCompareTracker):
+    # Kernel-assisted modes degrade gracefully: softpte → segv → native.
+    # This ladder is an intentional robustness addition — the reference
+    # (dirty.cpp getDirtyTracker) throws on an unavailable mode instead.
+    # dict.fromkeys dedupes so mode='segv' doesn't construct SegvTracker
+    # twice before falling back.
+    for fallback in dict.fromkeys((cls, SegvTracker, NativeCompareTracker)):
         try:
             return fallback()
         except RuntimeError as e:

@@ -120,11 +120,15 @@ int segv_start(void* start, uint64_t n_pages, void* flags)
         r.start = s;
         r.n_pages = n_pages;
         r.flags = static_cast<uint8_t*>(flags);
+        // Publish the region BEFORE mprotect: no fault can occur until the
+        // protection takes effect, and any write racing with the mprotect
+        // must already find a live region or the handler would chain the
+        // fault to the default handler and crash the process.
+        r.active.store(1, std::memory_order_release);
         if (mprotect(start, n_pages * PAGE, PROT_READ) != 0) {
             r.active.store(0, std::memory_order_release);
             return -2;
         }
-        r.active.store(1, std::memory_order_release);
         return i;
     }
     return -3;  // region table full

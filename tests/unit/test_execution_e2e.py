@@ -346,7 +346,8 @@ class ThreadsExecutor(Executor):
 
 
 @pytest.mark.parametrize("dirty_mode", ["native", "segv"])
-def test_threads_batch_two_hosts_snapshot_merge(cluster, dirty_mode):
+def test_threads_batch_two_hosts_snapshot_merge(cluster, dirty_mode,
+                                                monkeypatch):
     """VERDICT item 7 'done' criterion: a THREADS batch across two hosts
     restores from the main-thread snapshot and merges diffs back — under
     both the comparison tracker and the kernel-assisted write-fault
@@ -359,7 +360,10 @@ def test_threads_batch_two_hosts_snapshot_merge(cluster, dirty_mode):
 
     if dirty_mode == "segv" and get_segv_lib() is None:
         pytest.skip("segv tracker unavailable")
-    get_system_config().dirty_tracking_mode = dirty_mode
+    # monkeypatch restores the prior mode, so the segv parametrization
+    # cannot leak into every later test in the process
+    monkeypatch.setattr(get_system_config(), "dirty_tracking_mode",
+                        dirty_mode)
 
     from faabric_tpu.proto import BatchExecuteType
     from faabric_tpu.snapshot import (

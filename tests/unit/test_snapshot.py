@@ -250,9 +250,15 @@ def test_delta_roundtrip(spec):
     settings = DeltaSettings.parse(spec)
     delta = serialize_delta(settings, old.tobytes(), new.tobytes())
     out = apply_delta(delta, old.tobytes())
-    assert out == new.tobytes()
+    assert bytes(out) == new.tobytes()
     # Unchanged pages are never encoded
     assert len(delta) < new.size
+    # out= reuse buffer and in-place (out aliases old) paths agree
+    reuse = np.empty(new.size, np.uint8)
+    assert bytes(apply_delta(delta, old.tobytes(), out=reuse)) \
+        == new.tobytes()
+    inplace = old.copy()
+    assert bytes(apply_delta(delta, inplace, out=inplace)) == new.tobytes()
 
 
 def test_delta_grows_and_shrinks():
@@ -260,7 +266,7 @@ def test_delta_grows_and_shrinks():
     new = np.ones(PAGE_SIZE * 2, dtype=np.uint8)
     settings = DeltaSettings.parse("pages=4096;zlib=1")
     delta = serialize_delta(settings, old.tobytes(), new.tobytes())
-    assert apply_delta(delta, old.tobytes()) == new.tobytes()
+    assert bytes(apply_delta(delta, old.tobytes())) == new.tobytes()
 
 
 # ---------------------------------------------------------------------------
