@@ -180,6 +180,42 @@ def test_allreduce_matches_numpy(mpi_cluster, op, npop):
         np.testing.assert_allclose(results[rank], expected, rtol=1e-12)
 
 
+@pytest.mark.parametrize("op,npop", [
+    (MpiOp.SUM, np.add),
+    (MpiOp.MAX, np.maximum),
+])
+def test_allreduce_ring_single_host(op, npop, monkeypatch):
+    """Large single-host payloads take the zero-copy ring path
+    (reduce-scatter + allgather over ownership-transferred segments).
+    Checks: values match numpy, the caller's buffer survives unmodified
+    and writable, and odd sizes that don't divide by np still work."""
+    monkeypatch.setattr(MpiWorld, "CHUNK_BYTES", 256)
+    monkeypatch.setattr(MpiWorld, "CHUNK_BYTES_LOCAL", 256)
+    broker = PointToPointBroker("ringhost")
+    decision = SchedulingDecision(app_id=77, group_id=77)
+    for rank in range(4):
+        decision.add_message("ringhost", 3000 + rank, rank, rank)
+    broker.set_up_local_mappings_from_decision(decision)
+    world = MpiWorld(broker, 77, 4, 77)
+
+    n = 1003  # odd: uneven segment split
+    datas = {r: per_rank_data(r, n) for r in range(4)}
+    orig = {r: datas[r].copy() for r in range(4)}
+    expected = datas[0]
+    for r in range(1, 4):
+        expected = npop(expected, datas[r])
+
+    def fn(world_, rank):
+        return world_.allreduce(rank, datas[rank], op)
+
+    results = run_ranks(lambda r: world, fn, n=4)
+    for rank in range(4):
+        np.testing.assert_allclose(results[rank], expected, rtol=1e-12)
+        np.testing.assert_array_equal(datas[rank], orig[rank])
+        assert datas[rank].flags.writeable
+    broker.clear()
+
+
 def test_reduce_to_nonzero_root(mpi_cluster):
     expected = sum(per_rank_data(r) for r in range(6))
 
