@@ -585,9 +585,13 @@ class Planner:
                          ) -> Optional[SchedulingDecision]:
         """Take the preloaded rows matching this request's app idxs
         (reference Planner.cpp:1121-1136). Returns None — falling back to
-        the policy — when the preload doesn't cover the request."""
+        the policy — when the preload doesn't cover the request, names an
+        unknown host, or would oversubscribe one (a preload is an operator
+        hint recorded ahead of time; by use time other apps may have taken
+        the slots, and honoring it blindly would corrupt accounting)."""
         out = SchedulingDecision(req.app_id, preloaded.group_id)
         by_idx = {preloaded.app_idxs[i]: i for i in range(preloaded.n_messages)}
+        need: dict[str, int] = {}
         for msg in req.messages:
             i = by_idx.get(msg.app_idx)
             if i is None:
@@ -597,6 +601,15 @@ class Planner:
                 return None
             out.add_message(preloaded.hosts[i], msg.id, msg.app_idx,
                             preloaded.group_idxs[i])
+            need[preloaded.hosts[i]] = need.get(preloaded.hosts[i], 0) + 1
+        for ip, n in need.items():
+            h = self._hosts.get(ip)
+            if h is None or h.state.slots - h.state.used_slots < n:
+                logger.warning(
+                    "Preloaded decision for app %d needs %d slots on %s "
+                    "(unavailable); falling back to the policy",
+                    req.app_id, n, ip)
+                return None
         return out
 
     # ------------------------------------------------------------------

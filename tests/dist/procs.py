@@ -163,6 +163,107 @@ class DistExecutor(Executor):
         world.barrier(rank)
         return int(ReturnValue.SUCCESS)
 
+    def fn_mpi_send_many(self, msg, req):
+        """Port of the reference example mpi_send_many
+        (tests/dist/mpi/examples/mpi_send_many.cpp): 100 rounds of rank 0
+        fanning one int to every rank and collecting one response each —
+        sustained small-message ping-pong across the process boundary."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 8100
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        n_msg = 100
+
+        if rank == 0:
+            for _ in range(n_msg):
+                for dest in range(1, world.size):
+                    world.send(0, dest, np.array([100 + dest], np.int32))
+                for r in range(1, world.size):
+                    got, _ = world.recv(r, 0)
+                    if int(got[0]) != 100 - r:
+                        msg.output_data = f"bad:{r}:{got[0]}".encode()
+                        return int(ReturnValue.FAILED)
+            msg.output_data = b"send-many-ok"
+        else:
+            for _ in range(n_msg):
+                got, _ = world.recv(0, rank)
+                if int(got[0]) != 100 + rank:
+                    msg.output_data = f"bad:{got[0]}".encode()
+                    return int(ReturnValue.FAILED)
+                world.send(rank, 0, np.array([100 - rank], np.int32))
+            msg.output_data = b"send-many-ok"
+        world.barrier(rank)
+        return int(ReturnValue.SUCCESS)
+
+    def fn_mpi_checks(self, msg, req):
+        """Port of the reference example mpi_checks
+        (tests/dist/mpi/examples/mpi_checks.cpp): world sanity (rank >= 0,
+        size > 1), one fan-out of -100-rank, responses counted at 0."""
+        from faabric_tpu.mpi import get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 8200
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        if rank < 0 or world.size <= 1:
+            return int(ReturnValue.FAILED)
+
+        if rank == 0:
+            for dest in range(1, world.size):
+                world.send(0, dest, np.array([-100 - dest], np.int32))
+            responses = 0
+            for r in range(1, world.size):
+                got, _ = world.recv(r, 0)
+                if int(got[0]) == r:
+                    responses += 1
+            ok = responses == world.size - 1
+            msg.output_data = f"checks:{responses}".encode()
+            if not ok:
+                return int(ReturnValue.FAILED)
+        else:
+            got, _ = world.recv(0, rank)
+            if int(got[0]) != -100 - rank:
+                msg.output_data = f"bad:{got[0]}".encode()
+                return int(ReturnValue.FAILED)
+            world.send(rank, 0, np.array([rank], np.int32))
+            msg.output_data = b"checks-ok"
+        world.barrier(rank)
+        return int(ReturnValue.SUCCESS)
+
+    def fn_mpi_typesize(self, msg, req):
+        """Port of the reference example mpi_typesize
+        (tests/dist/mpi/examples/mpi_typesize.cpp): MPI_Type_size over
+        the datatype enum must match the C sizes."""
+        from faabric_tpu.mpi.api import mpi_type_size
+        from faabric_tpu.mpi.types import MpiDataType
+
+        expected = {
+            MpiDataType.INT: 4, MpiDataType.LONG: 8,
+            MpiDataType.LONG_LONG: 8, MpiDataType.LONG_LONG_INT: 8,
+            MpiDataType.DOUBLE: 8, MpiDataType.DOUBLE_INT: 12,
+            MpiDataType.FLOAT: 4, MpiDataType.CHAR: 1,
+        }
+        for dt, size in expected.items():
+            if mpi_type_size(dt) != size:
+                msg.output_data = f"bad:{dt.name}".encode()
+                return int(ReturnValue.FAILED)
+        msg.output_data = b"typesize-ok"
+        return int(ReturnValue.SUCCESS)
+
     def fn_mpi_cartesian(self, msg, req):
         """Port of the reference example mpi_cartesian
         (tests/dist/mpi/examples/mpi_cartesian.cpp): cart_create with a

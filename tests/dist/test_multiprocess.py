@@ -134,6 +134,9 @@ def test_dist_mpi_chunked_bulk_allreduce(dist_cluster):
     ("mpi_reduce_many", b"reduce-many-ok"),
     ("mpi_sync_async", b"sent"),
     ("mpi_cartesian", b"cart-ok:0x0"),
+    ("mpi_send_many", b"send-many-ok"),
+    ("mpi_checks", b"checks:7"),
+    ("mpi_typesize", b"typesize-ok"),
 ])
 def test_dist_mpi_more_examples(dist_cluster, behaviour, rank0_out):
     """Further reference example ports: mpi_reduce_many.cpp (100

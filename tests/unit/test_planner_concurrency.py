@@ -59,16 +59,60 @@ def test_planner_accounting_full_lifecycle_concurrent():
                 time.sleep(0.001)
 
         def lifecycle(seed):
+            from faabric_tpu.batch_scheduler.decision import (
+                SchedulingDecision,
+            )
+            from faabric_tpu.proto import BatchExecuteType
+
             rng = np.random.RandomState(seed)
             try:
                 for it in range(25):
-                    scenario = rng.randint(0, 4)
+                    scenario = rng.randint(0, 7)
                     req = batch_exec_factory("prop", f"fn{seed}",
                                              int(rng.randint(1, 5)))
+
+                    if scenario == 4:
+                        # Preloaded decision (REST operator hint): may be
+                        # honored or — when racing apps took the slots /
+                        # name a random host — fall back to the policy;
+                        # either way accounting must stay exact
+                        pre = SchedulingDecision(app_id=req.app_id,
+                                                 group_id=0)
+                        ip = HOSTS[rng.randint(len(HOSTS))][0]
+                        for i, m in enumerate(req.messages):
+                            pre.add_message(ip, 0, m.app_idx, i)
+                        planner.preload_scheduling_decision(pre)
+
+                    if scenario == 5:
+                        # Fork-join shape: THREADS NEW decisions go
+                        # through the decision cache (add on miss, reuse
+                        # on hit — with capacity re-validation)
+                        req.type = int(BatchExecuteType.THREADS)
+
                     decision = planner.call_batch(req)
                     if decision.app_id == NOT_ENOUGH_SLOTS:
                         continue
                     messages = list(req.messages)
+
+                    if scenario == 6 and it % 5 == 0:
+                        # Host churn mid-flight: a transient host joins,
+                        # may receive work, then expires (backdated
+                        # keep-alive) while apps still hold its slots.
+                        # Releases for a vanished host must be no-ops and
+                        # nothing may leak on the survivors.
+                        tmp = f"tmp{seed}"
+                        capacity[tmp] = 4
+                        planner.register_host(tmp, 4, 2)
+                        chaos = batch_exec_factory("prop", f"chaos{seed}",
+                                                   int(rng.randint(1, 4)))
+                        d2 = planner.call_batch(chaos)
+                        with planner._lock:
+                            h = planner._hosts.get(tmp)
+                            if h is not None:
+                                h.register_ts -= 10_000
+                        planner.expire_hosts()
+                        if d2.app_id != NOT_ENOUGH_SLOTS:
+                            _finish(planner, list(chaos.messages))
 
                     if scenario == 1:
                         # SCALE_CHANGE: grow the running app
@@ -99,6 +143,19 @@ def test_planner_accounting_full_lifecycle_concurrent():
 
                     time.sleep(rng.rand() * 0.001)
                     _finish(planner, messages)
+
+                    if scenario == 2:
+                        # Stale MIGRATION racing completed results must
+                        # classify as no-opportunity, not as a fresh app
+                        # (call_batch's raced-results guard)
+                        from faabric_tpu.proto import BatchExecuteType
+
+                        stale = batch_exec_factory("prop", f"fn{seed}", 1)
+                        stale.app_id = req.app_id
+                        stale.type = int(BatchExecuteType.MIGRATION)
+                        d3 = planner.call_batch(stale)
+                        assert d3.app_id in (DO_NOT_MIGRATE,
+                                             NOT_ENOUGH_SLOTS), d3.app_id
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
