@@ -137,6 +137,8 @@ def test_dist_mpi_chunked_bulk_allreduce(dist_cluster):
     ("mpi_send_many", b"send-many-ok"),
     ("mpi_checks", b"checks:7"),
     ("mpi_typesize", b"typesize-ok"),
+    ("mpi_collectives", b"collectives-ok"),
+    ("mpi_p2p_suite", b"p2p-suite-ok"),
 ])
 def test_dist_mpi_more_examples(dist_cluster, behaviour, rank0_out):
     """Further reference example ports: mpi_reduce_many.cpp (100
