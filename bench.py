@@ -362,6 +362,108 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
         clear_host_aliases()
 
 
+def _sendrecv_sizes() -> list[int]:
+    """Reference mpi_send_recv.cpp workload shape (mpi_bench.cpp:18-57):
+    a 'small' burst of 1000×8-int messages plus a ResNet-50-scale mix of
+    variably-sized gradient buckets. The mix below reproduces the
+    magnitude profile (a few multi-MiB conv buckets, a long tail of
+    sub-KiB bn/bias buckets, ~25.5M ints total) without copying the
+    verbatim per-layer table."""
+    import numpy as np
+
+    sizes = [8] * 1000
+    rng = np.random.RandomState(50)
+    big = [2359296, 2097152, 1048576, 1048576, 1048576, 1048576,
+           589824, 589824, 524288, 262144, 262144, 262144, 147456,
+           131072, 65536, 36864, 16384, 9408]
+    sizes += big * 3
+    small_tail = rng.choice([64, 128, 256, 512, 1024, 2048], 400).tolist()
+    sizes += [int(s) for s in small_tail]
+    total = sum(sizes)
+    target = 25_500_000
+    if total < target:
+        sizes.append(target - total)
+    return sizes
+
+
+def _sendrecv_worker_main() -> None:
+    """Child process body for the cross-process send/recv bench: rank 2
+    on xbenchB receives the full size distribution from rank 0, then
+    acks with one byte so the parent's clock includes wire drain."""
+    import numpy as np
+
+    broker, server, world = _bench_world("xbenchB", app_id=4)
+    print("READY", flush=True)
+    try:
+        sizes = _sendrecv_sizes()
+        # Handshake instead of a barrier: only ranks 0 and 2 are driven
+        world.send(2, 0, np.array([7], np.int32))
+        ok = True
+        for n in sizes:
+            got, _ = world.recv(0, 2)
+            ok = ok and got.size == n
+        world.send(2, 0, np.array([1 if ok else 0], np.int32))
+        print("DONE" if ok else "FAILED size mismatch", flush=True)
+    finally:
+        server.stop()
+        broker.clear()
+
+
+def bench_host_sendrecv_procs() -> dict:
+    """MPI point-to-point rate across OS processes (the reference's
+    second headline harness, mpi_send_recv.cpp:13-48): rank 0 streams
+    the size distribution to rank 2 over the bulk plane; rate =
+    total workload bytes / wall time, as mpi_bench.cpp:60-85 reports."""
+    import subprocess
+
+    import numpy as np
+
+    from faabric_tpu.transport.common import (
+        clear_host_aliases,
+        register_host_alias,
+    )
+
+    base_a = random.randint(10, 120) * 100
+    base_b = base_a + 3000
+    clear_host_aliases()
+    register_host_alias("xbenchA", "127.0.0.1", base_a)
+    register_host_alias("xbenchB", "127.0.0.1", base_b)
+    env = {**os.environ,
+           "FAABRIC_HOST_ALIASES":
+           f"xbenchA=127.0.0.1+{base_a},xbenchB=127.0.0.1+{base_b}"}
+    broker, server, world = _bench_world("xbenchA", app_id=4)
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--sendrecv-worker"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = child.stdout.readline().strip()
+        assert line == "READY", f"worker said {line!r}"
+        sizes = _sendrecv_sizes()
+        bufs = [np.zeros(n, np.int32) for n in sizes]
+        hello, _ = world.recv(2, 0)  # receiver up (no barrier: 2 ranks)
+        assert int(hello[0]) == 7
+        t0 = time.perf_counter()
+        for buf in bufs:
+            world.send(0, 2, buf)
+        ack, _ = world.recv(2, 0)
+        elapsed = time.perf_counter() - t0
+        assert int(ack[0]) == 1, "receiver saw wrong sizes"
+        status = child.stdout.readline().strip()
+        assert status == "DONE", f"worker reported: {status!r}"
+        workload = sum(sizes) * 4
+        return {"rate_gibs": workload / elapsed / (1 << 30),
+                "workload_mib": workload / (1 << 20),
+                "n_messages": len(sizes), "n_processes": 2}
+    finally:
+        server.stop()
+        broker.clear()
+        try:
+            child.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            child.kill()
+        clear_host_aliases()
+
+
 def _count_params(params) -> int:
     import jax
 
@@ -1252,6 +1354,7 @@ def main() -> None:
     host_section("host_allreduce", lambda: bench_host_allreduce(
         n_ranks=4, elems=1_000_000 if quick else 25_500_000,
         rounds=1 if quick else 3))
+    host_section("host_sendrecv_procs", bench_host_sendrecv_procs)
     host_section("host_allreduce_procs", lambda: bench_host_allreduce_procs(
         elems=1_000_000 if quick else 25_500_000,
         rounds=1 if quick else 3))
@@ -1318,6 +1421,9 @@ def main() -> None:
     print(line)
 
 if __name__ == "__main__":
+    if "--sendrecv-worker" in sys.argv:
+        _sendrecv_worker_main()
+        sys.exit(0)
     if "--allreduce-worker" in sys.argv:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         i = sys.argv.index("--allreduce-worker")
