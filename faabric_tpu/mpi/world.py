@@ -140,6 +140,7 @@ class MpiWorld:
         # rank → host cache (initLocalRemoteLeaders, MpiWorld.cpp:318-366)
         self._rank_hosts: dict[int, str] = {}
         self._local_leader_cache: dict[str, int] = {}
+        self._same_machine_cache: bool | None = None
 
         # Exec-graph accounting (MpiWorld.h:13-18)
         self._msg_count_to_rank: dict[int, int] = {}
@@ -162,6 +163,7 @@ class MpiWorld:
                 for idx in range(self.size)
             }
             self._local_leader_cache.clear()
+            self._same_machine_cache = None
 
     def host_for_rank(self, rank: int) -> str:
         with self._lock:
@@ -768,7 +770,7 @@ class MpiWorld:
         # Multi-host worlds keep the leader tree: it sends exactly one
         # message per remote host over the wire, which the ring does not.
         arr = np.asarray(data)
-        if (len(self.hosts()) == 1 and self.size > 1
+        if (self.size > 1 and self._all_hosts_same_machine()
                 and arr.nbytes >= self.CHUNK_BYTES * 2
                 and arr.size >= self.size
                 and (not isinstance(op, UserOp) or op.commute)):
@@ -779,6 +781,23 @@ class MpiWorld:
         reduced = self.reduce(rank, MAIN_RANK, data, op, _shared_ok=True)
         return self.broadcast(MAIN_RANK, rank,
                               reduced if rank == MAIN_RANK else np.asarray(data))
+
+    def _all_hosts_same_machine(self) -> bool:
+        """True when every rank's host resolves to THIS machine (rank
+        threads in one process, or worker processes sharing the box whose
+        cross-process legs ride the shm ring). The ring's extra hop count
+        is free on local bandwidth; over a real network the hierarchical
+        leader tree's one-message-per-host wins instead."""
+        cached = self._same_machine_cache
+        if cached is not None:
+            return cached
+        from faabric_tpu.transport.bulk import _is_local_ip
+        from faabric_tpu.transport.common import resolve_host
+
+        result = all(_is_local_ip(resolve_host(h, 0)[0])
+                     for h in self.hosts())
+        self._same_machine_cache = result
+        return result
 
     def _allreduce_ring(self, rank: int, data: np.ndarray,
                         op: MpiOp) -> np.ndarray:
