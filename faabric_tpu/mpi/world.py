@@ -141,6 +141,7 @@ class MpiWorld:
         self._rank_hosts: dict[int, str] = {}
         self._local_leader_cache: dict[str, int] = {}
         self._same_machine_cache: bool | None = None
+        self._topology_gen = 0  # bumped by refresh_rank_hosts
 
         # Exec-graph accounting (MpiWorld.h:13-18)
         self._msg_count_to_rank: dict[int, int] = {}
@@ -164,6 +165,7 @@ class MpiWorld:
             }
             self._local_leader_cache.clear()
             self._same_machine_cache = None
+            self._topology_gen += 1
 
     def host_for_rank(self, rank: int) -> str:
         with self._lock:
@@ -788,15 +790,24 @@ class MpiWorld:
         cross-process legs ride the shm ring). The ring's extra hop count
         is free on local bandwidth; over a real network the hierarchical
         leader tree's one-message-per-host wins instead."""
-        cached = self._same_machine_cache
-        if cached is not None:
-            return cached
-        from faabric_tpu.transport.bulk import _is_local_ip
         from faabric_tpu.transport.common import resolve_host
+        from faabric_tpu.util.network import is_local_ip
 
-        result = all(_is_local_ip(resolve_host(h, 0)[0])
-                     for h in self.hosts())
-        self._same_machine_cache = result
+        with self._lock:
+            if self._same_machine_cache is not None:
+                return self._same_machine_cache
+            gen = self._topology_gen
+        hosts = self.hosts()
+        # A single-host world is same-machine by definition — delivery is
+        # in-process no matter what the host label resolves to
+        result = len(hosts) == 1 or all(
+            is_local_ip(resolve_host(h, 0)[0]) for h in hosts)
+        with self._lock:
+            # Only cache if no refresh_rank_hosts (migration remap) raced
+            # this computation — a stale verdict would desync ring/tree
+            # algorithm choice across processes and hang the collective
+            if self._topology_gen == gen:
+                self._same_machine_cache = result
         return result
 
     def _allreduce_ring(self, rank: int, data: np.ndarray,

@@ -295,14 +295,9 @@ class BulkServer:
 
 
 def _is_local_ip(ip: str) -> bool:
-    if ip.startswith("127.") or ip == "localhost":
-        return True
-    from faabric_tpu.util.network import get_primary_ip_for_this_host
+    from faabric_tpu.util.network import is_local_ip
 
-    try:
-        return ip == get_primary_ip_for_this_host()
-    except OSError:
-        return False
+    return is_local_ip(ip)
 
 
 class BulkClient:

@@ -22,6 +22,18 @@ def get_primary_ip_for_this_host() -> str:
         return LOCALHOST
 
 
+def is_local_ip(ip: str) -> bool:
+    """True when ``ip`` names THIS machine (loopback or the primary
+    interface address). Used to gate same-machine fast paths (shm rings,
+    ring allreduce)."""
+    if ip.startswith("127.") or ip == "localhost":
+        return True
+    try:
+        return ip == get_primary_ip_for_this_host()
+    except OSError:
+        return False
+
+
 def get_free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("", 0))
