@@ -418,10 +418,13 @@ class BulkClient:
                 # surfaces arrives twice — the ordered-recv path drops
                 # duplicate sequence numbers. Known limitation: an RST
                 # that discards a delivered-but-unread earlier frame on a
-                # LIVE peer leaves a seq gap this retry cannot heal (the
-                # reference keeps sender-side UNACKED buffers for this,
-                # MpiWorld.cpp:1963-2030); ordered recvs then time out
-                # rather than hang silently.
+                # LIVE peer leaves a seq gap this retry cannot heal;
+                # ordered recvs then time out rather than hang silently.
+                # (The reference's raw-TCP plane has no reliability layer
+                # either — its per-rank-pair sockets never reconnect, and
+                # its "unacked message buffers", MpiWorld.cpp:1963-2030,
+                # are the receiver-side irecv-pending queues, which this
+                # framework implements in mpi/world.py's async requests.)
                 self._reset_sock_locked()
                 try:
                     self._sock = self._dial()
