@@ -184,7 +184,8 @@ def test_allreduce_matches_numpy(mpi_cluster, op, npop):
     (MpiOp.SUM, np.add),
     (MpiOp.MAX, np.maximum),
 ])
-def test_allreduce_ring_single_host(op, npop, monkeypatch):
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_allreduce_ring_single_host(op, npop, world_size, monkeypatch):
     """Large single-host payloads take the zero-copy ring path
     (reduce-scatter + allgather over ownership-transferred segments).
     Checks: values match numpy, the caller's buffer survives unmodified
@@ -193,23 +194,23 @@ def test_allreduce_ring_single_host(op, npop, monkeypatch):
     monkeypatch.setattr(MpiWorld, "CHUNK_BYTES_LOCAL", 256)
     broker = PointToPointBroker("ringhost")
     decision = SchedulingDecision(app_id=77, group_id=77)
-    for rank in range(4):
+    for rank in range(world_size):
         decision.add_message("ringhost", 3000 + rank, rank, rank)
     broker.set_up_local_mappings_from_decision(decision)
-    world = MpiWorld(broker, 77, 4, 77)
+    world = MpiWorld(broker, 77, world_size, 77)
 
     n = 1003  # odd: uneven segment split
-    datas = {r: per_rank_data(r, n) for r in range(4)}
-    orig = {r: datas[r].copy() for r in range(4)}
+    datas = {r: per_rank_data(r, n) for r in range(world_size)}
+    orig = {r: datas[r].copy() for r in range(world_size)}
     expected = datas[0]
-    for r in range(1, 4):
+    for r in range(1, world_size):
         expected = npop(expected, datas[r])
 
     def fn(world_, rank):
         return world_.allreduce(rank, datas[rank], op)
 
-    results = run_ranks(lambda r: world, fn, n=4)
-    for rank in range(4):
+    results = run_ranks(lambda r: world, fn, n=world_size)
+    for rank in range(world_size):
         np.testing.assert_allclose(results[rank], expected, rtol=1e-12)
         np.testing.assert_array_equal(datas[rank], orig[rank])
         assert datas[rank].flags.writeable
