@@ -864,10 +864,37 @@ def run_plane_worker(host: str, n_procs: int) -> None:
         loss = float(loss)
         ok = ok and np.isfinite(loss)
 
+        # Cross-process PIPELINE: a {dp:2, tp:2, pp:2} mesh whose pp=2
+        # stages live in DIFFERENT worker processes (device order is
+        # process-major, and pp is the mesh's last/fastest axis here, so
+        # at least one inter-stage ppermute hop crosses the process
+        # boundary inside the compiled 1F1B step)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from faabric_tpu.parallel.pipeline import (
+            init_pp_train_state,
+            make_pp_train_step,
+        )
+
+        pp_mesh = build_mesh(jax.devices(), MeshConfig(tp=2, pp=2))
+        pp_params, pp_opt = init_pp_train_state(
+            jax.random.PRNGKey(0), cfg, pp_mesh)
+        pp_step = make_pp_train_step(cfg, pp_mesh, n_microbatches=2,
+                                     schedule_name="1f1b")
+        batch_sharding = NamedSharding(pp_mesh, P("dp", None))
+        pp_tokens = jax.device_put(
+            rng.randint(0, 128, (8, 16)).astype(np.int32), batch_sharding)
+        pp_targets = jax.device_put(
+            rng.randint(0, 128, (8, 16)).astype(np.int32), batch_sharding)
+        _, _, pp_loss = pp_step(pp_params, pp_opt, pp_tokens, pp_targets)
+        pp_loss = float(pp_loss)
+        ok = ok and np.isfinite(pp_loss)
+
         print(f"PLANE-{'OK' if ok else 'FAIL'} proc={s['process_index']}/"
               f"{s['process_count']} gdev={s['global_devices']} "
               f"ldev={s['local_devices']} ranks={local_ranks} "
-              f"loss={loss:.6f}", flush=True)
+              f"pp_loss={pp_loss:.6f} loss={loss:.6f}", flush=True)
     except Exception as e:  # noqa: BLE001 — report to the harness
         print(f"PLANE-FAIL {type(e).__name__}: {e}"[:200], flush=True)
     time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))

@@ -328,12 +328,16 @@ def test_device_plane_cross_process_collectives(dist_cluster):
         # full 8-device plane
         assert {l.split("gdev=")[1].split()[0]
                 for l in lines.values()} == {"8"}
-        ranks = {l.split("ranks=")[1].split(" loss=")[0]
+        ranks = {l.split("ranks=")[1].split(" pp_loss=")[0]
                  for l in lines.values()}
         assert ranks == {"[0, 1, 2, 3]", "[4, 5, 6, 7]"}, ranks
-        # Both controllers ran the SAME global train step: identical loss
-        losses = {l.split("loss=")[1] for l in lines.values()}
+        # Both controllers ran the SAME global train steps: identical
+        # losses from the dp*tp step AND the cross-process-pp 1F1B step
+        losses = {l.split(" loss=")[1] for l in lines.values()}
         assert len(losses) == 1, lines
+        pp_losses = {l.split("pp_loss=")[1].split()[0]
+                     for l in lines.values()}
+        assert len(pp_losses) == 1, lines
     finally:
         for p in procs:
             p.terminate()
