@@ -168,12 +168,43 @@ class Planner:
     def expire_hosts(self) -> None:
         conf = get_system_config()
         now = time.monotonic()
+        doomed: list[Message] = []
         with self._lock:
             stale = [ip for ip, h in self._hosts.items()
                      if now - h.register_ts > conf.planner_host_timeout]
             for ip in stale:
                 logger.warning("Expiring host %s (no keep-alive)", ip)
                 del self._hosts[ip]
+            if stale:
+                # A dead worker cannot report results: fail its in-flight
+                # messages so batch waiters unblock instead of hanging
+                # forever (dispatch is async fire-and-forget — a write
+                # onto a pooled connection to a just-killed process can
+                # "succeed" into the kernel buffer, so dispatch-time
+                # error handling alone cannot catch this)
+                stale_set = set(stale)
+                for app_id, (req, decision) in self._in_flight.items():
+                    for i, h in enumerate(decision.hosts):
+                        if h in stale_set:
+                            mid = decision.message_ids[i]
+                            doomed.extend(m for m in req.messages
+                                          if m.id == mid)
+        if doomed:
+            # expire_hosts runs under callers' locks (_policy_host_map);
+            # set_message_result re-enters the RLock and pushes to result
+            # waiters over the network — defer to a thread so no network
+            # I/O ever happens under the planner lock
+            def _fail_expired(msgs=doomed):
+                for m in msgs:
+                    m.return_value = int(ReturnValue.FAILED)
+                    m.output_data = b"Host expired"
+                    try:
+                        self.set_message_result(m)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("Failing expired-host msg %d", m.id)
+
+            threading.Thread(target=_fail_expired, name="expiry-fail",
+                             daemon=True).start()
 
     def get_available_hosts(self) -> list[HostState]:
         self.expire_hosts()

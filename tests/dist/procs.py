@@ -763,21 +763,21 @@ class DistFactory(ExecutorFactory):
         return DistExecutor(msg)
 
 
-def run_planner() -> None:
+def run_planner(port_offset: int = 0) -> None:
     from faabric_tpu.planner import PlannerServer
 
-    server = PlannerServer(port_offset=0)
+    server = PlannerServer(port_offset=port_offset)
     server.start()
     print("READY", flush=True)
     time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
     server.stop()
 
 
-def run_worker(host: str) -> None:
+def run_worker(host: str, planner_host: str = "127.0.0.1") -> None:
     from faabric_tpu.runner import WorkerRuntime
 
     w = WorkerRuntime(host=host, slots=4, n_devices=4, factory=DistFactory(),
-                      planner_host="127.0.0.1")
+                      planner_host=planner_host)
     w.start()
     print("READY", flush=True)
     time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
@@ -909,8 +909,9 @@ if __name__ == "__main__":
     faulthandler.register(signal.SIGUSR1)
     role = sys.argv[1]
     if role == "planner":
-        run_planner()
+        run_planner(int(sys.argv[2]) if len(sys.argv) > 2 else 0)
     elif role == "planeworker":
         run_plane_worker(sys.argv[2], int(sys.argv[3]))
     else:
-        run_worker(sys.argv[2])
+        run_worker(sys.argv[2],
+                   sys.argv[3] if len(sys.argv) > 3 else "127.0.0.1")

@@ -348,6 +348,127 @@ def test_device_plane_cross_process_collectives(dist_cluster):
                 p.kill()
 
 
+def test_dist_worker_crash_fail_dispatch_and_expiry():
+    """SURVEY §5.3 end-to-end: a worker process is SIGKILLed; a batch
+    that still places on it gets its messages failed by the planner's
+    fail_dispatch (not hung), the dead host expires off the registry at
+    the keep-alive timeout, and a follow-up batch lands entirely on the
+    survivor. Self-contained cluster on its own ports (PLANNER_HOST_
+    TIMEOUT=4 so expiry is observable) so the module fixture's cluster
+    is untouched."""
+    import signal as _signal
+
+    from faabric_tpu.executor import ExecutorFactory
+    from faabric_tpu.runner import WorkerRuntime
+    from faabric_tpu.transport.common import clear_host_aliases
+
+    crash_aliases = (ALIASES + ",plB=127.0.0.1+500,w5=127.0.0.1+2000,"
+                     "w6=127.0.0.1+5000,cli2=127.0.0.1+7000")
+    env = dict(os.environ, FAABRIC_HOST_ALIASES=crash_aliases,
+               JAX_PLATFORMS="cpu", PLANNER_HOST_TIMEOUT="4")
+    procs = []
+
+    def spawn(*args):
+        p = subprocess.Popen([sys.executable, PROCS, *args],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True, env=env)
+        procs.append(p)
+        return p
+
+    old_aliases = os.environ.get("FAABRIC_HOST_ALIASES")
+    os.environ["FAABRIC_HOST_ALIASES"] = crash_aliases
+    clear_host_aliases()
+    os.environ["PLANNER_HOST_TIMEOUT"] = "4"
+    me = None
+    try:
+        planner = spawn("planner", "500")
+        assert planner.stdout.readline().strip() == "READY"
+        w5 = spawn("worker", "w5", "plB")
+        w6 = spawn("worker", "w6", "plB")
+        for p in (w5, w6):
+            assert p.stdout.readline().strip() == "READY"
+
+        class NullFactory(ExecutorFactory):
+            def create_executor(self, msg):
+                raise RuntimeError("client runs nothing")
+
+        me = WorkerRuntime(host="cli2", slots=0, factory=NullFactory(),
+                           planner_host="plB")
+        me.start()
+
+        # Healthy cluster: 8 messages spread over both workers
+        req = batch_exec_factory("dist", "square", 8)
+        for i, m in enumerate(req.messages):
+            m.input_data = str(i + 1).encode()
+        decision = me.planner_client.call_functions(req)
+        assert sorted(set(decision.hosts)) == ["w5", "w6"]
+        status = wait_batch_finished(me, req.app_id, timeout=30)
+        assert all(m.return_value == int(ReturnValue.SUCCESS)
+                   for m in status.message_results)
+
+        # Kill w6 outright. A batch placed before expiry has its w6
+        # messages stranded (async dispatch onto a dead pooled connection
+        # cannot error); the EXPIRY must fail them so waiters unblock.
+        w6.send_signal(_signal.SIGKILL)
+        w6.wait(timeout=5)
+        req2 = batch_exec_factory("dist", "square", 8)
+        for i, m in enumerate(req2.messages):
+            m.input_data = str(i + 1).encode()
+        d2 = me.planner_client.call_functions(req2)
+        assert "w6" in d2.hosts, d2.hosts  # planner hasn't expired it yet
+
+        # The dead host expires off the registry at the keep-alive TTL
+        # (polling get_available_hosts drives the lazy expiry)
+        deadline = time.time() + 15
+        hosts = None
+        while time.time() < deadline:
+            hosts = {h["ip"] for h in me.planner_client.get_available_hosts()}
+            if "w6" not in hosts:
+                break
+            time.sleep(0.5)
+        assert "w6" not in hosts, hosts
+
+        # Expiry failed the stranded messages; the batch resolves with
+        # the survivor's successes and the dead host's failures
+        status2 = wait_batch_finished(me, req2.app_id, timeout=30)
+        by_host = {}
+        for m, h in zip(req2.messages, d2.hosts):
+            r = next(x for x in status2.message_results if x.id == m.id)
+            by_host.setdefault(h, []).append(r)
+        assert all(r.return_value == int(ReturnValue.SUCCESS)
+                   for r in by_host["w5"])
+        assert all(r.return_value == int(ReturnValue.FAILED)
+                   for r in by_host["w6"])
+        assert any(b"expired" in r.output_data or b"failed" in r.output_data
+                   for r in by_host["w6"]), by_host["w6"]
+
+        # And the cluster heals: a survivor-sized batch fully succeeds
+        req3 = batch_exec_factory("dist", "square", 4)
+        for i, m in enumerate(req3.messages):
+            m.input_data = str(i + 1).encode()
+        d3 = me.planner_client.call_functions(req3)
+        assert set(d3.hosts) == {"w5"}, d3.hosts
+        status3 = wait_batch_finished(me, req3.app_id, timeout=30)
+        assert all(m.return_value == int(ReturnValue.SUCCESS)
+                   for m in status3.message_results)
+    finally:
+        if me is not None:
+            me.shutdown()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if old_aliases is None:
+            os.environ.pop("FAABRIC_HOST_ALIASES", None)
+        else:
+            os.environ["FAABRIC_HOST_ALIASES"] = old_aliases
+        os.environ.pop("PLANNER_HOST_TIMEOUT", None)
+        clear_host_aliases()
+
+
 def test_dist_mpi_alltoall_sleep(dist_cluster):
     """Reference example mpi_alltoall_sleep across real worker
     processes: 100 barrier+alltoall rounds with a mid-stream straggler
