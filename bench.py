@@ -1076,7 +1076,8 @@ def bench_dirty_tracker(quick: bool = False) -> dict:
         mem = np.zeros(size_mib << 20, np.uint8)
         per_mode: dict = {}
         stamp = 0
-        for mode in ("compare", "native", "hash", "segv", "softpte"):
+        for mode in ("compare", "native", "hash", "segv", "softpte",
+                     "uffd"):
             stamp += 1  # each bracket must see a REAL change
             t = make_dirty_tracker(mode)
             if t.mode != mode:

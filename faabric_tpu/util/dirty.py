@@ -358,10 +358,12 @@ class SegvTracker(DirtyTracker):
     def __init__(self) -> None:
         from faabric_tpu.util.native import get_segv_lib
 
-        self._lib = get_segv_lib()
-        if self._lib is None:
+        lib = get_segv_lib()
+        if lib is None:
             raise RuntimeError("segv dirty tracking unavailable "
                                "(native build failed)")
+        self._start_fn = lib.segv_start
+        self._stop_fn = lib.segv_stop
         self._region_ids: list[int] = []
         self._os_flags: Optional[np.ndarray] = None
         self._addr = 0
@@ -390,20 +392,20 @@ class SegvTracker(DirtyTracker):
         else:
             runs = [(0, n_os)]
         for lo, count in runs:
-            rid = self._lib.segv_start(
+            rid = self._start_fn(
                 start_al + lo * PAGE_SIZE, count,
                 self._os_flags.ctypes.data + lo)
             if rid < 0:
                 for r in self._region_ids:
-                    self._lib.segv_stop(r)
+                    self._stop_fn(r)
                 self._region_ids = []
-                raise RuntimeError(f"segv_start failed ({rid}) — "
+                raise RuntimeError(f"{self.mode} start failed ({rid}) — "
                                    "unprotectable mapping?")
             self._region_ids.append(rid)
 
     def stop_tracking(self, mem) -> None:
         for rid in self._region_ids:
-            self._lib.segv_stop(rid)
+            self._stop_fn(rid)
         self._region_ids = []
 
     def get_dirty_pages(self, mem) -> np.ndarray:
@@ -438,6 +440,37 @@ class SegvTracker(DirtyTracker):
             self.stop_tracking(None)
         except Exception:  # noqa: BLE001
             pass
+
+
+class UffdTracker(SegvTracker):
+    """userfaultfd write-protect tracking — the reference's
+    uffd-thread-wp mode (src/util/dirty.cpp uffd impls,
+    include/faabric/util/dirty.h:124-192): same O(dirty) fault-per-page
+    cost model as SIGSEGV tracking, but faults are ordinary events
+    consumed by ONE native thread (native/uffd_tracker.cpp) instead of a
+    process-wide signal handler — no async-signal-safety constraints and
+    no interaction with other SIGSEGV users (libtpu, faulthandler).
+    Kernel-side writes into the range (read(2)/recv into the buffer)
+    fault-and-resolve normally instead of failing EFAULT, which the
+    segv mode cannot offer. Needs kernel >= 5.7 uffd-wp; unavailable
+    kernels fall down the ladder (uffd -> segv -> native)."""
+
+    mode = "uffd"
+
+    def __init__(self) -> None:
+        from faabric_tpu.util.native import get_uffd_lib
+
+        lib = get_uffd_lib()
+        if lib is None:
+            raise RuntimeError("uffd-wp dirty tracking unavailable "
+                               "(kernel or native build)")
+        self._start_fn = lib.uffd_start
+        self._stop_fn = lib.uffd_stop
+        self._region_ids = []
+        self._os_flags = None
+        self._addr = 0
+        self._size = 0
+        self._page_off = 0
 
 
 def _mask_runs(mask: np.ndarray) -> list:
@@ -594,6 +627,7 @@ _TRACKERS = {
     "none": NoneTracker,
     "segv": SegvTracker,
     "softpte": SoftPTETracker,
+    "uffd": UffdTracker,
 }
 
 _FALLBACK_WARNED: set = set()

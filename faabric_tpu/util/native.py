@@ -182,6 +182,59 @@ def get_segv_lib() -> Optional[ctypes.CDLL]:
         return _segv_lib
 
 
+_UFFD_SRC = os.path.join(_REPO_ROOT, "native", "uffd_tracker.cpp")
+_UFFD_SO = os.path.join(_REPO_ROOT, "native", "build", "libuffdtracker.so")
+
+_uffd_lib: Optional[ctypes.CDLL] = None
+_uffd_tried = False
+
+
+def get_uffd_lib() -> Optional[ctypes.CDLL]:
+    """The userfaultfd write-protect dirty tracker
+    (native/uffd_tracker.cpp) — O(dirty) like the segv mode but faults
+    are resolved by a dedicated event thread instead of a process-wide
+    signal handler (the reference's uffd-thread-wp mode). None when the
+    kernel lacks uffd-wp or the native build fails."""
+    global _uffd_lib, _uffd_tried
+    with _lock:
+        if _uffd_tried:
+            return _uffd_lib
+        _uffd_tried = True
+        if not os.path.exists(_UFFD_SRC):
+            return None
+        if not os.path.exists(_UFFD_SO) or (os.path.getmtime(_UFFD_SO)
+                                            < os.path.getmtime(_UFFD_SRC)):
+            os.makedirs(os.path.dirname(_UFFD_SO), exist_ok=True)
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                   _UFFD_SRC, "-o", _UFFD_SO, "-lpthread"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, OSError) as e:
+                logger.warning("Native uffd_tracker build failed (%s); "
+                               "uffd dirty mode unavailable", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_UFFD_SO)
+        except OSError as e:
+            logger.warning("Could not load %s: %s", _UFFD_SO, e)
+            return None
+        lib.uffd_install.restype = ctypes.c_int
+        lib.uffd_install.argtypes = []
+        lib.uffd_start.restype = ctypes.c_int
+        lib.uffd_start.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_void_p]
+        lib.uffd_stop.restype = ctypes.c_int
+        lib.uffd_stop.argtypes = [ctypes.c_int]
+        rc = lib.uffd_install()
+        if rc != 0:
+            logger.info("userfaultfd write-protect unavailable (rc=%d); "
+                        "DIRTY_TRACKING_MODE=uffd falls back", rc)
+            return None
+        _uffd_lib = lib
+        return _uffd_lib
+
+
 def reset_for_tests() -> None:
     global _lib, _tried, _shm_lib, _shm_tried
     with _lock:

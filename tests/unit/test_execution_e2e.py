@@ -345,7 +345,7 @@ class ThreadsExecutor(Executor):
         return int(ReturnValue.SUCCESS)
 
 
-@pytest.mark.parametrize("dirty_mode", ["native", "segv"])
+@pytest.mark.parametrize("dirty_mode", ["native", "segv", "uffd"])
 def test_threads_batch_two_hosts_snapshot_merge(cluster, dirty_mode,
                                                 monkeypatch):
     """VERDICT item 7 'done' criterion: a THREADS batch across two hosts
@@ -356,10 +356,12 @@ def test_threads_batch_two_hosts_snapshot_merge(cluster, dirty_mode,
     import numpy as np
 
     from faabric_tpu.util.config import get_system_config
-    from faabric_tpu.util.native import get_segv_lib
+    from faabric_tpu.util.native import get_segv_lib, get_uffd_lib
 
     if dirty_mode == "segv" and get_segv_lib() is None:
         pytest.skip("segv tracker unavailable")
+    if dirty_mode == "uffd" and get_uffd_lib() is None:
+        pytest.skip("uffd tracker unavailable")
     # monkeypatch restores the prior mode, so the segv parametrization
     # cannot leak into every later test in the process
     monkeypatch.setattr(get_system_config(), "dirty_tracking_mode",

@@ -276,13 +276,15 @@ def test_delta_grows_and_shrinks():
 
 def _kernel_modes():
     from faabric_tpu.util.dirty import softpte_available
-    from faabric_tpu.util.native import get_segv_lib
+    from faabric_tpu.util.native import get_segv_lib, get_uffd_lib
 
     modes = []
     if get_segv_lib() is not None:
         modes.append("segv")
     if softpte_available():
         modes.append("softpte")
+    if get_uffd_lib() is not None:
+        modes.append("uffd")
     return modes or ["skip"]
 
 
