@@ -196,6 +196,13 @@ class Planner:
             # I/O ever happens under the planner lock
             def _fail_expired(msgs=doomed):
                 for m in msgs:
+                    # A host that was merely SLOW (paused past the
+                    # keep-alive timeout, then resumed) may have reported
+                    # a genuine result between collection and now —
+                    # never overwrite it with a synthetic failure
+                    with self._lock:
+                        if m.id in self._results.get(m.app_id, {}):
+                            continue
                     m.return_value = int(ReturnValue.FAILED)
                     m.output_data = b"Host expired"
                     try:

@@ -355,15 +355,18 @@ class SegvTracker(DirtyTracker):
 
     mode = "segv"
 
-    def __init__(self) -> None:
+    def _get_lib(self):
         from faabric_tpu.util.native import get_segv_lib
 
-        lib = get_segv_lib()
+        return get_segv_lib()
+
+    def __init__(self) -> None:
+        lib = self._get_lib()
         if lib is None:
-            raise RuntimeError("segv dirty tracking unavailable "
-                               "(native build failed)")
-        self._start_fn = lib.segv_start
-        self._stop_fn = lib.segv_stop
+            raise RuntimeError(f"{self.mode} dirty tracking unavailable "
+                               "(kernel or native build)")
+        self._start_fn = getattr(lib, f"{self.mode}_start")
+        self._stop_fn = getattr(lib, f"{self.mode}_stop")
         self._region_ids: list[int] = []
         self._os_flags: Optional[np.ndarray] = None
         self._addr = 0
@@ -457,20 +460,10 @@ class UffdTracker(SegvTracker):
 
     mode = "uffd"
 
-    def __init__(self) -> None:
+    def _get_lib(self):
         from faabric_tpu.util.native import get_uffd_lib
 
-        lib = get_uffd_lib()
-        if lib is None:
-            raise RuntimeError("uffd-wp dirty tracking unavailable "
-                               "(kernel or native build)")
-        self._start_fn = lib.uffd_start
-        self._stop_fn = lib.uffd_stop
-        self._region_ids = []
-        self._os_flags = None
-        self._addr = 0
-        self._size = 0
-        self._page_off = 0
+        return get_uffd_lib()
 
 
 def _mask_runs(mask: np.ndarray) -> list:

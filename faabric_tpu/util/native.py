@@ -1,9 +1,9 @@
-"""Loader for the native C++ helpers (native/pagediff.cpp).
+"""Loader for the native C++ helpers under native/.
 
-Compiles the shared library on first use (g++ is baked into the image;
-pybind11 is not, so the binding is ctypes over an extern-C surface) and
+Compiles each shared library on first use (g++ is baked into the image;
+pybind11 is not, so the bindings are ctypes over extern-C surfaces) and
 caches it next to the source. Falls back cleanly: callers check
-``get_pagediff_lib() is not None`` and use the numpy path otherwise.
+``get_*_lib() is not None`` and use the pure-Python/numpy path otherwise.
 """
 
 from __future__ import annotations
@@ -12,7 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 from faabric_tpu.util.logging import get_logger
 
@@ -20,173 +20,133 @@ logger = get_logger(__name__)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "pagediff.cpp")
-_SO = os.path.join(_REPO_ROOT, "native", "build", "libpagediff.so")
 
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
 _lock = threading.Lock()
+# name → loaded lib, or None after a failed attempt (one try per process)
+_cache: dict[str, Optional[ctypes.CDLL]] = {}
 
 
-def _build() -> bool:
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, OSError) as e:
-        logger.warning("Native pagediff build failed (%s); using numpy path", e)
-        return False
+def _load_native(name: str, src_file: str, so_file: str,
+                 declare: Callable[[ctypes.CDLL], None],
+                 install: Optional[Callable[[ctypes.CDLL], bool]] = None,
+                 extra_args: tuple = (),
+                 fail_note: str = "") -> Optional[ctypes.CDLL]:
+    """Shared compile-if-stale / load / declare-signatures / install
+    path for every native helper; one attempt per process per lib."""
+    src = os.path.join(_REPO_ROOT, "native", src_file)
+    so = os.path.join(_REPO_ROOT, "native", "build", so_file)
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        _cache[name] = None
+        if not os.path.exists(src):
+            return None
+        if not os.path.exists(so) or (os.path.getmtime(so)
+                                      < os.path.getmtime(src)):
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                   src, "-o", so, *extra_args]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, OSError) as e:
+                logger.warning("Native %s build failed (%s); %s",
+                               name, e, fail_note)
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            logger.warning("Could not load %s: %s", so, e)
+            return None
+        declare(lib)
+        if install is not None and not install(lib):
+            return None
+        _cache[name] = lib
+        return lib
+
+
+def _declare_pagediff(lib: ctypes.CDLL) -> None:
+    # void* arguments: callers pass numpy buffer addresses
+    lib.diff_pages.restype = ctypes.c_size_t
+    lib.diff_pages.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_size_t, ctypes.c_size_t,
+                               ctypes.c_void_p]
+    lib.diff_ranges.restype = ctypes.c_size_t
+    lib.diff_ranges.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_size_t, ctypes.c_size_t,
+                                ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_size_t]
+    lib.xor_buffers.restype = None
+    lib.xor_buffers.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_void_p, ctypes.c_size_t]
 
 
 def get_pagediff_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        if not os.path.exists(_SRC):
-            return None
-        if not os.path.exists(_SO) or (os.path.getmtime(_SO)
-                                       < os.path.getmtime(_SRC)):
-            if not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as e:
-            logger.warning("Could not load %s: %s", _SO, e)
-            return None
-        # void* arguments: callers pass numpy buffer addresses
-        lib.diff_pages.restype = ctypes.c_size_t
-        lib.diff_pages.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                   ctypes.c_size_t, ctypes.c_size_t,
-                                   ctypes.c_void_p]
-        lib.diff_ranges.restype = ctypes.c_size_t
-        lib.diff_ranges.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                    ctypes.c_size_t, ctypes.c_size_t,
-                                    ctypes.c_void_p, ctypes.c_void_p,
-                                    ctypes.c_size_t]
-        lib.xor_buffers.restype = None
-        lib.xor_buffers.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                    ctypes.c_void_p, ctypes.c_size_t]
-        _lib = lib
-        return _lib
+    return _load_native("pagediff", "pagediff.cpp", "libpagediff.so",
+                        _declare_pagediff, fail_note="using numpy path")
 
 
-_SHM_SRC = os.path.join(_REPO_ROOT, "native", "shm_ring.cpp")
-_SHM_SO = os.path.join(_REPO_ROOT, "native", "build", "libshmring.so")
-
-_shm_lib: Optional[ctypes.CDLL] = None
-_shm_tried = False
+def _declare_shmring(lib: ctypes.CDLL) -> None:
+    lib.ring_init.restype = ctypes.c_int
+    lib.ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ring_check.restype = ctypes.c_int64
+    lib.ring_check.argtypes = [ctypes.c_void_p]
+    lib.ring_free_space.restype = ctypes.c_int64
+    lib.ring_free_space.argtypes = [ctypes.c_void_p]
+    lib.ring_try_pushv.restype = ctypes.c_int
+    lib.ring_try_pushv.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_void_p),
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.c_uint64]
+    lib.ring_peek.restype = ctypes.c_int64
+    lib.ring_peek.argtypes = [ctypes.c_void_p]
+    lib.ring_pop.restype = ctypes.c_int64
+    lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_uint64]
+    lib.ring_wait_data.restype = ctypes.c_int
+    lib.ring_wait_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ring_wait_space.restype = ctypes.c_int
+    lib.ring_wait_space.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                    ctypes.c_uint32]
 
 
 def get_shmring_lib() -> Optional[ctypes.CDLL]:
     """The SPSC shared-memory ring (native/shm_ring.cpp) — the
     same-machine bulk data plane's hot path. None when g++ or the source
     is unavailable; callers fall back to the TCP plane."""
-    global _shm_lib, _shm_tried
-    with _lock:
-        if _shm_tried:
-            return _shm_lib
-        _shm_tried = True
-        if not os.path.exists(_SHM_SRC):
-            return None
-        if not os.path.exists(_SHM_SO) or (os.path.getmtime(_SHM_SO)
-                                           < os.path.getmtime(_SHM_SRC)):
-            os.makedirs(os.path.dirname(_SHM_SO), exist_ok=True)
-            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                   _SHM_SRC, "-o", _SHM_SO]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True,
-                               timeout=120)
-            except (subprocess.SubprocessError, OSError) as e:
-                logger.warning("Native shm_ring build failed (%s); "
-                               "same-machine bulk stays on TCP", e)
-                return None
-        try:
-            lib = ctypes.CDLL(_SHM_SO)
-        except OSError as e:
-            logger.warning("Could not load %s: %s", _SHM_SO, e)
-            return None
-        lib.ring_init.restype = ctypes.c_int
-        lib.ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.ring_check.restype = ctypes.c_int64
-        lib.ring_check.argtypes = [ctypes.c_void_p]
-        lib.ring_free_space.restype = ctypes.c_int64
-        lib.ring_free_space.argtypes = [ctypes.c_void_p]
-        lib.ring_try_pushv.restype = ctypes.c_int
-        lib.ring_try_pushv.argtypes = [ctypes.c_void_p,
-                                       ctypes.POINTER(ctypes.c_void_p),
-                                       ctypes.POINTER(ctypes.c_uint64),
-                                       ctypes.c_uint64]
-        lib.ring_peek.restype = ctypes.c_int64
-        lib.ring_peek.argtypes = [ctypes.c_void_p]
-        lib.ring_pop.restype = ctypes.c_int64
-        lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                 ctypes.c_uint64]
-        lib.ring_wait_data.restype = ctypes.c_int
-        lib.ring_wait_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
-        lib.ring_wait_space.restype = ctypes.c_int
-        lib.ring_wait_space.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                        ctypes.c_uint32]
-        _shm_lib = lib
-        return _shm_lib
+    return _load_native("shm_ring", "shm_ring.cpp", "libshmring.so",
+                        _declare_shmring,
+                        fail_note="same-machine bulk stays on TCP")
 
 
-_SEGV_SRC = os.path.join(_REPO_ROOT, "native", "segv_tracker.cpp")
-_SEGV_SO = os.path.join(_REPO_ROOT, "native", "build", "libsegvtracker.so")
-
-_segv_lib: Optional[ctypes.CDLL] = None
-_segv_tried = False
+def _declare_tracker(prefix: str) -> Callable[[ctypes.CDLL], None]:
+    def declare(lib: ctypes.CDLL) -> None:
+        install = getattr(lib, f"{prefix}_install")
+        install.restype = ctypes.c_int
+        install.argtypes = []
+        start = getattr(lib, f"{prefix}_start")
+        start.restype = ctypes.c_int
+        start.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+        stop = getattr(lib, f"{prefix}_stop")
+        stop.restype = ctypes.c_int
+        stop.argtypes = [ctypes.c_int]
+    return declare
 
 
 def get_segv_lib() -> Optional[ctypes.CDLL]:
     """The SIGSEGV write-fault dirty tracker (native/segv_tracker.cpp) —
     O(dirty) page tracking with no baseline copy. None when g++ or the
     source is unavailable; callers fall back to comparison tracking."""
-    global _segv_lib, _segv_tried
-    with _lock:
-        if _segv_tried:
-            return _segv_lib
-        _segv_tried = True
-        if not os.path.exists(_SEGV_SRC):
-            return None
-        if not os.path.exists(_SEGV_SO) or (os.path.getmtime(_SEGV_SO)
-                                            < os.path.getmtime(_SEGV_SRC)):
-            os.makedirs(os.path.dirname(_SEGV_SO), exist_ok=True)
-            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                   _SEGV_SRC, "-o", _SEGV_SO]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True,
-                               timeout=120)
-            except (subprocess.SubprocessError, OSError) as e:
-                logger.warning("Native segv_tracker build failed (%s); "
-                               "segv dirty mode unavailable", e)
-                return None
-        try:
-            lib = ctypes.CDLL(_SEGV_SO)
-        except OSError as e:
-            logger.warning("Could not load %s: %s", _SEGV_SO, e)
-            return None
-        lib.segv_install.restype = ctypes.c_int
-        lib.segv_install.argtypes = []
-        lib.segv_start.restype = ctypes.c_int
-        lib.segv_start.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                   ctypes.c_void_p]
-        lib.segv_stop.restype = ctypes.c_int
-        lib.segv_stop.argtypes = [ctypes.c_int]
+    def install(lib: ctypes.CDLL) -> bool:
         if lib.segv_install() != 0:
             logger.warning("segv_tracker handler install failed")
-            return None
-        _segv_lib = lib
-        return _segv_lib
+            return False
+        return True
 
-
-_UFFD_SRC = os.path.join(_REPO_ROOT, "native", "uffd_tracker.cpp")
-_UFFD_SO = os.path.join(_REPO_ROOT, "native", "build", "libuffdtracker.so")
-
-_uffd_lib: Optional[ctypes.CDLL] = None
-_uffd_tried = False
+    return _load_native("segv_tracker", "segv_tracker.cpp",
+                        "libsegvtracker.so", _declare_tracker("segv"),
+                        install=install,
+                        fail_note="segv dirty mode unavailable")
 
 
 def get_uffd_lib() -> Optional[ctypes.CDLL]:
@@ -195,52 +155,24 @@ def get_uffd_lib() -> Optional[ctypes.CDLL]:
     are resolved by a dedicated event thread instead of a process-wide
     signal handler (the reference's uffd-thread-wp mode). None when the
     kernel lacks uffd-wp or the native build fails."""
-    global _uffd_lib, _uffd_tried
-    with _lock:
-        if _uffd_tried:
-            return _uffd_lib
-        _uffd_tried = True
-        if not os.path.exists(_UFFD_SRC):
-            return None
-        if not os.path.exists(_UFFD_SO) or (os.path.getmtime(_UFFD_SO)
-                                            < os.path.getmtime(_UFFD_SRC)):
-            os.makedirs(os.path.dirname(_UFFD_SO), exist_ok=True)
-            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                   _UFFD_SRC, "-o", _UFFD_SO, "-lpthread"]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True,
-                               timeout=120)
-            except (subprocess.SubprocessError, OSError) as e:
-                logger.warning("Native uffd_tracker build failed (%s); "
-                               "uffd dirty mode unavailable", e)
-                return None
-        try:
-            lib = ctypes.CDLL(_UFFD_SO)
-        except OSError as e:
-            logger.warning("Could not load %s: %s", _UFFD_SO, e)
-            return None
-        lib.uffd_install.restype = ctypes.c_int
-        lib.uffd_install.argtypes = []
-        lib.uffd_start.restype = ctypes.c_int
-        lib.uffd_start.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                   ctypes.c_void_p]
-        lib.uffd_stop.restype = ctypes.c_int
-        lib.uffd_stop.argtypes = [ctypes.c_int]
+    def install(lib: ctypes.CDLL) -> bool:
         rc = lib.uffd_install()
         if rc != 0:
             logger.info("userfaultfd write-protect unavailable (rc=%d); "
                         "DIRTY_TRACKING_MODE=uffd falls back", rc)
-            return None
-        _uffd_lib = lib
-        return _uffd_lib
+            return False
+        return True
+
+    return _load_native("uffd_tracker", "uffd_tracker.cpp",
+                        "libuffdtracker.so", _declare_tracker("uffd"),
+                        install=install, extra_args=("-lpthread",),
+                        fail_note="uffd dirty mode unavailable")
 
 
 def reset_for_tests() -> None:
-    global _lib, _tried, _shm_lib, _shm_tried
     with _lock:
-        _lib = None
-        _tried = False
-        _shm_lib = None
-        _shm_tried = False
-        # segv lib deliberately NOT reset: its SIGSEGV handler is
-        # process-wide state that must not be re-installed per test
+        # segv/uffd deliberately NOT reset: the SIGSEGV handler and the
+        # uffd event thread are process-wide state that must not be
+        # re-installed per test
+        _cache.pop("pagediff", None)
+        _cache.pop("shm_ring", None)

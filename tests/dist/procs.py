@@ -865,10 +865,13 @@ def run_plane_worker(host: str, n_procs: int) -> None:
         ok = ok and np.isfinite(loss)
 
         # Cross-process PIPELINE: a {dp:2, tp:2, pp:2} mesh whose pp=2
-        # stages live in DIFFERENT worker processes (device order is
-        # process-major, and pp is the mesh's last/fastest axis here, so
-        # at least one inter-stage ppermute hop crosses the process
-        # boundary inside the compiled 1F1B step)
+        # stages live in DIFFERENT worker processes. Default process-
+        # major device order would put both pp stages of every dp slice
+        # in ONE process (the mesh reshapes (dp, sp, pp, ep, tp), so pp
+        # stride is ep*tp=2 — pairs {0,2},{1,3},...). Interleave the two
+        # processes' devices so every pp partner pair spans the process
+        # boundary and the compiled 1F1B step's inter-stage ppermute
+        # truly crosses processes.
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
@@ -877,7 +880,16 @@ def run_plane_worker(host: str, n_procs: int) -> None:
             make_pp_train_step,
         )
 
-        pp_mesh = build_mesh(jax.devices(), MeshConfig(tp=2, pp=2))
+        ds = sorted(jax.devices(), key=lambda d: d.id)
+        pp_order = [ds[i] for i in (0, 1, 4, 5, 2, 3, 6, 7)]
+        pp_mesh = build_mesh(pp_order, MeshConfig(tp=2, pp=2))
+        # Every pp hop must cross the process boundary, or this test
+        # proves nothing beyond the dp allreduce above
+        pidx = np.vectorize(lambda d: d.process_index)(pp_mesh.devices)
+        pp_axis = pp_mesh.axis_names.index("pp")
+        stage0, stage1 = (pidx.take(0, axis=pp_axis).ravel(),
+                          pidx.take(1, axis=pp_axis).ravel())
+        ok = ok and bool((stage0 != stage1).all())
         pp_params, pp_opt = init_pp_train_state(
             jax.random.PRNGKey(0), cfg, pp_mesh)
         pp_step = make_pp_train_step(cfg, pp_mesh, n_microbatches=2,
