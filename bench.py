@@ -1406,6 +1406,16 @@ def main() -> None:
     ar = extras.get("host_allreduce") or {}
     if ar.get("effective_gibs"):
         summary["host_allreduce_gibs"] = round(ar["effective_gibs"], 2)
+    arp = extras.get("host_allreduce_procs") or {}
+    if arp.get("effective_gibs"):
+        summary["host_allreduce_procs_gibs"] = round(
+            arp["effective_gibs"], 2)
+    sr = extras.get("host_sendrecv_procs") or {}
+    if sr.get("rate_gibs"):
+        summary["host_sendrecv_gibs"] = round(sr["rate_gibs"], 2)
+    dc = extras.get("delta_codec") or {}
+    if dc.get("apply_reuse_ms") is not None:
+        summary["delta_apply_reuse_ms"] = round(dc["apply_reuse_ms"], 1)
     result = {
         "metric": "ptp_dispatch_p50_ms",
         "value": round(p50, 4) if p50 else None,
