@@ -102,13 +102,19 @@ class _LocalMpiPayload:
     ``shared`` marks fan-out buffers delivered to several receivers (a
     consumer must copy before exposing them writable)."""
 
-    __slots__ = ("msg_type", "data", "shared")
+    __slots__ = ("msg_type", "data", "shared", "owned")
 
     def __init__(self, msg_type: MpiMessageType, data: np.ndarray,
-                 shared: bool = False) -> None:
+                 shared: bool = False, owned: bool = False) -> None:
         self.msg_type = msg_type
         self.data = data
         self.shared = shared
+        # owned=True: the sender TRANSFERRED the buffer — the receiver
+        # may fold into it in place. This must ride the payload, not the
+        # numpy writeable flag: flags live on the shared array object
+        # and a sender restoring its view's writability would race the
+        # receiver's flags-based ownership check
+        self.owned = owned
 
     def to_bytes(self) -> bytes:
         """Late wire conversion if routing sends this remote after all
@@ -258,7 +264,8 @@ class MpiWorld:
             if not _transfer:
                 arr.flags.writeable = False
             payload = _LocalMpiPayload(msg_type, arr,
-                                       shared=not _copy and not _transfer)
+                                       shared=not _copy and not _transfer,
+                                       owned=_transfer)
         else:
             # Lazy wire form: the bulk plane sends header + array buffer
             # straight from this rank's memory, no concatenation copy
@@ -271,16 +278,31 @@ class MpiWorld:
                   ) -> tuple[np.ndarray, MpiStatus]:
         """Internal receive: the array may be read-only / shared (zero-copy
         local path). Collectives use this — they never mutate received
-        buffers in place."""
+        buffers in place unless the sender transferred ownership (see
+        _recv_raw_owned)."""
+        arr, status, _ = self._recv_raw_owned(send_rank, recv_rank,
+                                              timeout=timeout)
+        return arr, status
+
+    def _recv_raw_owned(self, send_rank: int, recv_rank: int,
+                        timeout: float | None = None
+                        ) -> tuple[np.ndarray, MpiStatus, bool]:
+        """Internal receive + ownership bit: True iff the sender
+        TRANSFERRED the buffer (ring fold path), so the receiver may
+        mutate it in place."""
         raw = self.broker.recv_message(self.group_id, send_rank, recv_rank,
                                        must_order=True, timeout=timeout)
         if isinstance(raw, _LocalMpiPayload):
             arr = raw.data
+            owned = raw.owned
         else:
             _, arr, _req = unpack_mpi_payload(raw)
+            # Wire arrays are exclusively ours but frombuffer-read-only;
+            # writable ones (bytearray-backed) may be folded in place
+            owned = arr.flags.writeable
         status = MpiStatus(source=send_rank, count=arr.size,
                            dtype=int(mpi_dtype_for(arr.dtype)))
-        return arr, status
+        return arr, status, owned
 
     def recv(self, send_rank: int, recv_rank: int,
              timeout: float | None = None) -> tuple[np.ndarray, MpiStatus]:
@@ -832,33 +854,9 @@ class MpiWorld:
         Requires an associative+commutative op, which MPI mandates."""
         flat = data.reshape(-1)
         n = self.size
-        seg = [((i * flat.size) // n, ((i + 1) * flat.size) // n)
-               for i in range(n)]
+        seg = self._ring_segments(flat.size)
         nxt, prv = (rank + 1) % n, (rank - 1) % n
-
-        lo, hi = seg[rank]
-        first = flat[lo:hi]
-        first.flags.writeable = False
-        self.send(rank, nxt, first, MpiMessageType.REDUCE, _copy=False)
-        held = None
-        for step in range(n - 1):
-            arr, _ = self._recv_raw(prv, rank)
-            lo, hi = seg[(rank - step - 1) % n]
-            mine = flat[lo:hi]
-            if arr.flags.writeable and arr.dtype == mine.dtype:
-                folded = apply_op_inplace(op, arr, mine)
-            else:  # read-only step-0 view (or dtype-promoting op):
-                # non-inplace apply allocates + folds in ONE pass
-                folded = apply_op(op, arr, mine)
-            folded = np.asarray(folded)
-            if step < n - 2:
-                # Ownership transfer: the receiver folds into this buffer
-                # in place; we drop our reference here
-                self.send(rank, nxt, folded, MpiMessageType.REDUCE,
-                          _transfer=True)
-                del folded
-            else:
-                held = folded  # fully reduced segment (rank+1) % n
+        held, restore = self._ring_reduce_scatter(rank, data, op)
         # Allgather: circulate the complete segments by reference
         parts: dict[int, np.ndarray] = {(rank + 1) % n: held}
         for step in range(n - 1):
@@ -873,8 +871,62 @@ class MpiWorld:
         for i in range(n):
             lo, hi = seg[i]
             out[lo:hi] = parts[i]
-        first.flags.writeable = True  # restore the caller's buffer
+        # Our last allgather recv causally implies nxt completed its
+        # whole fold phase (chain length n-1), i.e. consumed our step-0
+        # view — only now may the caller's buffer go writable again
+        restore()
         return out.reshape(data.shape)
+
+    def _ring_segments(self, n_elems: int) -> list[tuple[int, int]]:
+        n = self.size
+        return [((i * n_elems) // n, ((i + 1) * n_elems) // n)
+                for i in range(n)]
+
+    def _ring_reduce_scatter(self, rank: int, data: np.ndarray,
+                             op: MpiOp):
+        """The ring's fold phase: np-1 steps, each rank folding 1/np of
+        the data into the partial it receives (ownership rides the
+        payload — folding based on the numpy writeable FLAG would race
+        the sender restoring its step-0 view's writability). Returns
+        (fully reduced segment (rank+1) % np, restore_fn): the CALLER
+        must run restore_fn only after its trailing ring phase — one
+        more full circulation — guarantees every neighbour consumed the
+        step-0 view of this rank's buffer."""
+        flat = data.reshape(-1)
+        n = self.size
+        seg = self._ring_segments(flat.size)
+        nxt, prv = (rank + 1) % n, (rank - 1) % n
+
+        lo, hi = seg[rank]
+        first = flat[lo:hi]
+        was_writeable = first.flags.writeable
+        first.flags.writeable = False
+        self.send(rank, nxt, first, MpiMessageType.REDUCE, _copy=False)
+        held = None
+        for step in range(n - 1):
+            arr, _, owned = self._recv_raw_owned(prv, rank)
+            lo, hi = seg[(rank - step - 1) % n]
+            mine = flat[lo:hi]
+            if owned and arr.flags.writeable and arr.dtype == mine.dtype:
+                folded = apply_op_inplace(op, arr, mine)
+            else:  # step-0 shared view (or dtype-promoting op):
+                # non-inplace apply allocates + folds in ONE pass
+                folded = apply_op(op, arr, mine)
+            folded = np.asarray(folded)
+            if step < n - 2:
+                # Ownership transfer: the receiver folds into this buffer
+                # in place; we drop our reference here
+                self.send(rank, nxt, folded, MpiMessageType.REDUCE,
+                          _transfer=True)
+                del folded
+            else:
+                held = folded  # fully reduced segment (rank+1) % n
+
+        def restore():
+            if was_writeable:
+                first.flags.writeable = True
+
+        return held, restore
 
     def scatter(self, send_rank: int, recv_rank: int, data: np.ndarray,
                 recv_count: int) -> np.ndarray:
@@ -1012,25 +1064,80 @@ class MpiWorld:
                        op: MpiOp = MpiOp.SUM) -> np.ndarray:
         """MPI_Reduce_scatter_block: reduce (size·k,) contributions, rank
         r keeps segment r (reference composes it the same way: reduce to
-        root + scatter)."""
+        root + scatter). Large same-machine payloads take the ring's
+        reduce-scatter phase directly — every rank folds 1/np per step
+        and the root never materialises the full reduction."""
         data = np.asarray(data).reshape(-1)
         if data.size % self.size:
             raise ValueError(
                 f"reduce_scatter needs size divisible by {self.size}")
         k = data.size // self.size
+        if (self.size > 1 and self._all_hosts_same_machine()
+                and data.nbytes >= self.CHUNK_BYTES * 2
+                and (not isinstance(op, UserOp) or op.commute)):
+            held, restore = self._ring_reduce_scatter(rank, data, op)
+            # The ring leaves rank holding segment (rank+1) — which
+            # belongs to rank+1; rotate one hop forward so every rank
+            # ends with ITS OWN segment (rank-1 holds ours). Ownership
+            # transfers with the rotation: the receiver returns the
+            # buffer to its caller outright
+            self.send(rank, (rank + 1) % self.size, np.asarray(held),
+                      MpiMessageType.REDUCE, _transfer=True)
+            del held
+            arr, _, owned = self._recv_raw_owned((rank - 1) % self.size,
+                                                 rank)
+            # The rotation recv extends the causal chain to length n,
+            # so nxt has consumed our step-0 view: safe to restore
+            restore()
+            return arr if owned and arr.flags.writeable else arr.copy()
         reduced = self.reduce(rank, MAIN_RANK, data, op)
         return self.scatter(MAIN_RANK, rank,
                             reduced if rank == MAIN_RANK else np.empty(0), k)
 
     def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
+        # Large same-machine payloads: ring allgather — contributions
+        # circulate as read-only references through the in-process
+        # queues (n-1 steps, one final assembly copy per rank) instead
+        # of funnelling through rank 0 twice.
+        data = np.asarray(data)
+        if (self.size > 1 and data.nbytes >= self.CHUNK_BYTES
+                and self._all_hosts_same_machine()):
+            return self._allgather_ring(rank, data)
         # gather(0) + broadcast (reference :1082-1111). The broadcast
         # stream is self-describing (CHUNK_HEADER), so non-roots need no
         # sized template — they follow the root's framing.
-        data = np.asarray(data)
         gathered = self.gather(rank, MAIN_RANK, data)
         template = (gathered if rank == MAIN_RANK
                     else np.empty(0, dtype=data.dtype))
         return self.broadcast(MAIN_RANK, rank, template)
+
+    def _allgather_ring(self, rank: int, data: np.ndarray) -> np.ndarray:
+        """Ring allgather: rank r's contribution is segment r; n-1 steps
+        pass segment references around the ring. The contribution rides
+        as ONE private read-only copy (other ranks keep the reference
+        through their assembly even after this rank returns, so a view
+        of the caller's buffer — which MPI lets the caller reuse
+        immediately — would be a torn-read hazard)."""
+        flat = data.reshape(-1)
+        n = self.size
+        nxt, prv = (rank + 1) % n, (rank - 1) % n
+        shared = flat.copy()
+        shared.flags.writeable = False
+        parts: dict[int, np.ndarray] = {rank: shared}
+        for step in range(n - 1):
+            send_seg = (rank - step) % n
+            part = parts[send_seg]
+            if part.flags.writeable:
+                part.flags.writeable = False
+            self.send(rank, nxt, part, MpiMessageType.ALLGATHER,
+                      _copy=False)
+            arr, _ = self._recv_raw(prv, rank)
+            parts[(rank - step - 1) % n] = arr
+        out = np.empty(n * flat.size, dtype=flat.dtype)
+        k = flat.size
+        for i in range(n):
+            out[i * k:(i + 1) * k] = parts[i]
+        return out
 
     def scan(self, rank: int, data: np.ndarray,
              op: MpiOp = MpiOp.SUM) -> np.ndarray:

@@ -217,6 +217,52 @@ def test_allreduce_ring_single_host(op, npop, world_size, monkeypatch):
     broker.clear()
 
 
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_reduce_scatter_and_allgather_ring(world_size, monkeypatch):
+    """Large same-machine reduce_scatter/allgather take the ring paths
+    (fold phase + rotation; reference-circulating gather) — results must
+    match numpy and the callers' buffers must survive writable."""
+    monkeypatch.setattr(MpiWorld, "CHUNK_BYTES", 64)
+    monkeypatch.setattr(MpiWorld, "CHUNK_BYTES_LOCAL", 64)
+    broker = PointToPointBroker("ringhost2")
+    decision = SchedulingDecision(app_id=78, group_id=78)
+    for rank in range(world_size):
+        decision.add_message("ringhost2", 3100 + rank, rank, rank)
+    broker.set_up_local_mappings_from_decision(decision)
+    world = MpiWorld(broker, 78, world_size, 78)
+
+    k = 97  # per-rank segment length
+    datas = {r: per_rank_data(r, world_size * k) for r in range(world_size)}
+    orig = {r: datas[r].copy() for r in range(world_size)}
+    total = sum(datas.values())
+
+    def rs_fn(world_, rank):
+        return world_.reduce_scatter(rank, datas[rank], MpiOp.SUM)
+
+    results = run_ranks(lambda r: world, rs_fn, n=world_size)
+    for rank in range(world_size):
+        np.testing.assert_allclose(results[rank],
+                                   total[rank * k:(rank + 1) * k],
+                                   rtol=1e-12)
+        np.testing.assert_array_equal(datas[rank], orig[rank])
+        assert datas[rank].flags.writeable
+        assert results[rank].flags.writeable  # caller owns its output
+
+    ag_datas = {r: per_rank_data(100 + r, k) for r in range(world_size)}
+    expected = np.concatenate([ag_datas[r] for r in range(world_size)])
+
+    def ag_fn(world_, rank):
+        return world_.allgather(rank, ag_datas[rank])
+
+    results = run_ranks(lambda r: world, ag_fn, n=world_size)
+    for rank in range(world_size):
+        np.testing.assert_allclose(results[rank], expected, rtol=1e-12)
+        assert results[rank].flags.writeable
+        # MPI contract: the send buffer is immediately reusable
+        ag_datas[rank][:] = -1
+    broker.clear()
+
+
 def test_reduce_to_nonzero_root(mpi_cluster):
     expected = sum(per_rank_data(r) for r in range(6))
 
