@@ -189,6 +189,33 @@ def bench_host_allreduce(n_ranks: int = 4, elems: int = 25_500_000,
     payload_bytes = elems * 4
     effective = 4 * (n_ranks - 1) * payload_bytes * rounds
     gibs = effective / elapsed / (1 << 30)
+
+    # Ring-backed cousins on the same world: reduce_scatter (fold phase
+    # + rotation) and allgather (reference circulation), reported with
+    # the same effective-bytes convention (bytes the wire would carry:
+    # (np-1)/np · N per rank each way)
+    extras = {}
+    for name, fn, elems_total in (
+            ("reduce_scatter",
+             lambda r: world.reduce_scatter(r, datas[r], MpiOp.SUM),
+             elems),
+            ("allgather",
+             lambda r: world.allgather(r, datas[r][:elems // n_ranks]),
+             elems)):
+        def loop(rank, fn=fn):
+            for _ in range(rounds):
+                fn(rank)
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=loop, args=(r,))
+              for r in range(n_ranks)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        el = time.perf_counter() - t0
+        moved = 2 * (n_ranks - 1) * (elems_total // n_ranks) * 4 \
+            * n_ranks * rounds
+        extras[f"{name}_gibs"] = round(moved / el / (1 << 30), 2)
     broker.clear()
 
     # Same-box floor: the allreduce's own data movement (root copy +
@@ -210,7 +237,8 @@ def bench_host_allreduce(n_ranks: int = 4, elems: int = 25_500_000,
     return {"effective_gibs": gibs, "np": n_ranks,
             "payload_mib": payload_bytes / (1 << 20), "rounds": rounds,
             "seq_floor_gibs": floor_gibs,
-            "pct_of_floor": round(100 * gibs / floor_gibs, 1)}
+            "pct_of_floor": round(100 * gibs / floor_gibs, 1),
+            **extras}
 
 
 def _mpi_sum():
