@@ -39,6 +39,17 @@ class SchedulingDecision:
     def is_single_host(self) -> bool:
         return len(set(self.hosts)) <= 1
 
+    def clone(self) -> "SchedulingDecision":
+        """Independent snapshot of the placement vectors. The planner
+        keeps mutating ITS copy as results land (remove_message), so
+        anything handed to a caller must be detached first."""
+        return SchedulingDecision(
+            app_id=self.app_id, group_id=self.group_id,
+            hosts=list(self.hosts), message_ids=list(self.message_ids),
+            app_idxs=list(self.app_idxs), group_idxs=list(self.group_idxs),
+            mpi_ports=list(self.mpi_ports),
+            device_ids=list(self.device_ids))
+
     def unique_hosts(self) -> list[str]:
         seen: dict[str, None] = {}
         for h in self.hosts:

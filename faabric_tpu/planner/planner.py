@@ -375,6 +375,12 @@ class Planner:
                 decision, mappings, dispatches = self._handle_dist_change(
                     req, decision)
 
+        # The caller gets a SNAPSHOT taken before dispatch: the in-flight
+        # decision object keeps mutating as results land (fast tasks can
+        # complete — and remove_message their rows — before the RPC layer
+        # even serializes the response)
+        result = decision.clone()
+
         # Network I/O strictly outside the lock: mappings first (guest code
         # blocks on wait_for_mappings before messaging), then dispatch.
         with self._lock:
@@ -383,7 +389,7 @@ class Planner:
                 gids | {mappings.group_id}, hosts | set(mappings.hosts))
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
-        return decision
+        return result
 
     # -- decision handling (all run under self._lock; they return the
     # mapping distribution + dispatches to perform after the lock drops) --
@@ -864,7 +870,8 @@ class Planner:
     def get_scheduling_decision(self, app_id: int) -> Optional[SchedulingDecision]:
         with self._lock:
             in_flight = self._in_flight.get(app_id)
-            return in_flight[1] if in_flight else None
+            # Snapshot: the live decision mutates as results land
+            return in_flight[1].clone() if in_flight else None
 
     # ------------------------------------------------------------------
     # State master registry

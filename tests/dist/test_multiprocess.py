@@ -19,15 +19,23 @@ PROCS = os.path.join(os.path.dirname(__file__), "procs.py")
 ALIASES = "w1=127.0.0.1+10000,w2=127.0.0.1+13000,cli=127.0.0.1+16000"
 
 
-def drain_stdout(p):
-    """Discard a child's further output on a daemon thread: a full 64 KB
-    pipe would block the child mid-log and wedge the cluster."""
+def drain_stdout(p, tee_path=None):
+    """Discard (or tee to a file) a child's further output on a daemon
+    thread: a full 64 KB pipe would block the child mid-log and wedge
+    the cluster."""
     import threading
 
     def _loop():
         try:
-            for _ in p.stdout:
-                pass
+            sink = open(tee_path, "w") if tee_path else None
+            try:
+                for line in p.stdout:
+                    if sink is not None:
+                        sink.write(line)
+                        sink.flush()
+            finally:
+                if sink is not None:
+                    sink.close()
         except Exception:  # noqa: BLE001 — the pipe died with the child
             pass
 
@@ -431,7 +439,8 @@ def test_dist_worker_crash_fail_dispatch_and_expiry():
             m.input_data = str(i + 1).encode()
         decision = me.planner_client.call_functions(req)
         assert sorted(set(decision.hosts)) == ["w5", "w6"], (
-            decision.hosts, me.planner_client.get_available_hosts())
+            decision.hosts, [m.id for m in req.messages], decision.app_id,
+            req.app_id, me.planner_client.get_available_hosts())
         status = wait_batch_finished(me, req.app_id, timeout=30)
         assert all(m.return_value == int(ReturnValue.SUCCESS)
                    for m in status.message_results)
