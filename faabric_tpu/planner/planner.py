@@ -375,15 +375,17 @@ class Planner:
                 decision, mappings, dispatches = self._handle_dist_change(
                     req, decision)
 
-        # The caller gets a SNAPSHOT taken before dispatch: the in-flight
-        # decision object keeps mutating as results land (fast tasks can
-        # complete — and remove_message their rows — before the RPC layer
-        # even serializes the response)
-        result = decision.clone()
-
         # Network I/O strictly outside the lock: mappings first (guest code
         # blocks on wait_for_mappings before messaging), then dispatch.
         with self._lock:
+            # Snapshot the decision (and the mappings, which for scale/
+            # dist changes IS the live in-flight decision) under the
+            # lock: results landing on other threads remove_message rows
+            # concurrently — fast tasks can complete before the RPC
+            # layer even serializes the response, and a clone taken
+            # outside the lock could tear mid-copy
+            result = decision.clone()
+            mappings = mappings.clone()
             gids, hosts = self._group_hosts.get(req.app_id, (set(), set()))
             self._group_hosts[req.app_id] = (
                 gids | {mappings.group_id}, hosts | set(mappings.hosts))

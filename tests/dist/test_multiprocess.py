@@ -456,8 +456,12 @@ def test_dist_worker_crash_fail_dispatch_and_expiry():
         d2 = me.planner_client.call_functions(req2)
         # Under heavy load the planner may already have expired w6 by
         # now (keep-alive TTL elapsed between kill and call); the
-        # stranded-messages scenario needs w6 still placed
+        # stranded-messages scenario needs w6 still placed. Skip LOUDLY
+        # rather than silently passing with the core path untested.
         stranded = "w6" in d2.hosts
+        if not stranded:
+            pytest.skip("w6 expired before the batch placed on it "
+                        f"(slow machine); d2.hosts={d2.hosts}")
 
         # The dead host expires off the registry at the keep-alive TTL
         # (polling get_available_hosts drives the lazy expiry)
