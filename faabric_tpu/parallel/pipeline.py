@@ -110,20 +110,34 @@ def unstack_block_params(pp_params: dict) -> dict:
 
 def pp_param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
     """Layer axis over ``pp``; heads/hidden over ``tp``; embed/ln_f/
-    lm_head replicated (small next to the blocks)."""
+    lm_head replicated (small next to the blocks). MoE configs add the
+    expert axis: router replicated, expert slabs over ``ep`` with each
+    expert's hidden over ``tp``."""
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    return {
-        "embed": ns(),
-        "stacked": {
+    if getattr(cfg, "n_experts", 0) > 0:
+        stacked = {
+            "ln1": ns("pp", None),
+            "wqkv": ns("pp", None, None, "tp", None),
+            "wo": ns("pp", "tp", None, None),
+            "ln2": ns("pp", None),
+            "router": ns("pp", None, None),
+            "w1": ns("pp", "ep", None, "tp"),
+            "w2": ns("pp", "ep", "tp", None),
+        }
+    else:
+        stacked = {
             "ln1": ns("pp", None),
             "wqkv": ns("pp", None, None, "tp", None),
             "wo": ns("pp", "tp", None, None),
             "ln2": ns("pp", None),
             "w1": ns("pp", None, "tp"),
             "w2": ns("pp", "tp", None),
-        },
+        }
+    return {
+        "embed": ns(),
+        "stacked": stacked,
         "ln_f": ns(),
         "lm_head": ns(),
     }
@@ -155,9 +169,17 @@ def _validate_pp_mesh(cfg: ModelConfig, mesh: Mesh) -> int:
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
-    if mesh.shape.get("sp", 1) > 1 or mesh.shape.get("ep", 1) > 1:
-        raise ValueError("pipeline path supports dp×tp×pp meshes "
-                         "(sp/ep must be 1)")
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("pipeline path supports dp×tp×pp(×ep) meshes "
+                         "(sp must be 1)")
+    ep = mesh.shape.get("ep", 1)
+    if ep > 1:
+        n_experts = getattr(cfg, "n_experts", 0)
+        if not n_experts:
+            raise ValueError("ep>1 needs a MoE config (n_experts)")
+        if n_experts % ep:
+            raise ValueError(
+                f"n_experts={n_experts} not divisible by ep={ep}")
     return n_stages
 
 
@@ -191,6 +213,90 @@ def _pp_block(x, blk, positions, cfg: ModelConfig):
 # The pipelined loss
 # ---------------------------------------------------------------------------
 
+def _is_moe(cfg: ModelConfig) -> bool:
+    return getattr(cfg, "n_experts", 0) > 0
+
+
+def _pp_moe_ffn(h, blk, cfg):
+    """Switch-MoE feed-forward on (tp, ep)-local shards: the routing +
+    capacity math is replicated (every member computes the same
+    dispatch/combine from the same activations, exactly the global
+    formulation in models/moe.py:_moe_layer), the expert FFN runs only
+    this member's experts (ep-local slab, tp-sharded hidden), and two
+    psums reassemble: tp for the row-parallel expert matmul, ep to sum
+    each member's contribution for its own experts' tokens. The switch
+    aux load-balancing loss is NOT computed on the pipeline path (the
+    head-anchored schedules carry one scalar loss; capacity dispatch
+    still bounds imbalance) — train with aux via the single-mesh MoE
+    step, or accept aux_loss_weight=0 semantics under pp."""
+    from faabric_tpu.models.moe import _capacity
+
+    b, s, d = h.shape
+    e = cfg.n_experts
+    k = cfg.router_top_k
+    c = _capacity(cfg, s)
+
+    h32 = h.astype(jnp.float32)
+    logits = h32 @ blk["router"].astype(jnp.float32)       # (B, S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)
+    if k == 1:
+        gates = topk_probs
+    else:
+        gates = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+    # Slot-major capacity allocation — models/moe.py:_moe_layer verbatim
+    oh = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)
+    oh_flat = oh.transpose(0, 2, 1, 3).reshape(b, k * s, e)
+    pos_flat = ((jnp.cumsum(oh_flat, axis=1) - 1.0) * oh_flat).sum(axis=-1)
+    keep = (pos_flat < c).astype(jnp.float32)
+    disp_flat = (oh_flat * keep[..., None])[..., None] \
+        * jax.nn.one_hot(pos_flat.astype(jnp.int32), c,
+                         dtype=jnp.float32)[:, :, None, :]
+    disp = disp_flat.reshape(b, k, s, e, c)
+    dispatch = disp.sum(axis=1)                            # (B, S, E, C)
+    combine_w = (disp
+                 * gates.transpose(0, 2, 1)[..., None, None]).sum(axis=1)
+
+    # This member's expert slab
+    ep_size = jax.lax.psum(1, "ep")
+    e_loc = e // ep_size
+    lo = jax.lax.axis_index("ep") * e_loc
+    disp_loc = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_loc, axis=2)
+    comb_loc = jax.lax.dynamic_slice_in_dim(combine_w, lo, e_loc, axis=2)
+
+    expert_in = jnp.einsum("bsec,bsd->ebcd", disp_loc, h32)
+    w1 = blk["w1"].astype(jnp.float32)                     # (E_loc, D, F_tp)
+    w2 = blk["w2"].astype(jnp.float32)                     # (E_loc, F_tp, D)
+    mid = jax.nn.gelu(jnp.einsum("ebcd,edf->ebcf", expert_in, w1))
+    out_e = jax.lax.psum(jnp.einsum("ebcf,efd->ebcd", mid, w2), "tp")
+    out = jnp.einsum("bsec,ebcd->bsd", comb_loc, out_e)
+    return jax.lax.psum(out, "ep").astype(h.dtype)
+
+
+def _pp_moe_block(x, blk, positions, cfg):
+    """MoE transformer block on (tp, ep)-local shards: the attention
+    sublayer is _pp_block's Megatron pattern; the FFN is the ep-local
+    switch-MoE above."""
+    h = _rms_norm(x, blk["ln1"])
+    qkv = jnp.einsum("bsd,dthe->tbshe", h,
+                     blk["wqkv"].astype(cfg.compute_dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v)
+    attn_out = jnp.einsum("bshe,hed->bsd", attn,
+                          blk["wo"].astype(cfg.compute_dtype))
+    x = x + jax.lax.psum(attn_out, "tp")
+
+    h = _rms_norm(x, blk["ln2"])
+    return x + _pp_moe_ffn(h, blk, cfg)
+
+
+def _block_fn(cfg: ModelConfig):
+    return _pp_moe_block if _is_moe(cfg) else _pp_block
+
+
 def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
                          cfg: ModelConfig, n_stages: int):
     """Per-device body (under shard_map over dp/tp/pp). tokens_mb/
@@ -207,7 +313,7 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
     def stage_fn(x):
         """Run my slab of layers (scan over the local layer axis)."""
         def body(h, blk):
-            return _pp_block(h, blk, positions, cfg), None
+            return _block_fn(cfg)(h, blk, positions, cfg), None
 
         if cfg.remat:
             body = jax.checkpoint(body)
@@ -324,7 +430,7 @@ def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
 
     def stage_fn(slab, x):
         def body(h, blk):
-            return _pp_block(h, blk, positions, cfg), None
+            return _block_fn(cfg)(h, blk, positions, cfg), None
 
         if cfg.remat:
             body = jax.checkpoint(body)
@@ -512,7 +618,13 @@ def init_pp_train_state(key: jax.Array, cfg: ModelConfig, mesh: Mesh,
     from faabric_tpu.models.transformer import init_params
 
     optimizer = optimizer or make_optimizer()
-    pp_params = stack_block_params(init_params(key, cfg))
+    if _is_moe(cfg):
+        from faabric_tpu.models.moe import init_moe_params
+
+        raw = init_moe_params(key, cfg)
+    else:
+        raw = init_params(key, cfg)
+    pp_params = stack_block_params(raw)
     pp_params = jax.device_put(pp_params, pp_param_shardings(mesh, cfg))
     opt_state = optimizer.init(pp_params)
     return pp_params, opt_state
