@@ -146,10 +146,12 @@ class Planner:
     def register_host(self, ip: str, slots: int, n_devices: int = 0,
                       overwrite: bool = False) -> float:
         conf = get_system_config()
+        fresh = False
         with self._lock:
             existing = self._hosts.get(ip)
             if existing is None or overwrite:
                 self._hosts[ip] = PlannerHost(ip, slots, n_devices)
+                fresh = existing is not None
                 logger.debug("Planner registered host %s (slots=%d chips=%d)",
                              ip, slots, n_devices)
             else:
@@ -159,6 +161,13 @@ class Planner:
                 if n_devices != len(existing.device_load):
                     existing.device_load = [0] * max(0, n_devices)
                     existing.state.n_devices = n_devices
+        if fresh:
+            # A RE-registration with overwrite is a worker process boot:
+            # any pooled connection to the previous incarnation is dead,
+            # and an async dispatch onto it can strand silently while
+            # the new worker's keep-alives keep the host looking healthy
+            self._clients.drop(ip)
+            self._snapshot_clients.drop(ip)
         return conf.planner_host_timeout
 
     def remove_host(self, ip: str) -> None:
