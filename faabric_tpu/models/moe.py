@@ -103,16 +103,20 @@ def _capacity(cfg: MoEConfig, seq: int) -> int:
         seq * cfg.router_top_k * cfg.capacity_factor / cfg.n_experts)))
 
 
-def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
-               mesh: Optional[Mesh]) -> tuple[jax.Array, jax.Array]:
-    """x (B, S, D) → (out, aux_loss)."""
+def moe_dispatch_combine(x: jax.Array, router: jax.Array, cfg: MoEConfig
+                         ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Routing + slot-major capacity allocation shared by the single-mesh
+    layer below and the pipeline's ep-local path
+    (parallel/pipeline.py:_pp_moe_ffn): x (B, S, D) →
+    (dispatch (B, S, E, C), combine_w (B, S, E, C), aux scalar).
+    Pure jnp — identical results wherever it runs, which is what keeps
+    the two paths loss-parity-exact."""
     b, s, d = x.shape
     e = cfg.n_experts
     k = cfg.router_top_k
     c = _capacity(cfg, s)
 
-    logits = (x.astype(jnp.float32)
-              @ blk["router"].astype(jnp.float32))  # (B, S, E)
+    logits = x.astype(jnp.float32) @ router.astype(jnp.float32)  # (B, S, E)
     probs = jax.nn.softmax(logits, axis=-1)
     topk_probs, topk_idx = jax.lax.top_k(probs, k)   # (B, S, K)
     if k == 1:
@@ -140,6 +144,13 @@ def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
     dispatch = disp.sum(axis=1)                              # (B, S, E, C)
     combine_w = (disp
                  * gates.transpose(0, 2, 1)[..., None, None]).sum(axis=1)
+    return dispatch, combine_w, aux
+
+
+def _moe_layer(x: jax.Array, blk: dict, cfg: MoEConfig,
+               mesh: Optional[Mesh]) -> tuple[jax.Array, jax.Array]:
+    """x (B, S, D) → (out, aux_loss)."""
+    dispatch, combine_w, aux = moe_dispatch_combine(x, blk["router"], cfg)
 
     def constrain(arr, *spec):
         if mesh is not None:

@@ -189,9 +189,9 @@ def _pp_specs(cfg: ModelConfig, mesh: Mesh):
     return param_specs, P(None, "dp", None)
 
 
-def _pp_block(x, blk, positions, cfg: ModelConfig):
-    """One transformer block on tp-local shards: qkv/w1 column-parallel,
-    wo/w2 row-parallel with a psum over ``tp`` after each."""
+def _pp_attention_sublayer(x, blk, positions, cfg: ModelConfig):
+    """Megatron attention on tp-local shards (qkv column-parallel, wo
+    row-parallel + psum) — shared by the dense and MoE pp blocks."""
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dthe->tbshe", h,
                      blk["wqkv"].astype(cfg.compute_dtype))
@@ -201,8 +201,13 @@ def _pp_block(x, blk, positions, cfg: ModelConfig):
     attn = _attention(q, k, v)
     attn_out = jnp.einsum("bshe,hed->bsd", attn,
                           blk["wo"].astype(cfg.compute_dtype))
-    x = x + jax.lax.psum(attn_out, "tp")
+    return x + jax.lax.psum(attn_out, "tp")
 
+
+def _pp_block(x, blk, positions, cfg: ModelConfig):
+    """One transformer block on tp-local shards: qkv/w1 column-parallel,
+    wo/w2 row-parallel with a psum over ``tp`` after each."""
+    x = _pp_attention_sublayer(x, blk, positions, cfg)
     h = _rms_norm(x, blk["ln2"])
     ff = jax.nn.gelu(h @ blk["w1"].astype(cfg.compute_dtype))
     ff_out = ff @ blk["w2"].astype(cfg.compute_dtype)
@@ -229,34 +234,15 @@ def _pp_moe_ffn(h, blk, cfg):
     head-anchored schedules carry one scalar loss; capacity dispatch
     still bounds imbalance) — train with aux via the single-mesh MoE
     step, or accept aux_loss_weight=0 semantics under pp."""
-    from faabric_tpu.models.moe import _capacity
+    from faabric_tpu.models.moe import moe_dispatch_combine
 
-    b, s, d = h.shape
     e = cfg.n_experts
-    k = cfg.router_top_k
-    c = _capacity(cfg, s)
-
     h32 = h.astype(jnp.float32)
-    logits = h32 @ blk["router"].astype(jnp.float32)       # (B, S, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    topk_probs, topk_idx = jax.lax.top_k(probs, k)
-    if k == 1:
-        gates = topk_probs
-    else:
-        gates = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
-
-    # Slot-major capacity allocation — models/moe.py:_moe_layer verbatim
-    oh = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)
-    oh_flat = oh.transpose(0, 2, 1, 3).reshape(b, k * s, e)
-    pos_flat = ((jnp.cumsum(oh_flat, axis=1) - 1.0) * oh_flat).sum(axis=-1)
-    keep = (pos_flat < c).astype(jnp.float32)
-    disp_flat = (oh_flat * keep[..., None])[..., None] \
-        * jax.nn.one_hot(pos_flat.astype(jnp.int32), c,
-                         dtype=jnp.float32)[:, :, None, :]
-    disp = disp_flat.reshape(b, k, s, e, c)
-    dispatch = disp.sum(axis=1)                            # (B, S, E, C)
-    combine_w = (disp
-                 * gates.transpose(0, 2, 1)[..., None, None]).sum(axis=1)
+    # Routing + capacity allocation: the SHARED pure-jnp definition from
+    # models/moe.py — one implementation is what keeps this path
+    # loss-parity-exact with the single-mesh layer (aux is discarded
+    # here; see docstring)
+    dispatch, combine_w, _aux = moe_dispatch_combine(h, blk["router"], cfg)
 
     # This member's expert slab
     ep_size = jax.lax.psum(1, "ep")
@@ -275,20 +261,9 @@ def _pp_moe_ffn(h, blk, cfg):
 
 
 def _pp_moe_block(x, blk, positions, cfg):
-    """MoE transformer block on (tp, ep)-local shards: the attention
-    sublayer is _pp_block's Megatron pattern; the FFN is the ep-local
-    switch-MoE above."""
-    h = _rms_norm(x, blk["ln1"])
-    qkv = jnp.einsum("bsd,dthe->tbshe", h,
-                     blk["wqkv"].astype(cfg.compute_dtype))
-    q, k, v = qkv[0], qkv[1], qkv[2]
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v)
-    attn_out = jnp.einsum("bshe,hed->bsd", attn,
-                          blk["wo"].astype(cfg.compute_dtype))
-    x = x + jax.lax.psum(attn_out, "tp")
-
+    """MoE transformer block on (tp, ep)-local shards: the shared
+    Megatron attention sublayer + the ep-local switch-MoE FFN above."""
+    x = _pp_attention_sublayer(x, blk, positions, cfg)
     h = _rms_norm(x, blk["ln2"])
     return x + _pp_moe_ffn(h, blk, cfg)
 

@@ -151,7 +151,10 @@ class Planner:
             existing = self._hosts.get(ip)
             if existing is None or overwrite:
                 self._hosts[ip] = PlannerHost(ip, slots, n_devices)
-                fresh = existing is not None
+                # Every overwrite registration is a worker BOOT — even if
+                # the previous entry already expired off the registry,
+                # a pooled connection to the dead incarnation may remain
+                fresh = overwrite
                 logger.debug("Planner registered host %s (slots=%d chips=%d)",
                              ip, slots, n_devices)
             else:
@@ -527,8 +530,8 @@ class Planner:
             return decision  # callers freeze their app (spot eviction)
         if is_sentinel_decision(decision):
             return None
-        # Return a copy: the live decision keeps mutating as results drain
-        return SchedulingDecision.from_dict(decision.to_dict())
+        # call_batch already returns a detached clone — safe to hand out
+        return decision
 
     def _freeze_app(self, req: BatchExecuteRequest) -> None:
         """Park a running app: release its resources and remember the

@@ -19,23 +19,15 @@ PROCS = os.path.join(os.path.dirname(__file__), "procs.py")
 ALIASES = "w1=127.0.0.1+10000,w2=127.0.0.1+13000,cli=127.0.0.1+16000"
 
 
-def drain_stdout(p, tee_path=None):
-    """Discard (or tee to a file) a child's further output on a daemon
-    thread: a full 64 KB pipe would block the child mid-log and wedge
-    the cluster."""
+def drain_stdout(p):
+    """Discard a child's further output on a daemon thread: a full 64 KB
+    pipe would block the child mid-log and wedge the cluster."""
     import threading
 
     def _loop():
         try:
-            sink = open(tee_path, "w") if tee_path else None
-            try:
-                for line in p.stdout:
-                    if sink is not None:
-                        sink.write(line)
-                        sink.flush()
-            finally:
-                if sink is not None:
-                    sink.close()
+            for _ in p.stdout:
+                pass
         except Exception:  # noqa: BLE001 — the pipe died with the child
             pass
 
