@@ -49,7 +49,6 @@ except ImportError:  # pragma: no cover — older JAX
 
 from faabric_tpu.models.transformer import (
     ModelConfig,
-    _attention,
     _rms_norm,
     _rope,
 )
@@ -144,10 +143,11 @@ def pp_param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
 
 
 def pp_data_sharding(mesh: Mesh) -> NamedSharding:
-    """(M, B, S) microbatched tokens: batch over dp, microbatch axis
+    """(M, B, S) microbatched tokens: batch over dp, sequence over sp
+    (identical to the pre-sp layout when sp=1), microbatch axis
     replicated (every stage sees every microbatch's tokens; only stage 0
     embeds them)."""
-    return NamedSharding(mesh, P(None, "dp", None))
+    return NamedSharding(mesh, P(None, "dp", "sp"))
 
 
 # ---------------------------------------------------------------------------
@@ -156,12 +156,14 @@ def pp_data_sharding(mesh: Mesh) -> NamedSharding:
 
 def _head_nll(y, ln_f, lm_head, targets_m, cfg: ModelConfig):
     """LM-head NLL for one microbatch — the single definition both
-    schedules (GPipe's loss_one, 1F1B's head) differentiate."""
+    schedules (GPipe's loss_one, 1F1B's head) differentiate. The local
+    token mean is pmean'd over sp (equal shard sizes; no-op at sp=1) so
+    a sequence-sharded pipeline reports the global mean."""
     h = _rms_norm(y, ln_f)
     logits = (h @ lm_head.astype(cfg.compute_dtype)).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets_m[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jax.lax.pmean(jnp.mean(nll), "sp")
 
 
 def _validate_pp_mesh(cfg: ModelConfig, mesh: Mesh) -> int:
@@ -169,9 +171,10 @@ def _validate_pp_mesh(cfg: ModelConfig, mesh: Mesh) -> int:
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
-    if mesh.shape.get("sp", 1) > 1:
-        raise ValueError("pipeline path supports dp×tp×pp(×ep) meshes "
-                         "(sp must be 1)")
+    if mesh.shape.get("sp", 1) > 1 and getattr(cfg, "n_experts", 0):
+        raise ValueError(
+            "MoE pipeline stages don't compose with sp (per-shard "
+            "capacity would diverge from the global routing)")
     ep = mesh.shape.get("ep", 1)
     if ep > 1:
         n_experts = getattr(cfg, "n_experts", 0)
@@ -186,19 +189,46 @@ def _validate_pp_mesh(cfg: ModelConfig, mesh: Mesh) -> int:
 def _pp_specs(cfg: ModelConfig, mesh: Mesh):
     param_specs = jax.tree.map(lambda s: s.spec,
                                pp_param_shardings(mesh, cfg))
-    return param_specs, P(None, "dp", None)
+    return param_specs, P(None, "dp", "sp")
+
+
+def _pp_attention_offset(q, k, v, row_offset):
+    """Causal attention where q covers the GLOBAL rows [row_offset,
+    row_offset + Sq) of a sequence whose K/V span all Skv rows. Reduces
+    to models/transformer._attention exactly at row_offset=0, Skv==Sq
+    (same op order and fp32 softmax accumulators)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    sq, skv = q.shape[1], k.shape[1]
+    rows = row_offset + jnp.arange(sq)[:, None]
+    mask = jnp.arange(skv)[None, :] <= rows
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def _pp_attention_sublayer(x, blk, positions, cfg: ModelConfig):
     """Megatron attention on tp-local shards (qkv column-parallel, wo
-    row-parallel + psum) — shared by the dense and MoE pp blocks."""
+    row-parallel + psum) — shared by the dense and MoE pp blocks.
+
+    Sequence parallelism composes here: activations/Q stay sharded over
+    ``sp`` and K/V are (transiently) all-gathered for the causal
+    offset-masked attention — the DeepSpeed-Ulysses-flavoured gather
+    variant, chosen over the ring inside the pipeline because the tick
+    scan already owns the ppermute schedule. Both collectives are
+    no-ops at sp=1, so this is ONE code path, not a branch. (The
+    dedicated non-pp sp path keeps full ring attention with flash
+    kernels — parallel/ring_attention.py.)"""
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dthe->tbshe", h,
                      blk["wqkv"].astype(cfg.compute_dtype))
     q, k, v = qkv[0], qkv[1], qkv[2]
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v)
+    k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
+    v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
+    row0 = jax.lax.axis_index("sp") * q.shape[1]
+    attn = _pp_attention_offset(q, k_full, v_full, row0)
     attn_out = jnp.einsum("bshe,hed->bsd", attn,
                           blk["wo"].astype(cfg.compute_dtype))
     return x + jax.lax.psum(attn_out, "tp")
@@ -281,7 +311,11 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
     d_model = cfg.d_model
     ticks = n_ticks(n_stages, m_count)
 
-    positions = jnp.broadcast_to(jnp.arange(seq)[None], (b_local, seq))
+    # GLOBAL row ids: the sequence may be sharded over sp (offset 0
+    # and a no-op at sp=1)
+    positions = jnp.broadcast_to(
+        jax.lax.axis_index("sp") * seq + jnp.arange(seq)[None],
+        (b_local, seq))
     embed = pp_params["embed"]
     stacked = pp_params["stacked"]
 
@@ -309,7 +343,7 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
         tokens_m = tokens_mb[m]
 
         emb = _mark_varying(embed.astype(cfg.compute_dtype)[tokens_m],
-                            ("dp", "pp"))
+                            ("dp", "pp", "sp"))
         x = jnp.where(s_idx == 0, emb, x_in)
         y = stage_fn(x)
 
@@ -317,7 +351,7 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
         return jax.lax.ppermute(y, "pp", perm), y
 
     x0 = _mark_varying(jnp.zeros((b_local, seq, d_model), cfg.compute_dtype),
-                       ("dp", "pp"))
+                       ("dp", "pp", "sp"))
     _, ys = jax.lax.scan(tick, x0, jnp.arange(ticks))
     # Last stage produced microbatch m at tick m + (S − 1); every other
     # stage's slice is garbage and is masked out by the final psum
@@ -397,7 +431,11 @@ def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
     ticks = n_ticks_1f1b(n_stages, m_count)
     n_slots = ring_slots(n_stages)
 
-    positions = jnp.broadcast_to(jnp.arange(seq)[None], (b_local, seq))
+    # GLOBAL row ids: the sequence may be sharded over sp (offset 0
+    # and a no-op at sp=1)
+    positions = jnp.broadcast_to(
+        jax.lax.axis_index("sp") * seq + jnp.arange(seq)[None],
+        (b_local, seq))
     embed = pp_params["embed"]
     stacked = pp_params["stacked"]
     is_first = s_idx == 0
@@ -425,7 +463,7 @@ def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
         mf_c = jnp.clip(mf, 0, m_count - 1)
         tokens_f = tokens_mb[mf_c]
         emb = _mark_varying(embed.astype(cfg.compute_dtype)[tokens_f],
-                            ("dp", "pp"))
+                            ("dp", "pp", "sp"))
         x_in = jnp.where(is_first, emb, x_hop)
         slot_f = mf_c % n_slots
         ring = ring.at[slot_f].set(
@@ -476,17 +514,18 @@ def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
                 loss_acc), None
 
     zeros_act = _mark_varying(
-        jnp.zeros((b_local, seq, d_model), cfg.compute_dtype), ("dp", "pp"))
+        jnp.zeros((b_local, seq, d_model), cfg.compute_dtype),
+        ("dp", "pp", "sp"))
     ring0 = _mark_varying(
         jnp.zeros((n_slots, b_local, seq, d_model), cfg.compute_dtype),
-        ("dp", "pp"))
+        ("dp", "pp", "sp"))
     # Accumulator vma types mirror what lands in them: g_stacked /
     # g_lnf / g_lmh receive vjp cotangents already auto-psum'd over the
     # axes their params are invariant on (zeros_like inherits the
     # param's own type); g_embed takes the dp-local dx scatter and the
     # loss the pp/dp-local masked head value
     g_stacked0 = jax.tree.map(jnp.zeros_like, stacked)
-    g_embed0 = _mark_varying(jnp.zeros_like(embed), ("dp", "pp"))
+    g_embed0 = _mark_varying(jnp.zeros_like(embed), ("dp", "pp", "sp"))
     g_lnf0 = jnp.zeros_like(pp_params["ln_f"])
     g_lmh0 = jnp.zeros_like(pp_params["lm_head"])
     loss0 = _mark_varying(jnp.zeros((), jnp.float32), ("dp", "pp"))
@@ -511,7 +550,8 @@ def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
     #   too for the head leaves) — they arrive as Σ over dp shards, so
     #   the dp MEAN is a static division, and another psum/pmean would
     #   double-count.
-    g_embed = jax.lax.pmean(jax.lax.psum(g_embed * inv_m, "pp"), "dp")
+    g_embed = jax.lax.pmean(
+        jax.lax.psum(jax.lax.psum(g_embed * inv_m, "pp"), "sp"), "dp")
     scale = inv_m / dp_size
     g_stacked = jax.tree.map(lambda g: g * scale, g_stacked)
     g_lnf = g_lnf * scale
