@@ -166,6 +166,16 @@ def _head_nll(y, ln_f, lm_head, targets_m, cfg: ModelConfig):
     return jax.lax.pmean(jnp.mean(nll), "sp")
 
 
+def _global_positions(b_local: int, seq: int):
+    """GLOBAL row ids for this device's sequence shard — the single
+    definition of 'global row = axis_index(sp) · seq_local + local'
+    shared by both schedule bodies (and consistent with the row0 offset
+    in _pp_attention_sublayer). A no-op offset at sp=1."""
+    return jnp.broadcast_to(
+        jax.lax.axis_index("sp") * seq + jnp.arange(seq)[None],
+        (b_local, seq))
+
+
 def _validate_pp_mesh(cfg: ModelConfig, mesh: Mesh) -> int:
     n_stages = mesh.shape["pp"]
     if cfg.n_layers % n_stages:
@@ -311,11 +321,7 @@ def _pipeline_loss_local(pp_params, tokens_mb, targets_mb,
     d_model = cfg.d_model
     ticks = n_ticks(n_stages, m_count)
 
-    # GLOBAL row ids: the sequence may be sharded over sp (offset 0
-    # and a no-op at sp=1)
-    positions = jnp.broadcast_to(
-        jax.lax.axis_index("sp") * seq + jnp.arange(seq)[None],
-        (b_local, seq))
+    positions = _global_positions(b_local, seq)
     embed = pp_params["embed"]
     stacked = pp_params["stacked"]
 
@@ -431,11 +437,7 @@ def _pipeline_1f1b_local(pp_params, tokens_mb, targets_mb,
     ticks = n_ticks_1f1b(n_stages, m_count)
     n_slots = ring_slots(n_stages)
 
-    # GLOBAL row ids: the sequence may be sharded over sp (offset 0
-    # and a no-op at sp=1)
-    positions = jnp.broadcast_to(
-        jax.lax.axis_index("sp") * seq + jnp.arange(seq)[None],
-        (b_local, seq))
+    positions = _global_positions(b_local, seq)
     embed = pp_params["embed"]
     stacked = pp_params["stacked"]
     is_first = s_idx == 0
