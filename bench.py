@@ -1366,12 +1366,46 @@ def main() -> None:
         except OSError as e:
             _log(f"sidecar write failed: {e}")
 
+    # Telemetry rides along: a /metrics-equivalent snapshot brackets
+    # every host section, so each BENCH_*.json carries bytes-moved /
+    # frame-count deltas and phase-time shares per section — per-phase
+    # perf trajectory across rounds for free (ISSUE 1)
+    from faabric_tpu.telemetry import (
+        get_metrics,
+        set_tracing,
+        snapshot_delta,
+        summary_data,
+    )
+
+    # FAABRIC_TRACING=0 captures untraced timings (span recording does
+    # perturb hot multi-threaded sections a little); the phase_shares
+    # block is then simply absent
+    if os.environ.get("FAABRIC_TRACING", "1") != "0":
+        set_tracing(True)
+
+    def _phase_shares(before: dict, after: dict) -> dict:
+        deltas = {k: after[k]["total_s"] - before.get(k, {}).get("total_s", 0)
+                  for k in after}
+        total = sum(v for v in deltas.values() if v > 0)
+        if total <= 0:
+            return {}
+        return {k: round(v / total, 4)
+                for k, v in sorted(deltas.items(), key=lambda kv: -kv[1])
+                if v / total >= 0.005}
+
     def host_section(name, fn):
         t0 = time.perf_counter()
+        m0, p0 = get_metrics().snapshot(), summary_data()
         try:
             extras[name] = fn()
         except Exception as e:  # noqa: BLE001
             extras[name + "_error"] = str(e)[:200]
+        tel = {k: v for k, v in (
+            ("metrics_delta", snapshot_delta(m0, get_metrics().snapshot())),
+            ("phase_shares", _phase_shares(p0, summary_data())),
+        ) if v}
+        if tel:
+            extras.setdefault("telemetry", {})[name] = tel
         _log(f"{name}: {time.perf_counter() - t0:.1f}s")
         save_extras()
 

@@ -89,9 +89,31 @@ class PlannerHttpEndpoint:
                 self.wfile.write(data)
 
             def do_GET(self) -> None:  # noqa: N802
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        body = endpoint.metrics_text().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif path == "/trace":
+                        body = endpoint.trace_json().encode()
+                        ctype = "application/json"
+                    else:
+                        body = b'{"status": "running"}'
+                        ctype = "application/json"
+                except Exception as e:  # noqa: BLE001 — scrape errors
+                    logger.exception("HTTP GET %s failed", path)
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(b'{"status": "running"}')
+                self.wfile.write(body)
 
             def log_message(self, fmt, *args):  # quiet
                 logger.debug("http: " + fmt, *args)
@@ -111,6 +133,35 @@ class PlannerHttpEndpoint:
             self._thread.join(timeout=5.0)
         self._server = None
         self._thread = None
+
+    # ------------------------------------------------------------------
+    # Telemetry export (GET /metrics, GET /trace)
+    # ------------------------------------------------------------------
+    def metrics_text(self) -> str:
+        """Prometheus text exposition merging every registered host's
+        local registry (plus the planner's own) under a ``host`` label."""
+        from faabric_tpu.telemetry import render_snapshots
+
+        tel = self.planner.collect_telemetry()
+        return render_snapshots(
+            {host: t.get("metrics", {}) for host, t in tel.items()})
+
+    def trace_json(self) -> str:
+        """Chrome trace_event JSON merging every host's span buffer onto
+        one wall-clock timeline (load in chrome://tracing / Perfetto).
+        Raw pids are remapped per (host, pid): containerized workers are
+        routinely all pid 1, and colliding pids would collapse different
+        hosts onto one Perfetto process row."""
+        tel = self.planner.collect_telemetry(include_trace=True)
+        events: list = []
+        pid_map: dict[tuple[str, int], int] = {}
+        for host in sorted(tel):
+            for e in tel[host].get("trace") or []:
+                key = (host, e.get("pid", 0))
+                pid = pid_map.setdefault(key, len(pid_map) + 1)
+                # Copy: the planner's own events are live tracer state
+                events.append({**e, "pid": pid})
+        return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
 
     # ------------------------------------------------------------------
     def handle(self, body: bytes) -> tuple[int, str]:

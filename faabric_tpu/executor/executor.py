@@ -28,6 +28,12 @@ from faabric_tpu.proto import (
     ReturnValue,
     get_main_thread_snapshot_key,
 )
+from faabric_tpu.telemetry import (
+    NULL_SPAN,
+    get_metrics,
+    span,
+    tracing_enabled,
+)
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.logging import get_logger
 from faabric_tpu.util.queues import Queue
@@ -38,6 +44,16 @@ if TYPE_CHECKING:  # pragma: no cover
 logger = get_logger(__name__)
 
 POOL_SHUTDOWN = -1
+
+_metrics = get_metrics()
+_QUEUE_WAIT_SECONDS = _metrics.histogram(
+    "faabric_executor_queue_wait_seconds",
+    "Task time spent queued before a pool thread picked it up")
+_RUN_SECONDS = _metrics.histogram(
+    "faabric_executor_run_seconds",
+    "Guest execute_task run time")
+_TASKS_TOTAL = _metrics.counter(
+    "faabric_executor_tasks_total", "Tasks executed")
 
 
 class FunctionMigratedException(Exception):
@@ -53,6 +69,7 @@ class ExecutorTask:
     def __init__(self, msg_idx: int, req: BatchExecuteRequest) -> None:
         self.msg_idx = msg_idx
         self.req = req
+        self.enqueue_ts = time.monotonic()
 
 
 def _merge_dirty_flags(acc, new):
@@ -253,6 +270,8 @@ class Executor:
         msg = req.messages[task.msg_idx]
         is_threads = req.type == int(BatchExecuteType.THREADS)
         msg.executed_host = self.scheduler.host if self.scheduler else ""
+        queue_wait = time.monotonic() - task.enqueue_ts
+        _QUEUE_WAIT_SECONDS.observe(queue_wait)
 
         # Thread-local dirty tracking brackets the task so each thread
         # reports only its own writes (reference Executor.cpp:464-476)
@@ -262,11 +281,12 @@ class Executor:
             tracker.start_thread_local_tracking(
                 mem, region_hints=self._batch_hints)
 
-        from faabric_tpu.util.clock import prof
-
         ExecutorContext.set(self, req, task.msg_idx)
+        run_t0 = time.monotonic()
         try:
-            with prof("executor.execute_task"):
+            with span("executor", "execute_task", msg_id=msg.id,
+                      function=f"{msg.user}/{msg.function}") \
+                    if tracing_enabled() else NULL_SPAN:
                 ret = self.execute_task(pool_idx, task.msg_idx, req)
         except FunctionMigratedException:
             logger.debug("%s task %d migrated", self.id, msg.id)
@@ -281,8 +301,16 @@ class Executor:
         finally:
             ExecutorContext.unset()
 
+        run_seconds = time.monotonic() - run_t0
+        _RUN_SECONDS.observe(run_seconds)
+        _TASKS_TOTAL.inc()
         msg.return_value = ret
         msg.finish_timestamp = time.time()
+        # Per-message timing rides the result into the planner, so
+        # ExecGraph.to_json() can report wall/queue/exec durations per
+        # node (util/exec_graph.py)
+        msg.int_exec_graph_details["queue_us"] = int(queue_wait * 1e6)
+        msg.int_exec_graph_details["exec_us"] = int(run_seconds * 1e6)
         self.last_exec = time.monotonic()
 
         # Each thread contributes its dirty pages BEFORE the outstanding

@@ -41,11 +41,43 @@ from faabric_tpu.mpi.types import (
     pack_mpi_payload,
     unpack_mpi_payload,
 )
+from faabric_tpu.telemetry import get_metrics, span
+from faabric_tpu.transport.bulk import MAX_FRAME_BYTES
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
 
 MAIN_RANK = 0
+
+# Ring paths send whole segments as SINGLE bulk-plane messages (the
+# zero-copy ownership protocol cannot chunk them); a frame above the
+# bulk plane's sanity ceiling is rejected as garbage and drops the
+# connection (ADVICE r5). Headroom covers the MPI wire header riding
+# inside the bulk frame.
+RING_MSG_CAP = MAX_FRAME_BYTES - 4096
+
+_metrics = get_metrics()
+_coll_total: dict = {}
+_coll_bytes: dict = {}
+
+
+def _count_collective(op: str, nbytes: int) -> None:
+    c = _coll_total.get(op)
+    b = _coll_bytes.get(op)
+    if c is None or b is None:
+        # Both setdefaults run unconditionally: ranks are concurrent
+        # threads, and observing one dict populated must not imply the
+        # other is (the registry dedupes handles, so racers agree)
+        c = _coll_total.setdefault(op, _metrics.counter(
+            "faabric_mpi_collectives_total",
+            "Host-path collective invocations (per participating rank)",
+            op=op))
+        b = _coll_bytes.setdefault(op, _metrics.counter(
+            "faabric_mpi_collective_bytes_total",
+            "Per-rank payload bytes entering host-path collectives",
+            op=op))
+    c.inc()
+    b.inc(nbytes)
 
 
 class _SendWorker:
@@ -528,9 +560,11 @@ class MpiWorld:
     def barrier(self, rank: int) -> None:
         # Gather-to-0 + broadcast (reference :1753-1775) — delegated to the
         # group barrier, which already has a single-host fast path
-        self.broker.wait_for_mappings(self.group_id)
-        group = self.broker.get_group(self.group_id)
-        group.barrier(rank)
+        _count_collective("barrier", 0)
+        with span("mpi", "barrier", rank=rank, size=self.size):
+            self.broker.wait_for_mappings(self.group_id)
+            group = self.broker.get_group(self.group_id)
+            group.barrier(rank)
 
     # Above this, collectives stream in chunks so tree stages overlap:
     # while a leader reduces chunk k, chunk k+1 is on the wire and chunk
@@ -552,6 +586,14 @@ class MpiWorld:
 
     def broadcast(self, send_rank: int, recv_rank: int, data: np.ndarray
                   ) -> np.ndarray:
+        data = np.asarray(data)
+        _count_collective("broadcast", int(data.nbytes))
+        with span("mpi", "broadcast", rank=recv_rank, root=send_rank,
+                  bytes=int(data.nbytes)):
+            return self._broadcast_impl(send_rank, recv_rank, data)
+
+    def _broadcast_impl(self, send_rank: int, recv_rank: int,
+                        data: np.ndarray) -> np.ndarray:
         """Reference :786-853: root sends once per remote host (to its
         local leader) + to its own host's ranks; leaders re-broadcast
         locally.
@@ -675,6 +717,15 @@ class MpiWorld:
     def reduce(self, rank: int, root: int, data: np.ndarray,
                op: MpiOp = MpiOp.SUM,
                _shared_ok: bool = False) -> Optional[np.ndarray]:
+        data = np.asarray(data)
+        _count_collective("reduce", int(data.nbytes))
+        with span("mpi", "reduce", rank=rank, root=root,
+                  bytes=int(data.nbytes)):
+            return self._reduce_impl(rank, root, data, op, _shared_ok)
+
+    def _reduce_impl(self, rank: int, root: int, data: np.ndarray,
+                     op: MpiOp = MpiOp.SUM,
+                     _shared_ok: bool = False) -> Optional[np.ndarray]:
         """Reference :1127-1249: non-leaders send to their local leader;
         leaders partially reduce and forward one message to root.
         Large payloads stream chunk-pipelined."""
@@ -794,17 +845,42 @@ class MpiWorld:
         # Multi-host worlds keep the leader tree: it sends exactly one
         # message per remote host over the wire, which the ring does not.
         arr = np.asarray(data)
-        if (self.size > 1 and self._all_hosts_same_machine()
-                and arr.nbytes >= self.CHUNK_BYTES * 2
-                and arr.size >= self.size
-                and (not isinstance(op, UserOp) or op.commute)):
-            return self._allreduce_ring(rank, arr, op)
-        # reduce to 0 + broadcast (reference :1251-1264). The trailing
-        # broadcast is the completion barrier that makes zero-copy local
-        # contribution sends safe (_shared_ok).
-        reduced = self.reduce(rank, MAIN_RANK, data, op, _shared_ok=True)
-        return self.broadcast(MAIN_RANK, rank,
-                              reduced if rank == MAIN_RANK else np.asarray(data))
+        use_ring = (arr.size >= self.size
+                    and self._ring_eligible(arr, op))
+        _count_collective("allreduce", int(arr.nbytes))
+        with span("mpi", "allreduce", rank=rank, size=self.size,
+                  bytes=int(arr.nbytes),
+                  algo="ring" if use_ring else "tree"):
+            if use_ring:
+                return self._allreduce_ring(rank, arr, op)
+            # reduce to 0 + broadcast (reference :1251-1264). The trailing
+            # broadcast is the completion barrier that makes zero-copy local
+            # contribution sends safe (_shared_ok).
+            with span("mpi.phase", "reduce", rank=rank):
+                reduced = self._reduce_impl(rank, MAIN_RANK, arr, op,
+                                            _shared_ok=True)
+            with span("mpi.phase", "broadcast", rank=rank):
+                return self._broadcast_impl(
+                    MAIN_RANK, rank,
+                    reduced if rank == MAIN_RANK else arr)
+
+    def _ring_eligible(self, arr: np.ndarray, op) -> bool:
+        """Shared ring-path predicate for allreduce/reduce_scatter: big
+        enough to beat the tree, all ranks on this machine, commuting
+        op, and every per-rank segment fits one bulk frame."""
+        return (self.size > 1 and arr.nbytes >= self.CHUNK_BYTES * 2
+                and (not isinstance(op, UserOp) or op.commute)
+                and self._all_hosts_same_machine()
+                and self._ring_segment_fits(arr, op))
+
+    def _ring_segment_fits(self, arr: np.ndarray, op=None) -> bool:
+        """Every per-rank segment must fit one bulk frame (segments are
+        never chunked — see RING_MSG_CAP). A UserOp's fold may promote
+        the dtype (apply_op), so the circulated segments can be wider
+        than the input — size with the widest numpy itemsize (16) then."""
+        seg_elems = arr.size // self.size + 1
+        itemsize = 16 if isinstance(op, UserOp) else arr.itemsize
+        return seg_elems * itemsize <= RING_MSG_CAP
 
     def _all_hosts_same_machine(self) -> bool:
         """True when every rank's host resolves to THIS machine (rank
@@ -856,26 +932,30 @@ class MpiWorld:
         n = self.size
         seg = self._ring_segments(flat.size)
         nxt, prv = (rank + 1) % n, (rank - 1) % n
-        held, restore = self._ring_reduce_scatter(rank, data, op)
+        with span("mpi.phase", "reduce_scatter", rank=rank):
+            held, restore = self._ring_reduce_scatter(rank, data, op)
         # Allgather: circulate the complete segments by reference
-        parts: dict[int, np.ndarray] = {(rank + 1) % n: held}
-        for step in range(n - 1):
-            send_seg = (rank + 1 - step) % n
-            part = parts[send_seg]
-            if part.flags.writeable:
-                part.flags.writeable = False
-            self.send(rank, nxt, part, MpiMessageType.REDUCE, _copy=False)
-            arr, _ = self._recv_raw(prv, rank)
-            parts[(rank - step) % n] = arr
-        out = np.empty(flat.size, dtype=held.dtype)
-        for i in range(n):
-            lo, hi = seg[i]
-            out[lo:hi] = parts[i]
-        # Our last allgather recv causally implies nxt completed its
-        # whole fold phase (chain length n-1), i.e. consumed our step-0
-        # view — only now may the caller's buffer go writable again
-        restore()
-        return out.reshape(data.shape)
+        with span("mpi.phase", "allgather", rank=rank):
+            parts: dict[int, np.ndarray] = {(rank + 1) % n: held}
+            for step in range(n - 1):
+                send_seg = (rank + 1 - step) % n
+                part = parts[send_seg]
+                if part.flags.writeable:
+                    part.flags.writeable = False
+                self.send(rank, nxt, part, MpiMessageType.REDUCE,
+                          _copy=False)
+                arr, _ = self._recv_raw(prv, rank)
+                parts[(rank - step) % n] = arr
+        with span("mpi.phase", "assemble", rank=rank):
+            out = np.empty(flat.size, dtype=held.dtype)
+            for i in range(n):
+                lo, hi = seg[i]
+                out[lo:hi] = parts[i]
+            # Our last allgather recv causally implies nxt completed its
+            # whole fold phase (chain length n-1), i.e. consumed our step-0
+            # view — only now may the caller's buffer go writable again
+            restore()
+            return out.reshape(data.shape)
 
     def _ring_segments(self, n_elems: int) -> list[tuple[int, int]]:
         n = self.size
@@ -907,11 +987,13 @@ class MpiWorld:
             arr, _, owned = self._recv_raw_owned(prv, rank)
             lo, hi = seg[(rank - step - 1) % n]
             mine = flat[lo:hi]
-            if owned and arr.flags.writeable and arr.dtype == mine.dtype:
-                folded = apply_op_inplace(op, arr, mine)
-            else:  # step-0 shared view (or dtype-promoting op):
-                # non-inplace apply allocates + folds in ONE pass
-                folded = apply_op(op, arr, mine)
+            with span("mpi.detail", "fold", rank=rank, step=step):
+                if owned and arr.flags.writeable \
+                        and arr.dtype == mine.dtype:
+                    folded = apply_op_inplace(op, arr, mine)
+                else:  # step-0 shared view (or dtype-promoting op):
+                    # non-inplace apply allocates + folds in ONE pass
+                    folded = apply_op(op, arr, mine)
             folded = np.asarray(folded)
             if step < n - 2:
                 # Ownership transfer: the receiver folds into this buffer
@@ -930,6 +1012,13 @@ class MpiWorld:
 
     def scatter(self, send_rank: int, recv_rank: int, data: np.ndarray,
                 recv_count: int) -> np.ndarray:
+        _count_collective("scatter", int(np.asarray(data).nbytes))
+        with span("mpi", "scatter", rank=recv_rank, root=send_rank):
+            return self._scatter_impl(send_rank, recv_rank, data,
+                                      recv_count)
+
+    def _scatter_impl(self, send_rank: int, recv_rank: int,
+                      data: np.ndarray, recv_count: int) -> np.ndarray:
         """Root splits (size*recv_count) into per-rank chunks."""
         if recv_rank == send_rank:
             data = np.asarray(data)
@@ -943,6 +1032,14 @@ class MpiWorld:
 
     def gather(self, send_rank: int, root: int, data: np.ndarray
                ) -> Optional[np.ndarray]:
+        data = np.asarray(data)
+        _count_collective("gather", int(data.nbytes))
+        with span("mpi", "gather", rank=send_rank, root=root,
+                  bytes=int(data.nbytes)):
+            return self._gather_impl(send_rank, root, data)
+
+    def _gather_impl(self, send_rank: int, root: int, data: np.ndarray
+                     ) -> Optional[np.ndarray]:
         """Two-step local-leader aggregation (reference :917-1080)."""
         my_host = self.host_for_rank(send_rank)
         root_host = self.host_for_rank(root)
@@ -1072,27 +1169,39 @@ class MpiWorld:
             raise ValueError(
                 f"reduce_scatter needs size divisible by {self.size}")
         k = data.size // self.size
-        if (self.size > 1 and self._all_hosts_same_machine()
-                and data.nbytes >= self.CHUNK_BYTES * 2
-                and (not isinstance(op, UserOp) or op.commute)):
-            held, restore = self._ring_reduce_scatter(rank, data, op)
-            # The ring leaves rank holding segment (rank+1) — which
-            # belongs to rank+1; rotate one hop forward so every rank
-            # ends with ITS OWN segment (rank-1 holds ours). Ownership
-            # transfers with the rotation: the receiver returns the
-            # buffer to its caller outright
-            self.send(rank, (rank + 1) % self.size, np.asarray(held),
-                      MpiMessageType.REDUCE, _transfer=True)
-            del held
-            arr, _, owned = self._recv_raw_owned((rank - 1) % self.size,
-                                                 rank)
-            # The rotation recv extends the causal chain to length n,
-            # so nxt has consumed our step-0 view: safe to restore
-            restore()
-            return arr if owned and arr.flags.writeable else arr.copy()
-        reduced = self.reduce(rank, MAIN_RANK, data, op)
-        return self.scatter(MAIN_RANK, rank,
-                            reduced if rank == MAIN_RANK else np.empty(0), k)
+        use_ring = self._ring_eligible(data, op)
+        _count_collective("reduce_scatter", int(data.nbytes))
+        with span("mpi", "reduce_scatter", rank=rank, size=self.size,
+                  bytes=int(data.nbytes),
+                  algo="ring" if use_ring else "tree"):
+            if use_ring:
+                with span("mpi.phase", "reduce_scatter", rank=rank):
+                    held, restore = self._ring_reduce_scatter(rank, data,
+                                                              op)
+                # The ring leaves rank holding segment (rank+1) — which
+                # belongs to rank+1; rotate one hop forward so every rank
+                # ends with ITS OWN segment (rank-1 holds ours). Ownership
+                # transfers with the rotation: the receiver returns the
+                # buffer to its caller outright
+                with span("mpi.phase", "rotate", rank=rank):
+                    self.send(rank, (rank + 1) % self.size,
+                              np.asarray(held), MpiMessageType.REDUCE,
+                              _transfer=True)
+                    del held
+                    arr, _, owned = self._recv_raw_owned(
+                        (rank - 1) % self.size, rank)
+                    # The rotation recv extends the causal chain to length
+                    # n, so nxt has consumed our step-0 view: safe to
+                    # restore
+                    restore()
+                    return (arr if owned and arr.flags.writeable
+                            else arr.copy())
+            with span("mpi.phase", "reduce", rank=rank):
+                reduced = self._reduce_impl(rank, MAIN_RANK, data, op)
+            with span("mpi.phase", "scatter", rank=rank):
+                return self._scatter_impl(
+                    MAIN_RANK, rank,
+                    reduced if rank == MAIN_RANK else np.empty(0), k)
 
     def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
         # Large same-machine payloads: ring allgather — contributions
@@ -1100,16 +1209,26 @@ class MpiWorld:
         # queues (n-1 steps, one final assembly copy per rank) instead
         # of funnelling through rank 0 twice.
         data = np.asarray(data)
-        if (self.size > 1 and data.nbytes >= self.CHUNK_BYTES
-                and self._all_hosts_same_machine()):
-            return self._allgather_ring(rank, data)
-        # gather(0) + broadcast (reference :1082-1111). The broadcast
-        # stream is self-describing (CHUNK_HEADER), so non-roots need no
-        # sized template — they follow the root's framing.
-        gathered = self.gather(rank, MAIN_RANK, data)
-        template = (gathered if rank == MAIN_RANK
-                    else np.empty(0, dtype=data.dtype))
-        return self.broadcast(MAIN_RANK, rank, template)
+        # The ring circulates each rank's WHOLE contribution as one
+        # message, so it too is capped at a single bulk frame
+        use_ring = (self.size > 1 and data.nbytes >= self.CHUNK_BYTES
+                    and data.nbytes <= RING_MSG_CAP
+                    and self._all_hosts_same_machine())
+        _count_collective("allgather", int(data.nbytes))
+        with span("mpi", "allgather", rank=rank, size=self.size,
+                  bytes=int(data.nbytes),
+                  algo="ring" if use_ring else "tree"):
+            if use_ring:
+                return self._allgather_ring(rank, data)
+            # gather(0) + broadcast (reference :1082-1111). The broadcast
+            # stream is self-describing (CHUNK_HEADER), so non-roots need
+            # no sized template — they follow the root's framing.
+            with span("mpi.phase", "gather", rank=rank):
+                gathered = self._gather_impl(rank, MAIN_RANK, data)
+            template = (gathered if rank == MAIN_RANK
+                        else np.empty(0, dtype=data.dtype))
+            with span("mpi.phase", "broadcast", rank=rank):
+                return self._broadcast_impl(MAIN_RANK, rank, template)
 
     def _allgather_ring(self, rank: int, data: np.ndarray) -> np.ndarray:
         """Ring allgather: rank r's contribution is segment r; n-1 steps
@@ -1157,18 +1276,21 @@ class MpiWorld:
         """All-pairs exchange of equal chunks (reference :1433-1736 naive
         variant): data is (size*chunk,), row r goes to rank r."""
         data = np.asarray(data)
-        chunk = data.size // self.size
-        rows = data.reshape(self.size, chunk)
-        for r in range(self.size):
-            if r != rank:
-                self.send(rank, r, rows[r], MpiMessageType.ALLTOALL)
-        out = np.empty_like(rows)
-        out[rank] = rows[rank]
-        for r in range(self.size):
-            if r != rank:
-                arr, _ = self.recv(r, rank)
-                out[r] = arr
-        return out.reshape(-1)
+        _count_collective("alltoall", int(data.nbytes))
+        with span("mpi", "alltoall", rank=rank, size=self.size,
+                  bytes=int(data.nbytes)):
+            chunk = data.size // self.size
+            rows = data.reshape(self.size, chunk)
+            for r in range(self.size):
+                if r != rank:
+                    self.send(rank, r, rows[r], MpiMessageType.ALLTOALL)
+            out = np.empty_like(rows)
+            out[rank] = rows[rank]
+            for r in range(self.size):
+                if r != rank:
+                    arr, _ = self.recv(r, rank)
+                    out[r] = arr
+            return out.reshape(-1)
 
     # ------------------------------------------------------------------
     # Cartesian topology (reference :369-493 — there fixed 2-D periodic,

@@ -45,13 +45,31 @@ from faabric_tpu.proto import (
     ReturnValue,
     update_batch_exec_group_id,
 )
+from faabric_tpu.telemetry import get_metrics, span
 from faabric_tpu.transport.common import MPI_BASE_PORT, MPI_PORTS_PER_HOST
-from faabric_tpu.util.clock import prof
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.gids import generate_gid
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
+
+_metrics = get_metrics()
+_SCHEDULE_SECONDS = _metrics.histogram(
+    "faabric_planner_schedule_seconds",
+    "End-to-end call_batch latency (decision + mappings + dispatch)")
+_DISPATCH_SECONDS = _metrics.histogram(
+    "faabric_planner_dispatch_seconds",
+    "Per-decision worker dispatch latency (network, post-lock)")
+_IN_FLIGHT_APPS = _metrics.gauge(
+    "faabric_planner_in_flight_apps",
+    "Apps currently holding slots on the planner")
+_RESULTS_TOTAL = _metrics.counter(
+    "faabric_planner_results_total",
+    "Message results recorded by the planner")
+_RESULT_ROUNDTRIP = _metrics.histogram(
+    "faabric_planner_result_roundtrip_seconds",
+    "Message creation to result recorded at the planner (wall clocks of "
+    "the submitting host and the planner: cross-machine skew shifts it)")
 
 
 class PlannerHost:
@@ -89,6 +107,9 @@ class PlannerHost:
 class Planner:
     def __init__(self) -> None:
         self._lock = threading.RLock()
+        # host ip → live scrape thread (collect_telemetry); setdefault/pop
+        # on the GIL-atomic dict bound in-flight scrapes to one per host
+        self._telemetry_scrapes: dict[str, threading.Thread] = {}
         self._hosts: dict[str, PlannerHost] = {}
         # app_id → (req, decision)
         self._in_flight: dict[int, tuple[BatchExecuteRequest, SchedulingDecision]] = {}
@@ -210,8 +231,11 @@ class Planner:
                 for m in msgs:
                     # A host that was merely SLOW (paused past the
                     # keep-alive timeout, then resumed) may have reported
-                    # a genuine result between collection and now —
-                    # never overwrite it with a synthetic failure
+                    # a genuine result between collection and now. This
+                    # pre-check just skips the obvious cases; the
+                    # authoritative guard is set_message_result's
+                    # first-write-wins check, which closes the remaining
+                    # check-then-act window under one lock hold.
                     with self._lock:
                         if m.id in self._results.get(m.app_id, {}):
                             continue
@@ -294,6 +318,16 @@ class Planner:
         """Schedule a batch. Accounting happens under the planner lock;
         network dispatch happens after it is released, so one unreachable
         worker cannot stall keep-alives and other apps' scheduling."""
+        t0 = time.monotonic()
+        with span("planner", "call_batch", app_id=req.app_id,
+                  n_messages=req.n_messages()):
+            try:
+                return self._call_batch_inner(req)
+            finally:
+                _SCHEDULE_SECONDS.observe(time.monotonic() - t0)
+
+    def _call_batch_inner(self, req: BatchExecuteRequest
+                          ) -> SchedulingDecision:
         from faabric_tpu.proto import update_batch_exec_app_id
 
         # Messages must agree with their batch's app id — chained/scale
@@ -301,7 +335,7 @@ class Planner:
         # wrong app bucket (reference updateBatchExecAppId)
         update_batch_exec_app_id(req, req.app_id)
 
-        with prof("planner.call_batch"), self._lock:
+        with self._lock:
             scheduler = get_batch_scheduler()
             decision_type = scheduler.get_decision_type(self._in_flight, req)
 
@@ -401,6 +435,7 @@ class Planner:
             gids, hosts = self._group_hosts.get(req.app_id, (set(), set()))
             self._group_hosts[req.app_id] = (
                 gids | {mappings.group_id}, hosts | set(mappings.hosts))
+            _IN_FLIGHT_APPS.set(len(self._in_flight))
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         return result
@@ -543,6 +578,7 @@ class Planner:
             self._evicted[req.app_id] = old_req
         else:
             self._evicted[req.app_id] = req
+        _IN_FLIGHT_APPS.set(len(self._in_flight))
 
     # -- resource accounting ---------------------------------------------
     def _policy_host_map(self) -> dict[str, HostState]:
@@ -695,8 +731,11 @@ class Planner:
         return out
 
     def _do_dispatch(self, dispatches: list[tuple[str, BatchExecuteRequest]]) -> None:
-        with prof("planner.dispatch"):
+        t0 = time.monotonic()
+        with span("planner", "dispatch", n_hosts=len(dispatches)):
             self._do_dispatch_inner(dispatches)
+        if dispatches:
+            _DISPATCH_SECONDS.observe(time.monotonic() - t0)
 
     def _do_dispatch_inner(self,
                            dispatches: list[tuple[str, BatchExecuteRequest]]
@@ -778,8 +817,22 @@ class Planner:
                 # there as a MIGRATION batch (reference §3.5)
                 redispatch = self._build_migration_redispatch(app_id, msg_id)
             if not migrated and not frozen:
+                if msg_id in self._results.get(app_id, {}):
+                    # First write wins (ADVICE r5): a synthetic FAILED
+                    # result (host expiry) racing a genuine late result —
+                    # or a duplicate report — must never overwrite the
+                    # recorded result. The first write already released
+                    # the slot and notified waiters; late readers get
+                    # the stored result from get_message_result.
+                    logger.debug("Ignoring duplicate result for msg %d "
+                                 "(app %d)", msg_id, app_id)
+                    return
                 self._release_message(app_id, msg_id)
                 self._results.setdefault(app_id, {})[msg_id] = msg
+                _RESULTS_TOTAL.inc()
+                if msg.timestamp:
+                    _RESULT_ROUNDTRIP.observe(
+                        max(0.0, time.time() - msg.timestamp))
 
                 in_flight = self._in_flight.get(app_id)
                 if in_flight is not None:
@@ -796,6 +849,7 @@ class Planner:
                         self._completed_order.append(app_id)
                         self._evict_old_results()
                         logger.debug("App %d complete", app_id)
+                    _IN_FLIGHT_APPS.set(len(self._in_flight))
 
             waiters = self._waiters.pop((app_id, msg_id), set())
             clients = [self._get_client(ip) for ip in waiters]
@@ -939,6 +993,66 @@ class Planner:
             "frozenApps": frozen,
         }
 
+    def collect_telemetry(self, include_trace: bool = False,
+                          timeout: float = 5.0) -> dict:
+        """host label → {"metrics": snapshot, "trace": [events]} from this
+        (planner) process plus every registered worker's local registry —
+        the aggregation behind ``GET /metrics`` and ``GET /trace``.
+        Workers are scraped CONCURRENTLY under one deadline: a host that
+        fails — or is wedged past ``timeout`` — is skipped, not fatal; a
+        scrape must not go down (or block a Prometheus scrape window)
+        with one bad host."""
+        from faabric_tpu.telemetry import trace_events
+
+        out: dict = {"planner": {"metrics": get_metrics().snapshot()}}
+        if include_trace:
+            out["planner"]["trace"] = trace_events()
+
+        # One in-flight scrape per host, ever: a wedged host's thread can
+        # block inside its client's sync RPC for the full socket timeout,
+        # and each scrape holds that client's sync lock — spawning a new
+        # thread per GET while the old one is stuck would pile threads up
+        # behind the lock without bound. A host with a live scrape is
+        # simply absent from this response.
+        ips = [h.ip for h in self.get_available_hosts()]
+        slots: list = [None] * len(ips)  # per-thread slot: a straggler
+        # writing after the deadline mutates only its own cell, never the
+        # dict the caller is iterating
+
+        def scrape(i: int, ip: str) -> None:
+            try:
+                slots[i] = self._get_client(ip).get_telemetry(include_trace)
+            except Exception:  # noqa: BLE001
+                logger.warning("Telemetry scrape of %s failed", ip)
+            finally:
+                self._telemetry_scrapes.pop(ip, None)
+
+        threads = []
+        for i, ip in enumerate(ips):
+            t = threading.Thread(target=scrape, args=(i, ip),
+                                 name=f"telemetry-scrape-{ip}",
+                                 daemon=True)
+            if self._telemetry_scrapes.setdefault(ip, t) is not t:
+                logger.warning(
+                    "Skipping telemetry scrape of %s (previous scrape "
+                    "still in flight)", ip)
+                continue
+            try:
+                t.start()
+            except RuntimeError:  # thread/fd exhaustion: don't leave the
+                # registration behind or the host is skipped forever
+                self._telemetry_scrapes.pop(ip, None)
+                logger.warning("Could not start telemetry scrape of %s", ip)
+                continue
+            threads.append(t)
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        for ip, tel in zip(ips, slots):
+            if tel is not None:
+                out[ip] = tel
+        return out
+
     def flush_hosts(self) -> None:
         with self._lock:
             self._hosts.clear()
@@ -980,6 +1094,7 @@ class Planner:
             self._num_migrations = 0
             self._clients.close_all()
             self._snapshot_clients.close_all()
+            _IN_FLIGHT_APPS.set(0)
         from faabric_tpu.batch_scheduler import get_decision_cache
         from faabric_tpu.transport.ptp_remote import close_mapping_clients
 
@@ -989,6 +1104,7 @@ class Planner:
     def flush_scheduling_state(self) -> None:
         with self._lock:
             self._in_flight.clear()
+            _IN_FLIGHT_APPS.set(0)
             self._results.clear()
             self._expected.clear()
             self._next_idx.clear()

@@ -62,6 +62,9 @@ class PlannerServer(MessageEndpointServer):
             port_offset=port_offset)
 
     def start(self) -> None:
+        from faabric_tpu.telemetry import set_process_label
+
+        set_process_label("planner")
         super().start()
         self.snapshot_server.start()
 

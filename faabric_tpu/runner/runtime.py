@@ -29,6 +29,11 @@ class WorkerRuntime:
                  device_plane_size: int = 0) -> None:
         conf = get_system_config()
         self.host = host or get_primary_ip_for_this_host()
+        # Traces from co-located worker processes merge on one Perfetto
+        # timeline; the label tells their rows apart
+        from faabric_tpu.telemetry import set_process_label
+
+        set_process_label(f"worker-{self.host}")
         self.slots = slots or conf.get_usable_cores()
         self.n_devices = n_devices
         # >1: join the multi-process device plane at boot — this worker

@@ -44,6 +44,7 @@ class FunctionCalls(enum.IntEnum):
     EXECUTE_FUNCTIONS = 1
     FLUSH = 2
     SET_MESSAGE_RESULT = 3
+    GET_TELEMETRY = 4
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +107,19 @@ class FunctionCallClient(MessageEndpointClient):
             return
         self.sync_send(int(FunctionCalls.FLUSH))
 
+    def get_telemetry(self, include_trace: bool = False) -> dict:
+        """This host's local metrics snapshot (and optionally its trace
+        buffer) — the wire the planner aggregates ``GET /metrics`` and
+        ``GET /trace`` from."""
+        if is_mock_mode():
+            return {"metrics": {}, "trace": []}
+        resp = self.sync_send(int(FunctionCalls.GET_TELEMETRY),
+                              {"trace": bool(include_trace)},
+                              idempotent=True)
+        import json as _json
+
+        return _json.loads(resp.payload.decode()) if resp.payload else {}
+
 
 def _message_to_wire(msg: Message) -> tuple[dict, bytes]:
     from faabric_tpu.proto import messages_to_wire
@@ -147,4 +161,14 @@ class FunctionCallServer(MessageEndpointServer):
         if msg.code == int(FunctionCalls.FLUSH):
             self.scheduler.flush()
             return handler_response()
+        if msg.code == int(FunctionCalls.GET_TELEMETRY):
+            import json as _json
+
+            from faabric_tpu.telemetry import get_metrics, trace_events
+
+            body: dict = {"metrics": get_metrics().snapshot()}
+            if msg.header.get("trace"):
+                body["trace"] = trace_events()
+            # Payload, not header: a full trace buffer is bulk data
+            return handler_response(payload=_json.dumps(body).encode())
         raise ValueError(f"Unknown sync function call {msg.code}")

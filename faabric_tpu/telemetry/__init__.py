@@ -1,0 +1,69 @@
+"""Runtime telemetry: the metrics registry + span tracer.
+
+Two layers, one import surface:
+
+- :mod:`faabric_tpu.telemetry.metrics` — process-wide counters, gauges
+  and fixed-bucket histograms with Prometheus text export (served by the
+  planner endpoint's ``GET /metrics``, aggregated from every host).
+- :mod:`faabric_tpu.telemetry.tracer` — nestable spans with Chrome
+  ``trace_event`` export (``GET /trace``) and the text summary that
+  supersedes ``util.clock.prof_summary``.
+
+See docs/telemetry.md for env vars and capture recipes.
+"""
+
+from faabric_tpu.telemetry.metrics import (
+    DEFAULT_BUCKETS,
+    NULL_METRIC,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_metrics,
+    metrics_enabled,
+    render_snapshots,
+    set_metrics_enabled,
+    snapshot_delta,
+)
+from faabric_tpu.telemetry.tracer import (
+    NULL_SPAN,
+    Tracer,
+    chrome_trace,
+    chrome_trace_json,
+    get_tracer,
+    reset_tracing,
+    set_process_label,
+    set_tracing,
+    span,
+    summary_data,
+    text_summary,
+    trace_events,
+    tracing_enabled,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "NULL_METRIC",
+    "NULL_SPAN",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "chrome_trace",
+    "chrome_trace_json",
+    "get_metrics",
+    "get_tracer",
+    "metrics_enabled",
+    "render_snapshots",
+    "reset_tracing",
+    "set_metrics_enabled",
+    "set_process_label",
+    "set_tracing",
+    "snapshot_delta",
+    "span",
+    "summary_data",
+    "text_summary",
+    "trace_events",
+    "tracing_enabled",
+]

@@ -1,0 +1,229 @@
+"""Span tracer: nestable, thread-/async-safe timing spans with Chrome
+``trace_event`` export.
+
+A span carries (subsystem, label, attrs); nesting is tracked through a
+``contextvars.ContextVar``, so spans opened on different threads (each
+thread starts with an empty context) or interleaved asyncio tasks never
+corrupt each other's stacks. Completed spans land in a bounded ring
+buffer as Chrome "X" (complete) events — load the ``chrome_trace()``
+dump in ``chrome://tracing`` or Perfetto and the per-thread nesting
+renders as flame graphs. Aggregate totals are kept separately (complete
+even after the ring buffer wraps) and feed ``text_summary()``, the
+successor of ``util.clock.prof_summary``.
+
+Enablement: ``FAABRIC_TRACING=1`` (or the legacy
+``FAABRIC_SELF_TRACING=1``) at process start, or ``set_tracing(True)``
+programmatically (tests, targeted capture). Disabled mode is a
+zero-allocation fast path: ``span(...)`` returns one shared no-op
+context manager.
+
+Timestamps: wall-clock-anchored microseconds (``wall_epoch +
+monotonic_delta``), so traces captured by co-located processes (the
+multi-process bulk plane) line up on one Perfetto timeline.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "faabric_current_span", default=None)
+
+
+class _NullSpan:
+    """Shared no-op span returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "subsystem", "label", "attrs", "_t0", "_token")
+
+    def __init__(self, tracer: "Tracer", subsystem: str, label: str,
+                 attrs: dict) -> None:
+        self._tracer = tracer
+        self.subsystem = subsystem
+        self.label = label
+        self.attrs = attrs
+
+    def __enter__(self):
+        parent = _current.get()
+        if parent is not None:
+            self.attrs.setdefault(
+                "parent", f"{parent.subsystem}/{parent.label}")
+        self._token = _current.set(self)
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        end = time.monotonic()
+        _current.reset(self._token)
+        self._tracer._record(self, self._t0, end)
+        return False
+
+
+class Tracer:
+    def __init__(self, enabled: bool, maxlen: int) -> None:
+        self._enabled = enabled
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=maxlen)
+        self._totals: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+        self._tid_names: dict[int, str] = {}
+        self._pid = os.getpid()
+        self.process_label = f"faabric-{self._pid}"
+        # Wall anchor for cross-process alignment of monotonic stamps
+        self._wall0 = time.time() - time.monotonic()
+
+    # -- span creation --------------------------------------------------
+    def span(self, subsystem: str, label: str, **attrs):
+        if not self._enabled:
+            return NULL_SPAN
+        return _Span(self, subsystem, label, attrs)
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        self._enabled = on
+
+    # -- recording ------------------------------------------------------
+    def _record(self, span: _Span, t0: float, t1: float) -> None:
+        tid = threading.get_ident()
+        event = {
+            "name": span.label,
+            "cat": span.subsystem,
+            "ph": "X",
+            "ts": (self._wall0 + t0) * 1e6,
+            "dur": (t1 - t0) * 1e6,
+            "pid": self._pid,
+            "tid": tid,
+        }
+        if span.attrs:
+            event["args"] = span.attrs
+        key = f"{span.subsystem}/{span.label}"
+        with self._lock:
+            self._events.append(event)
+            self._totals[key] = self._totals.get(key, 0.0) + (t1 - t0)
+            self._counts[key] = self._counts.get(key, 0) + 1
+            # Last-write-wins: CPython recycles thread idents, so the
+            # row label should follow the ident's CURRENT owner
+            self._tid_names[tid] = threading.current_thread().name
+
+    # -- export ---------------------------------------------------------
+    def trace_events(self) -> list[dict]:
+        """Completed spans plus process/thread-name metadata records."""
+        with self._lock:
+            events = list(self._events)
+            tid_names = dict(self._tid_names)
+        meta: list[dict] = [{
+            "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
+            "args": {"name": self.process_label},
+        }]
+        for tid, name in tid_names.items():
+            meta.append({"name": "thread_name", "ph": "M", "pid": self._pid,
+                         "tid": tid, "args": {"name": name}})
+        return meta + events
+
+    def chrome_trace(self) -> dict:
+        return {"traceEvents": self.trace_events(),
+                "displayTimeUnit": "ms"}
+
+    def chrome_trace_json(self) -> str:
+        return json.dumps(self.chrome_trace())
+
+    def summary_data(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: {"total_s": self._totals[k],
+                        "count": self._counts[k]}
+                    for k in self._totals}
+
+    def text_summary(self) -> str:
+        with self._lock:
+            lines = ["--- PROF summary ---"]
+            for key in sorted(self._totals):
+                lines.append(
+                    f"{key:<40} total={self._totals[key] * 1000:.2f}ms "
+                    f"n={self._counts[key]}")
+            return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._totals.clear()
+            self._counts.clear()
+            self._tid_names.clear()
+
+
+def _env_enabled() -> bool:
+    return (os.environ.get("FAABRIC_TRACING", "0") == "1"
+            or os.environ.get("FAABRIC_SELF_TRACING", "0") == "1")
+
+
+_tracer: Tracer | None = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _tracer
+    if _tracer is None:
+        with _tracer_lock:
+            if _tracer is None:
+                maxlen = int(os.environ.get("FAABRIC_TRACE_BUFFER", 65536))
+                _tracer = Tracer(_env_enabled(), maxlen)
+    return _tracer
+
+
+# -- module-level conveniences (the API instrumentation sites use) ------
+def span(subsystem: str, label: str, **attrs):
+    return get_tracer().span(subsystem, label, **attrs)
+
+
+def tracing_enabled() -> bool:
+    return get_tracer().enabled()
+
+
+def set_tracing(on: bool) -> None:
+    get_tracer().set_enabled(on)
+
+
+def set_process_label(label: str) -> None:
+    get_tracer().process_label = label
+
+
+def trace_events() -> list[dict]:
+    return get_tracer().trace_events()
+
+
+def chrome_trace() -> dict:
+    return get_tracer().chrome_trace()
+
+
+def chrome_trace_json() -> str:
+    return get_tracer().chrome_trace_json()
+
+
+def text_summary() -> str:
+    return get_tracer().text_summary()
+
+
+def summary_data() -> dict[str, dict]:
+    return get_tracer().summary_data()
+
+
+def reset_tracing() -> None:
+    get_tracer().reset()

@@ -32,6 +32,12 @@ import time
 
 import numpy as np
 
+from faabric_tpu.telemetry import (
+    NULL_SPAN,
+    get_metrics,
+    span,
+    tracing_enabled,
+)
 from faabric_tpu.transport.common import (
     DEFAULT_SOCKET_TIMEOUT,
     resolve_host,
@@ -39,6 +45,38 @@ from faabric_tpu.transport.common import (
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
+
+_metrics = get_metrics()
+_BULK_TX_FRAMES = {
+    path: _metrics.counter(
+        "faabric_bulk_tx_frames_total",
+        "Bulk-plane frames sent", path=path)
+    for path in ("tcp", "shm")
+}
+_BULK_TX_BYTES = {
+    path: _metrics.counter(
+        "faabric_bulk_tx_bytes_total",
+        "Bulk-plane payload bytes sent", path=path)
+    for path in ("tcp", "shm")
+}
+_BULK_RX_FRAMES = {
+    path: _metrics.counter(
+        "faabric_bulk_rx_frames_total",
+        "Bulk-plane frames received", path=path)
+    for path in ("tcp", "shm")
+}
+_BULK_RX_BYTES = {
+    path: _metrics.counter(
+        "faabric_bulk_rx_bytes_total",
+        "Bulk-plane payload bytes received", path=path)
+    for path in ("tcp", "shm")
+}
+_BULK_SEND_SECONDS = {
+    path: _metrics.histogram(
+        "faabric_bulk_send_seconds",
+        "Bulk-plane per-frame send latency", path=path)
+    for path in ("tcp", "shm")
+}
 
 BULK_PORT = 8014
 # Below this the RPC plane wins (no extra connection, lower latency)
@@ -192,6 +230,8 @@ class BulkServer:
                 # np.empty skips the 100 MiB-scale memset a bytearray pays
                 payload = np.empty(nbytes, dtype=np.uint8)
                 _recv_exact_into(conn, memoryview(payload).cast("B"))
+                _BULK_RX_FRAMES["tcp"].inc()
+                _BULK_RX_BYTES["tcp"].inc(nbytes)
                 # Deliver the array itself: it is exclusively owned by
                 # this frame, so the MPI unpack can wrap it without a copy
                 self.broker.deliver(group_id, send_idx, recv_idx,
@@ -254,6 +294,8 @@ class BulkServer:
                     logger.warning("Desynced shm ring %s; abandoning",
                                    ring.name)
                     return
+                _BULK_RX_FRAMES["shm"].inc()
+                _BULK_RX_BYTES["shm"].inc(nbytes)
                 self.broker.deliver((group_hi << 64) | group_lo, send_idx,
                                     recv_idx, payload, seq, channel)
         except Exception:  # noqa: BLE001 — one bad ring, not the server
@@ -390,9 +432,20 @@ class BulkClient:
                 # stall each one the full timeout while holding the
                 # client lock (ADVICE r3). The first push gets a short
                 # leash because an unattached ring can never drain.
-                if ring.push([head, *views],
-                             timeout=2.0 if self.shm_frames == 0 else 5.0):
+                t0 = time.monotonic()
+                # Gate attr construction too: with tracing off, the
+                # per-frame fast path must not even build a kwargs dict
+                with span("transport.bulk", "shm_push", bytes=nbytes,
+                          dest=self.host) if tracing_enabled() \
+                        else NULL_SPAN:
+                    pushed = ring.push(
+                        [head, *views],
+                        timeout=2.0 if self.shm_frames == 0 else 5.0)
+                if pushed:
                     self.shm_frames += 1
+                    _BULK_TX_FRAMES["shm"].inc()
+                    _BULK_TX_BYTES["shm"].inc(nbytes)
+                    _BULK_SEND_SECONDS["shm"].observe(time.monotonic() - t0)
                     return
                 logger.warning("Shm ring for %s stalled; abandoning ring, "
                                "staying on TCP", self.host)
@@ -407,10 +460,17 @@ class BulkClient:
                 ring.close(unlink=True)
                 self._ring = None
                 self._ring_refused = True
+            t0 = time.monotonic()
             try:
-                self._sock.sendall(head)
-                for v in views:
-                    self._sock.sendall(v)
+                with span("transport.bulk", "tcp_send", bytes=nbytes,
+                          dest=self.host) if tracing_enabled() \
+                        else NULL_SPAN:
+                    self._sock.sendall(head)
+                    for v in views:
+                        self._sock.sendall(v)
+                _BULK_TX_FRAMES["tcp"].inc()
+                _BULK_TX_BYTES["tcp"].inc(nbytes)
+                _BULK_SEND_SECONDS["tcp"].observe(time.monotonic() - t0)
             except OSError:
                 # One reconnect attempt (idle reset). A partial frame on
                 # the dead connection is discarded by the receiver with
@@ -431,6 +491,10 @@ class BulkClient:
                     self._sock.sendall(head)
                     for v in views:
                         self._sock.sendall(v)
+                    _BULK_TX_FRAMES["tcp"].inc()
+                    _BULK_TX_BYTES["tcp"].inc(nbytes)
+                    _BULK_SEND_SECONDS["tcp"].observe(
+                        time.monotonic() - t0)
                 except BaseException:
                     # A half-written frame must never linger on a kept
                     # socket — the receiver would splice the NEXT frame

@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any
 
+from faabric_tpu.telemetry import get_metrics
 from faabric_tpu.transport.common import DEFAULT_SOCKET_TIMEOUT, resolve_host
 from faabric_tpu.transport.message import (
     MessageResponseCode,
@@ -24,6 +26,23 @@ from faabric_tpu.transport.message import (
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
+
+_metrics = get_metrics()
+_TX_FRAMES = {
+    plane: _metrics.counter(
+        "faabric_transport_tx_frames_total",
+        "Frames sent on the shared RPC plane", plane=plane)
+    for plane in ("async", "sync")
+}
+_TX_BYTES = {
+    plane: _metrics.counter(
+        "faabric_transport_tx_bytes_total",
+        "Payload bytes sent on the shared RPC plane", plane=plane)
+    for plane in ("async", "sync")
+}
+_RPC_SECONDS = _metrics.histogram(
+    "faabric_transport_rpc_seconds",
+    "Client-side sync RPC round-trip latency")
 
 
 class RpcError(Exception):
@@ -69,6 +88,8 @@ class MessageEndpointClient:
             for attempt in (0, 1):
                 try:
                     send_frame(self._get_sock("async"), msg)
+                    _TX_FRAMES["async"].inc()
+                    _TX_BYTES["async"].inc(len(payload))
                     return
                 except (OSError, TransportError) as e:
                     self._reset_sock("async")
@@ -94,6 +115,7 @@ class MessageEndpointClient:
           between requests).
         """
         msg = TransportMessage(code=code, header=header or {}, payload=payload)
+        t0 = time.monotonic()
         with self._locks["sync"]:
             for attempt in (0, 1):
                 fresh = self._socks["sync"] is None
@@ -102,6 +124,8 @@ class MessageEndpointClient:
                     sock = self._get_sock("sync")
                     send_frame(sock, msg)
                     sent = True
+                    _TX_FRAMES["sync"].inc()
+                    _TX_BYTES["sync"].inc(len(payload))
                     resp = recv_frame(sock)
                     break
                 except (OSError, TransportError) as e:
@@ -118,6 +142,7 @@ class MessageEndpointClient:
                         ) from e
             else:  # pragma: no cover
                 raise RpcError("unreachable")
+        _RPC_SECONDS.observe(time.monotonic() - t0)
         if resp.response_code != int(MessageResponseCode.SUCCESS):
             raise RpcError(
                 f"RPC {code} to {self.host}:{self.sync_port} failed: "

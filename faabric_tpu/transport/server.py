@@ -18,8 +18,16 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any
 
+from faabric_tpu.telemetry import (
+    NULL_METRIC,
+    NULL_SPAN,
+    get_metrics,
+    span,
+    tracing_enabled,
+)
 from faabric_tpu.transport.message import (
     ConnectionClosed,
     MessageResponseCode,
@@ -33,6 +41,35 @@ from faabric_tpu.util.logging import get_logger
 from faabric_tpu.util.queues import Queue
 
 logger = get_logger(__name__)
+
+_metrics = get_metrics()
+_RX_FRAMES = {
+    plane: _metrics.counter(
+        "faabric_transport_rx_frames_total",
+        "Frames received on the shared RPC plane", plane=plane)
+    for plane in ("async", "sync")
+}
+_RX_BYTES = {
+    plane: _metrics.counter(
+        "faabric_transport_rx_bytes_total",
+        "Payload bytes received on the shared RPC plane", plane=plane)
+    for plane in ("async", "sync")
+}
+_TX_FRAMES = _metrics.counter(
+    "faabric_transport_tx_frames_total",
+    "Frames sent on the shared RPC plane", plane="sync-response")
+_TX_BYTES = _metrics.counter(
+    "faabric_transport_tx_bytes_total",
+    "Payload bytes sent on the shared RPC plane", plane="sync-response")
+_HANDLE_SECONDS = {
+    plane: _metrics.histogram(
+        "faabric_transport_handle_seconds",
+        "Server-side request handling latency", plane=plane)
+    for plane in ("async", "sync")
+}
+_QUEUE_DEPTH = _metrics.gauge(
+    "faabric_transport_work_queue_depth",
+    "Async-plane frames queued awaiting a worker thread")
 
 
 class MessageEndpointServer:
@@ -207,8 +244,14 @@ class MessageEndpointServer:
                     break
                 if msg.is_shutdown():
                     break
+                _RX_FRAMES[plane].inc()
+                _RX_BYTES[plane].inc(len(msg.payload))
                 if plane == "async":
                     self._work.enqueue((msg, None))
+                    # size() takes the queue lock — skip it when the
+                    # gauge is a disabled-mode no-op
+                    if _QUEUE_DEPTH is not NULL_METRIC:
+                        _QUEUE_DEPTH.set(self._work.size())
                 else:
                     # Sync requests are handled inline on the connection
                     # thread so responses pair with their requests even with
@@ -223,8 +266,12 @@ class MessageEndpointServer:
                 pass
 
     def _handle_sync(self, msg: TransportMessage, conn: socket.socket) -> None:
+        t0 = time.monotonic()
         try:
-            resp = self.do_sync_recv(msg)
+            # Per-RPC: skip even the kwargs-dict build when tracing is off
+            with span("transport", "sync_handle", server=self.label,
+                      code=msg.code) if tracing_enabled() else NULL_SPAN:
+                resp = self.do_sync_recv(msg)
             if resp is None:
                 resp = TransportMessage(code=msg.code)
             resp.response_code = int(MessageResponseCode.SUCCESS)
@@ -235,8 +282,11 @@ class MessageEndpointServer:
                 header={"error": str(e)},
                 response_code=int(MessageResponseCode.ERROR),
             )
+        _HANDLE_SECONDS["sync"].observe(time.monotonic() - t0)
         try:
             send_frame(conn, resp)
+            _TX_FRAMES.inc()
+            _TX_BYTES.inc(len(resp.payload))
         except OSError:
             pass
         self._fire_request_latch()
@@ -246,10 +296,17 @@ class MessageEndpointServer:
             msg, _ = self._work.dequeue()
             if msg.is_shutdown():
                 return
+            if _QUEUE_DEPTH is not NULL_METRIC:
+                _QUEUE_DEPTH.set(self._work.size())
+            t0 = time.monotonic()
             try:
-                self.do_async_recv(msg)
+                with span("transport", "async_handle", server=self.label,
+                          code=msg.code) if tracing_enabled() \
+                        else NULL_SPAN:
+                    self.do_async_recv(msg)
             except Exception:  # noqa: BLE001
                 logger.exception("%s async handler error", self.label)
+            _HANDLE_SECONDS["async"].observe(time.monotonic() - t0)
             self._fire_request_latch()
 
 

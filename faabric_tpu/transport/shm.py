@@ -28,7 +28,25 @@ import time
 
 import numpy as np
 
+from faabric_tpu.telemetry import NULL_METRIC, get_metrics
 from faabric_tpu.util.native import get_shmring_lib
+
+_metrics = get_metrics()
+_RING_TX_FRAMES = _metrics.counter(
+    "faabric_shm_ring_tx_frames_total", "Frames pushed into shm rings")
+_RING_TX_BYTES = _metrics.counter(
+    "faabric_shm_ring_tx_bytes_total", "Payload bytes pushed into shm rings")
+_RING_RX_FRAMES = _metrics.counter(
+    "faabric_shm_ring_rx_frames_total", "Frames popped from shm rings")
+_RING_RX_BYTES = _metrics.counter(
+    "faabric_shm_ring_rx_bytes_total", "Payload bytes popped from shm rings")
+_RING_PUSH_WAIT = _metrics.histogram(
+    "faabric_shm_ring_push_wait_seconds",
+    "Blocking wait for ring space when the fast-path push found none "
+    "(consumer backpressure)")
+_RING_PUSH_STALLS = _metrics.counter(
+    "faabric_shm_ring_push_stalls_total",
+    "Ring pushes abandoned on timeout (sender fell back to TCP)")
 
 SHM_DIR = "/dev/shm"
 HDR_BYTES = 192
@@ -168,14 +186,27 @@ class ShmRing:
         falls back to TCP). Waits in the kernel on the ring's shared
         futex, woken by the consumer's pops — no polling."""
         if self.try_push(bufs):
+            # Size the frame only when a counter will record it — the
+            # disabled-metrics fast path stays allocation-free
+            if _RING_TX_BYTES is not NULL_METRIC:
+                _RING_TX_FRAMES.inc()
+                _RING_TX_BYTES.inc(
+                    sum(len(memoryview(b).cast("B")) for b in bufs))
             return True
-        need = sum(len(memoryview(b).cast("B")) for b in bufs) + 8
-        deadline = time.monotonic() + timeout
+        nbytes = sum(len(memoryview(b).cast("B")) for b in bufs)
+        need = nbytes + 8
+        t0 = time.monotonic()
+        deadline = t0 + timeout
         while True:
             self._lib.ring_wait_space(self._base, need, 20_000)
             if self.try_push(bufs):
+                _RING_PUSH_WAIT.observe(time.monotonic() - t0)
+                _RING_TX_FRAMES.inc()
+                _RING_TX_BYTES.inc(nbytes)
                 return True
             if time.monotonic() >= deadline:
+                _RING_PUSH_WAIT.observe(time.monotonic() - t0)
+                _RING_PUSH_STALLS.inc()
                 return False
 
     def wait_data(self, timeout_us: int = 20_000) -> bool:
@@ -193,6 +224,8 @@ class ShmRing:
             return None
         out = np.empty(n, np.uint8)
         self._lib.ring_pop(self._base, out.ctypes.data, n)
+        _RING_RX_FRAMES.inc()
+        _RING_RX_BYTES.inc(n)
         return out
 
     def peek(self) -> int:

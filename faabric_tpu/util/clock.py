@@ -1,22 +1,17 @@
 """Wall-clock and timing helpers (reference: include/faabric/util/timing.h).
 
-PROF_START/PROF_END macros become the ``prof`` context manager; totals are
-accumulated per label and dumped with ``prof_summary`` (TRACE_ALL analog,
-enabled via env FAABRIC_SELF_TRACING=1).
+The PROF_START/PROF_END macros' ``prof`` context manager now delegates
+into the telemetry span tracer (faabric_tpu/telemetry/tracer.py): every
+``prof`` label becomes a ``prof/<label>`` span, so legacy call sites
+show up in the Chrome trace and the Prometheus-era summaries without
+change. ``prof_summary`` (the TRACE_ALL analog) returns the tracer's
+text summary — enabled via FAABRIC_TRACING=1 or the legacy
+FAABRIC_SELF_TRACING=1.
 """
 
 from __future__ import annotations
 
-import contextlib
-import os
-import threading
 import time
-from collections import defaultdict
-
-_ENABLED = os.environ.get("FAABRIC_SELF_TRACING", "0") == "1"
-_totals: dict[str, float] = defaultdict(float)
-_counts: dict[str, int] = defaultdict(int)
-_lock = threading.Lock()
 
 
 def get_global_clock_epoch() -> float:
@@ -31,36 +26,26 @@ def now() -> float:
     return time.monotonic()
 
 
-@contextlib.contextmanager
 def prof(label: str):
-    if not _ENABLED:
-        yield
-        return
-    start = time.monotonic()
-    try:
-        yield
-    finally:
-        elapsed = time.monotonic() - start
-        with _lock:
-            _totals[label] += elapsed
-            _counts[label] += 1
+    """Timing bracket; a no-op singleton while tracing is disabled."""
+    from faabric_tpu.telemetry import tracer
+
+    return tracer.span("prof", label)
 
 
 def prof_summary() -> str:
-    with _lock:
-        lines = ["--- PROF summary ---"]
-        for label in sorted(_totals):
-            lines.append(
-                f"{label:<40} total={_totals[label]*1000:.2f}ms n={_counts[label]}"
-            )
-        return "\n".join(lines)
+    from faabric_tpu.telemetry import tracer
+
+    return tracer.text_summary()
 
 
 def prof_reset() -> None:
-    with _lock:
-        _totals.clear()
-        _counts.clear()
+    from faabric_tpu.telemetry import tracer
+
+    tracer.reset_tracing()
 
 
 def is_tracing_enabled() -> bool:
-    return _ENABLED
+    from faabric_tpu.telemetry import tracer
+
+    return tracer.tracing_enabled()

@@ -32,12 +32,31 @@ class ExecGraph:
 
     def to_dict(self) -> dict[str, Any]:
         def _node(n: ExecGraphNode) -> dict[str, Any]:
-            return {"msg": n.msg.to_dict(), "chained": [_node(c) for c in n.children]}
+            return {"msg": n.msg.to_dict(),
+                    "timing": node_timing(n.msg),
+                    "chained": [_node(c) for c in n.children]}
 
         return {"root": _node(self.root)}
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
+
+
+def node_timing(msg: Message) -> dict[str, float]:
+    """Per-node duration summary (milliseconds) from the timing the
+    executor attaches to each result (executor.py _run_task): ``queue``
+    is task-queue wait, ``exec`` the guest run, ``wall`` message creation
+    to finish — the planner-observed end-to-end latency."""
+    out: dict[str, float] = {}
+    details = msg.int_exec_graph_details
+    if "queue_us" in details:
+        out["queue_ms"] = round(details["queue_us"] / 1000.0, 3)
+    if "exec_us" in details:
+        out["exec_ms"] = round(details["exec_us"] / 1000.0, 3)
+    if msg.timestamp and msg.finish_timestamp:
+        out["wall_ms"] = round(
+            max(0.0, msg.finish_timestamp - msg.timestamp) * 1000.0, 3)
+    return out
 
 
 def log_chained_function(parent: Message, chained_msg_id: int) -> None:

@@ -195,30 +195,46 @@ int uffd_start(void* start, uint64_t n_pages, void* flags)
         }
         (void)sink;
     }
+    // Claim and publish the region BEFORE arming write protection
+    // (mirroring segv_start): once the WRITEPROTECT ioctl lands, a
+    // concurrent writer can fault immediately, and the event thread
+    // must find a live region or it resolves the fault without
+    // recording the dirty bit — a silently lost page.
+    int id = -1;
+    {
+        std::lock_guard<std::mutex> lock(g_mu);
+        for (int i = 0; i < MAX_REGIONS; i++) {
+            Region& r = g_regions[i];
+            if (r.active) {
+                continue;
+            }
+            r.start = s;
+            r.n_pages = n_pages;
+            r.flags = static_cast<uint8_t*>(flags);
+            r.active = true;
+            id = i;
+            break;
+        }
+    }
+    if (id < 0) {
+        struct uffdio_range rng = {s, n_pages * PAGE};
+        ioctl(g_fd, UFFDIO_UNREGISTER, &rng);
+        return -4;  // region table full
+    }
     struct uffdio_writeprotect wp;
     wp.range.start = s;
     wp.range.len = n_pages * PAGE;
     wp.mode = UFFDIO_WRITEPROTECT_MODE_WP;
     if (ioctl(g_fd, UFFDIO_WRITEPROTECT, &wp) != 0) {
+        {
+            std::lock_guard<std::mutex> lock(g_mu);
+            g_regions[id].active = false;
+        }
         struct uffdio_range rng = {s, n_pages * PAGE};
         ioctl(g_fd, UFFDIO_UNREGISTER, &rng);
         return -3;
     }
-    std::lock_guard<std::mutex> lock(g_mu);
-    for (int i = 0; i < MAX_REGIONS; i++) {
-        Region& r = g_regions[i];
-        if (r.active) {
-            continue;
-        }
-        r.start = s;
-        r.n_pages = n_pages;
-        r.flags = static_cast<uint8_t*>(flags);
-        r.active = true;
-        return i;
-    }
-    struct uffdio_range rng = {s, n_pages * PAGE};
-    ioctl(g_fd, UFFDIO_UNREGISTER, &rng);
-    return -4;  // region table full
+    return id;
 }
 
 // Clear write protection, unregister and retire the region. 0 on

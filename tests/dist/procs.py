@@ -96,6 +96,30 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
         return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
 
+    def fn_mpi_telemetry(self, msg, req):
+        """12 MiB-per-rank allreduce on its OWN world id, driven by the
+        telemetry acceptance test — worlds persist per worker process,
+        so reusing mpi_big's id would collide with its test."""
+        from faabric_tpu.mpi import MpiOp, get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7510
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        n = (12 << 20) // 4
+        out = world.allreduce(rank, np.full(n, rank + 1, np.int32),
+                              MpiOp.SUM)
+        world.barrier(rank)
+        ok = bool((out == 36).all())
+        msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
+        return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
+
     def fn_mpi_reduce_many(self, msg, req):
         """Port of the reference example mpi_reduce_many
         (tests/dist/mpi/examples/mpi_reduce_many.cpp): 100 back-to-back
@@ -768,8 +792,18 @@ def run_planner(port_offset: int = 0) -> None:
 
     server = PlannerServer(port_offset=port_offset)
     server.start()
+    endpoint = None
+    http_port = int(os.environ.get("DIST_HTTP_PORT", "0"))
+    if http_port:
+        # REST surface for the telemetry tests: GET /metrics + /trace
+        from faabric_tpu.endpoint import PlannerHttpEndpoint
+
+        endpoint = PlannerHttpEndpoint(port=http_port)
+        endpoint.start()
     print("READY", flush=True)
     time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
+    if endpoint is not None:
+        endpoint.stop()
     server.stop()
 
 

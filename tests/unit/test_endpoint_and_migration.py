@@ -307,6 +307,51 @@ def test_rest_in_flight_and_evict(cluster, endpoint):
     assert status == 200
 
 
+def test_rest_metrics_and_trace(cluster, endpoint):
+    """GET /metrics serves Prometheus-parseable text aggregating every
+    registered host's registry; GET /trace serves chrome-trace JSON."""
+    import re
+
+    from faabric_tpu.telemetry import set_tracing, span
+
+    # Traffic so counters are non-zero, plus one span for the trace
+    req = batch_exec_factory("demo", "echo", 2)
+    status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH,
+                       json.dumps(req.to_dict()))
+    assert status == 200
+    set_tracing(True)
+    try:
+        with span("test", "rest_trace_probe", n=1):
+            pass
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{endpoint}/trace", timeout=10) as resp:
+            assert resp.status == 200
+            trace = json.loads(resp.read())
+    finally:
+        set_tracing(False)
+    assert any(e.get("name") == "rest_trace_probe"
+               for e in trace["traceEvents"])
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{endpoint}/metrics", timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9.eE+-]+$')
+    lines = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert lines
+    for line in lines:
+        assert sample_re.match(line), f"unparseable: {line!r}"
+    # The in-process cluster shares one registry; every registered host
+    # (and the planner itself) appears as a host label over it
+    for host in ("hostA", "hostB", "planner"):
+        assert f'host="{host}"' in text
+    assert "faabric_transport_tx_bytes_total" in text
+    assert "faabric_planner_schedule_seconds_bucket" in text
+
+
 def test_rest_bad_requests(cluster, endpoint):
     status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH, "{}")
     assert status == 400

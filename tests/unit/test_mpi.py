@@ -263,6 +263,62 @@ def test_reduce_scatter_and_allgather_ring(world_size, monkeypatch):
     broker.clear()
 
 
+def test_allreduce_emits_phase_spans(mpi_cluster):
+    """ISSUE 1: every rank's allreduce produces one mpi/allreduce span
+    decomposed into named mpi.phase child spans (tree path: reduce +
+    broadcast), and the per-op collective counters advance."""
+    from faabric_tpu.telemetry import (
+        get_metrics,
+        reset_tracing,
+        set_tracing,
+        snapshot_delta,
+        trace_events,
+    )
+
+    before = get_metrics().snapshot()
+    set_tracing(True)
+    reset_tracing()
+    try:
+        datas = {r: np.full(200_000, float(r), np.float64) for r in range(6)}
+
+        def fn(world, rank):
+            return world.allreduce(rank, datas[rank], MpiOp.SUM)
+
+        results = run_ranks(mpi_cluster, fn)
+        expected = sum(datas.values())
+        for rank in range(6):
+            np.testing.assert_allclose(results[rank], expected)
+
+        events = [e for e in trace_events() if e.get("ph") == "X"]
+        allreduces = [e for e in events if e["cat"] == "mpi"
+                      and e["name"] == "allreduce"]
+        assert len(allreduces) == 6  # one span per rank
+        phases = [e for e in events if e["cat"] == "mpi.phase"]
+        for ar in allreduces:
+            assert ar["args"]["algo"] in ("tree", "ring")
+            lo, hi = ar["ts"], ar["ts"] + ar["dur"]
+            mine = [p for p in phases if p["tid"] == ar["tid"]
+                    and p["ts"] >= lo - 1 and p["ts"] + p["dur"] <= hi + 1]
+            names = {p["name"] for p in mine}
+            if ar["args"]["algo"] == "tree":
+                assert {"reduce", "broadcast"} <= names, names
+            else:
+                assert {"reduce_scatter", "allgather"} <= names, names
+            assert all(p["args"]["parent"] == "mpi/allreduce" for p in mine)
+            # The phases, not the dispatch glue, account for the span
+            covered = sum(p["dur"] for p in mine)
+            assert covered >= 0.5 * ar["dur"], (covered, ar["dur"])
+    finally:
+        reset_tracing()
+        set_tracing(False)
+
+    delta = snapshot_delta(before, get_metrics().snapshot())
+    assert delta.get('faabric_mpi_collectives_total{op="allreduce"}') == 6
+    assert delta.get(
+        'faabric_mpi_collective_bytes_total{op="allreduce"}') == \
+        6 * 200_000 * 8
+
+
 def test_reduce_to_nonzero_root(mpi_cluster):
     expected = sum(per_rank_data(r) for r in range(6))
 

@@ -1,0 +1,280 @@
+"""Telemetry layer: metrics registry semantics, span tracer nesting,
+disabled-mode fast paths, and export formats (Prometheus text exposition,
+Chrome trace_event JSON)."""
+
+import json
+import math
+import threading
+
+import pytest
+
+from faabric_tpu.telemetry import (
+    DEFAULT_BUCKETS,
+    NULL_METRIC,
+    NULL_SPAN,
+    MetricsRegistry,
+    get_metrics,
+    get_tracer,
+    metrics_enabled,
+    render_snapshots,
+    reset_tracing,
+    set_metrics_enabled,
+    set_tracing,
+    snapshot_delta,
+    span,
+    trace_events,
+    tracing_enabled,
+)
+from faabric_tpu.telemetry.metrics import _label_str
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", route="a")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotonic
+
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    assert g.value == 8
+
+
+def test_same_labels_same_handle_different_labels_new_series():
+    reg = MetricsRegistry()
+    a = reg.counter("t_frames_total", path="tcp")
+    b = reg.counter("t_frames_total", path="tcp")
+    c = reg.counter("t_frames_total", path="shm")
+    assert a is b
+    assert a is not c
+    a.inc(3)
+    c.inc(1)
+    snap = reg.snapshot()
+    rows = {tuple(sorted(r["labels"].items())): r["value"]
+            for r in snap["t_frames_total"]["series"]}
+    assert rows[(("path", "tcp"),)] == 3
+    assert rows[(("path", "shm"),)] == 1
+
+
+def test_type_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("t_thing")
+    with pytest.raises(ValueError):
+        reg.gauge("t_thing")
+
+
+def test_histogram_bucket_boundaries():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005)   # bucket 0
+    h.observe(0.01)    # le is INCLUSIVE: still bucket 0
+    h.observe(0.02)    # bucket 1
+    h.observe(0.5)     # bucket 2
+    h.observe(5.0)     # overflow: +Inf only
+    assert h.counts == [2, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.005 + 0.01 + 0.02 + 0.5 + 5.0)
+
+    # Prometheus render is CUMULATIVE with a trailing +Inf bucket
+    text = reg.render_prometheus()
+    assert 't_lat_seconds_bucket{le="0.01"} 2' in text
+    assert 't_lat_seconds_bucket{le="0.1"} 3' in text
+    assert 't_lat_seconds_bucket{le="1"} 4' in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_lat_seconds_count 5" in text
+
+
+def test_default_buckets_ascending():
+    assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+    assert all(b > 0 and math.isfinite(b) for b in DEFAULT_BUCKETS)
+
+
+def test_concurrent_increments_do_not_lose_updates():
+    reg = MetricsRegistry()
+    c = reg.counter("t_par_total")
+    h = reg.histogram("t_par_seconds", buckets=(1.0,))
+    n, iters = 8, 2000
+
+    def worker():
+        for _ in range(iters):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n * iters
+    assert h.count == n * iters
+    assert h.counts[0] == n * iters
+
+
+def test_disabled_mode_returns_shared_noop_handle():
+    assert metrics_enabled()  # default-on in this process
+    set_metrics_enabled(False)
+    try:
+        reg = MetricsRegistry()
+        c = reg.counter("t_off_total")
+        g = reg.gauge("t_off_depth")
+        h = reg.histogram("t_off_seconds")
+        # One shared singleton — the zero-allocation fast path
+        assert c is NULL_METRIC and g is NULL_METRIC and h is NULL_METRIC
+        c.inc()
+        g.set(3)
+        h.observe(1.0)  # all no-ops
+        assert reg.snapshot() == {}
+    finally:
+        set_metrics_enabled(True)
+
+
+def test_get_metrics_is_a_singleton():
+    assert get_metrics() is get_metrics()
+
+
+# ---------------------------------------------------------------------------
+# Export: multi-host merge + deltas
+# ---------------------------------------------------------------------------
+
+def test_render_snapshots_merges_hosts_under_host_label():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.counter("t_tx_bytes_total", "bytes", plane="sync").inc(10)
+    r2.counter("t_tx_bytes_total", "bytes", plane="sync").inc(32)
+    text = render_snapshots({"w1": r1.snapshot(), "w2": r2.snapshot()})
+    assert text.count("# TYPE t_tx_bytes_total counter") == 1
+    assert 't_tx_bytes_total{host="w1",plane="sync"} 10' in text
+    assert 't_tx_bytes_total{host="w2",plane="sync"} 32' in text
+
+
+def test_label_escaping():
+    assert _label_str({"f": 'a"b\\c'}) == '{f="a\\"b\\\\c"}'
+
+
+def test_snapshot_delta_counters_and_histograms():
+    reg = MetricsRegistry()
+    c = reg.counter("t_d_total", op="x")
+    h = reg.histogram("t_d_seconds", buckets=(1.0,))
+    c.inc(5)
+    h.observe(0.25)
+    before = reg.snapshot()
+    c.inc(7)
+    h.observe(0.5)
+    h.observe(0.25)
+    delta = snapshot_delta(before, reg.snapshot())
+    assert delta['t_d_total{op="x"}'] == 7
+    assert delta["t_d_seconds_count"] == 2
+    assert delta["t_d_seconds_sum"] == pytest.approx(0.75)
+    # Unchanged series do not appear
+    assert snapshot_delta(reg.snapshot(), reg.snapshot()) == {}
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tracing():
+    was = tracing_enabled()
+    set_tracing(True)
+    reset_tracing()
+    yield get_tracer()
+    reset_tracing()
+    set_tracing(was)
+
+
+def test_disabled_span_is_shared_noop():
+    assert not tracing_enabled()  # default-off in the test process
+    reset_tracing()  # other tests may have left recorded spans behind
+    s = span("mpi", "allreduce", bytes=1024)
+    assert s is NULL_SPAN
+    with s:
+        pass  # no-op, no recording
+    assert [e for e in trace_events() if e.get("ph") == "X"] == []
+
+
+def test_span_nesting_records_parent(tracing):
+    with span("mpi", "allreduce", rank=0):
+        with span("mpi.phase", "reduce", rank=0):
+            pass
+        with span("mpi.phase", "broadcast", rank=0):
+            pass
+    events = [e for e in trace_events() if e.get("ph") == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"allreduce", "reduce", "broadcast"}
+    for phase in ("reduce", "broadcast"):
+        assert by_name[phase]["args"]["parent"] == "mpi/allreduce"
+        # Child interval sits inside the parent's
+        p, c = by_name["allreduce"], by_name[phase]
+        assert c["ts"] >= p["ts"] - 1e-3
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-3
+
+
+def test_span_nesting_is_thread_isolated(tracing):
+    """Two threads nest independently: neither sees the other's span as
+    its parent (contextvars give each thread an empty stack)."""
+    barrier = threading.Barrier(2)
+
+    def worker(label):
+        with span("t", f"outer-{label}"):
+            barrier.wait(timeout=5)
+            with span("t", f"inner-{label}"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = {e["name"]: e for e in trace_events() if e.get("ph") == "X"}
+    assert events["inner-0"]["args"]["parent"] == "t/outer-0"
+    assert events["inner-1"]["args"]["parent"] == "t/outer-1"
+    assert events["inner-0"]["tid"] != events["inner-1"]["tid"]
+
+
+def test_chrome_trace_json_schema(tracing):
+    with span("transport", "sync_handle", code=7):
+        pass
+    doc = json.loads(tracing.chrome_trace_json())
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    # Metadata records name the process and threads
+    metas = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    assert any(e["name"] == "thread_name" for e in metas)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 1
+    e = xs[0]
+    assert e["name"] == "sync_handle" and e["cat"] == "transport"
+    assert e["args"]["code"] == 7
+    assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+    assert e["dur"] >= 0
+    assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+
+
+def test_text_summary_and_totals(tracing):
+    for _ in range(3):
+        with span("prof", "step"):
+            pass
+    data = tracing.summary_data()
+    assert data["prof/step"]["count"] == 3
+    assert data["prof/step"]["total_s"] >= 0
+    text = tracing.text_summary()
+    assert "prof/step" in text and "n=3" in text
+
+
+def test_clock_prof_delegates_into_tracer(tracing):
+    from faabric_tpu.util.clock import is_tracing_enabled, prof, prof_summary
+
+    assert is_tracing_enabled()
+    with prof("legacy.label"):
+        pass
+    assert tracing.summary_data()["prof/legacy.label"]["count"] == 1
+    assert "prof/legacy.label" in prof_summary()
