@@ -390,6 +390,123 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
         clear_host_aliases()
 
 
+def bench_robustness(quick: bool = False) -> dict:
+    """ISSUE 2 robustness section: recovery latency under worker loss.
+
+    Stands up a real planner + 2 worker PROCESSES (tests/dist/procs.py),
+    spreads a sleep batch over both, SIGKILLs one worker mid-batch and
+    measures kill → batch-complete: keep-alive expiry detection + the
+    planner's requeue-with-backoff onto the survivor + re-execution.
+    Also measures the disabled fault-point hot-path cost (the shared
+    no-op handle) so regressions in the "faults off" overhead are
+    caught by the round-over-round JSON."""
+    import signal
+    import subprocess
+    import timeit
+
+    from faabric_tpu.faults import NULL_FAULT
+    from faabric_tpu.transport.common import clear_host_aliases
+    from faabric_tpu.util.config import get_system_config
+
+    # Disabled-path overhead: one fire() on the shared no-op handle
+    n = 200_000
+    noop_ns = timeit.timeit(NULL_FAULT.fire, number=n) / n * 1e9
+
+    procs_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tests", "dist", "procs.py")
+    b = random.randint(10, 120) * 100
+    aliases = (f"rbpl=127.0.0.1+{b},rbw0=127.0.0.1+{b + 2500},"
+               f"rbw1=127.0.0.1+{b + 5000},rbcli=127.0.0.1+{b + 7500}")
+    knobs = {"PLANNER_HOST_TIMEOUT": "3", "PLANNER_REQUEUE_BACKOFF": "0.3",
+             "PLANNER_MAX_REQUEUES": "5"}
+    env = {**os.environ, "FAABRIC_HOST_ALIASES": aliases,
+           "JAX_PLATFORMS": "cpu", **knobs}
+    saved = {k: os.environ.get(k)
+             for k in ["FAABRIC_HOST_ALIASES", *knobs]}
+    os.environ.update({"FAABRIC_HOST_ALIASES": aliases, **knobs})
+    clear_host_aliases()
+    get_system_config().reset()
+
+    children = []
+
+    def spawn(*args):
+        p = subprocess.Popen([sys.executable, procs_py, *args],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, text=True, env=env)
+        children.append(p)
+        while True:  # log lines may precede READY
+            line = p.stdout.readline()
+            assert line, f"bench child {args} died before READY"
+            if line.strip() == "READY":
+                return p
+
+    me = None
+    try:
+        spawn("planner", str(b))
+        spawn("worker", "rbw0", "rbpl", "8")
+        victim = spawn("worker", "rbw1", "rbpl", "4")
+
+        from faabric_tpu.executor import ExecutorFactory
+        from faabric_tpu.proto import ReturnValue, batch_exec_factory
+        from faabric_tpu.runner import WorkerRuntime
+
+        class NullFactory(ExecutorFactory):
+            def create_executor(self, msg):
+                raise RuntimeError("client runs nothing")
+
+        me = WorkerRuntime(host="rbcli", slots=0, factory=NullFactory(),
+                           planner_host="rbpl")
+        me.start()
+
+        task_s = 1.0 if quick else 2.5
+        req = batch_exec_factory("dist", "sleep", 12)
+        for m in req.messages:
+            m.input_data = str(task_s).encode()
+        decision = me.planner_client.call_functions(req)
+        n_on_victim = sum(1 for h in decision.hosts if h == "rbw1")
+        assert n_on_victim, decision.hosts
+
+        time.sleep(0.5)
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=5)
+        t_kill = time.perf_counter()
+
+        deadline = time.time() + 90
+        status = me.planner_client.get_batch_results(req.app_id)
+        while not status.finished and time.time() < deadline:
+            time.sleep(0.1)
+            status = me.planner_client.get_batch_results(req.app_id)
+        kill_to_complete = time.perf_counter() - t_kill
+        ok = status.finished and all(
+            m.return_value == int(ReturnValue.SUCCESS)
+            for m in status.message_results)
+        return {
+            "kill_to_complete_s": round(kill_to_complete, 3),
+            "recovered_messages": n_on_victim,
+            "n_messages": 12, "task_s": task_s,
+            "host_timeout_s": 3.0, "requeue_backoff_s": 0.3,
+            "all_success": ok,
+            "noop_fault_point_ns": round(noop_ns, 1),
+        }
+    finally:
+        if me is not None:
+            me.shutdown()
+        for p in children:
+            p.terminate()
+        for p in children:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_host_aliases()
+        get_system_config().reset()
+
+
 def _sendrecv_sizes() -> list[int]:
     """Reference mpi_send_recv.cpp workload shape (mpi_bench.cpp:18-57):
     a 'small' burst of 1000×8-int messages plus a ResNet-50-scale mix of
@@ -1421,6 +1538,7 @@ def main() -> None:
     host_section("host_allreduce_procs", lambda: bench_host_allreduce_procs(
         elems=1_000_000 if quick else 25_500_000,
         rounds=1 if quick else 3))
+    host_section("robustness", lambda: bench_robustness(quick))
 
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
         # Device phase: TPU first with per-section watchdogs; CPU tiny

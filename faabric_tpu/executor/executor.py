@@ -21,6 +21,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from faabric_tpu.executor.context import ExecutorContext
+from faabric_tpu.faults import fault_point, faults_enabled
 from faabric_tpu.proto import (
     BatchExecuteRequest,
     BatchExecuteType,
@@ -44,6 +45,9 @@ if TYPE_CHECKING:  # pragma: no cover
 logger = get_logger(__name__)
 
 POOL_SHUTDOWN = -1
+
+_FAULTS = faults_enabled()
+_FP_RUN = fault_point("executor.run")
 
 _metrics = get_metrics()
 _QUEUE_WAIT_SECONDS = _metrics.histogram(
@@ -284,6 +288,11 @@ class Executor:
         ExecutorContext.set(self, req, task.msg_idx)
         run_t0 = time.monotonic()
         try:
+            if _FAULTS:
+                # delay rules make stragglers; raise rules fail the task
+                # (the generic handler below folds it into the result)
+                _FP_RUN.fire(function=f"{msg.user}/{msg.function}",
+                             msg_id=msg.id)
             with span("executor", "execute_task", msg_id=msg.id,
                       function=f"{msg.user}/{msg.function}") \
                     if tracing_enabled() else NULL_SPAN:

@@ -11,7 +11,7 @@ from faabric_tpu.mpi.types import (
     np_dtype_for,
 )
 from faabric_tpu.mpi.window import MpiWindow
-from faabric_tpu.mpi.world import MAIN_RANK, MpiWorld
+from faabric_tpu.mpi.world import MAIN_RANK, MpiWorld, MpiWorldAborted
 from faabric_tpu.mpi.registry import MpiContext, MpiWorldRegistry, get_mpi_context
 
 __all__ = [
@@ -23,6 +23,7 @@ __all__ = [
     "MpiStatus",
     "MpiWindow",
     "MpiWorld",
+    "MpiWorldAborted",
     "MpiWorldRegistry",
     "UserOp",
     "apply_op",

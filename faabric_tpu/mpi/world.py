@@ -41,13 +41,25 @@ from faabric_tpu.mpi.types import (
     pack_mpi_payload,
     unpack_mpi_payload,
 )
+from faabric_tpu.faults import fault_point, faults_enabled
 from faabric_tpu.telemetry import get_metrics, span
 from faabric_tpu.transport.bulk import MAX_FRAME_BYTES
+from faabric_tpu.transport.point_to_point import GroupAbortedError
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
 
 MAIN_RANK = 0
+
+# The MPI-facing name for a group abort: recv/barrier/collectives raise
+# this within ~one liveness-check interval (mpi_abort_check_seconds)
+# when a peer's host is dead or a send to it failed terminally — the
+# transport layer detects and broadcasts the abort (point_to_point.py),
+# this is simply its MPI-domain name.
+MpiWorldAborted = GroupAbortedError
+
+_FAULTS = faults_enabled()
+_FP_COLLECTIVE = fault_point("mpi.collective")
 
 # Ring paths send whole segments as SINGLE bulk-plane messages (the
 # zero-copy ownership protocol cannot chunk them); a frame above the
@@ -62,6 +74,10 @@ _coll_bytes: dict = {}
 
 
 def _count_collective(op: str, nbytes: int) -> None:
+    if _FAULTS:
+        # One chaos choke point covering every host-path collective:
+        # delay rules add straggler latency, raise rules fail the rank
+        _FP_COLLECTIVE.fire(op=op, bytes=nbytes)
     c = _coll_total.get(op)
     b = _coll_bytes.get(op)
     if c is None or b is None:
@@ -190,6 +206,22 @@ class MpiWorld:
         self._send_workers: dict[int, _SendWorker] = {}
         self._in_send_pool = threading.local()
         self._split_seq = 0  # split-generation draws (see _split_draw)
+
+        # Bounded-time failure propagation: register with the broker so
+        # recvs blocked on this world probe peer liveness and raise
+        # MpiWorldAborted instead of hanging to the socket timeout
+        # (guarded: some unit tests drive worlds with stub brokers)
+        watch = getattr(broker, "watch_group", None)
+        if watch is not None:
+            watch(group_id)
+
+    def abort(self, reason: str = "MPI_Abort") -> None:
+        """Abort the world: every rank's blocked/future recv, barrier or
+        collective on it raises MpiWorldAborted. Idempotent; callable
+        from any rank or from the runtime when it learns a peer died."""
+        abort = getattr(self.broker, "abort_group", None)
+        if abort is not None:
+            abort(self.group_id, reason)
 
     # ------------------------------------------------------------------
     # Topology
@@ -1467,6 +1499,9 @@ class MpiWorld:
             self._rank_hosts.clear()
             self._local_leader_cache.clear()
             self._device_collectives = None
+        watch = getattr(self.broker, "watch_group", None)
+        if watch is not None:
+            watch(self.group_id)  # liveness checking follows the new gid
 
     # ------------------------------------------------------------------
     def exec_graph_details(self) -> dict[str, int]:

@@ -16,6 +16,7 @@ import threading
 from typing import Optional
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.faults import SUPPRESS, fault_point, faults_enabled
 from faabric_tpu.planner.server import PlannerCalls
 from faabric_tpu.proto import (
     BatchExecuteRequest,
@@ -35,6 +36,9 @@ from faabric_tpu.util.periodic import PeriodicBackgroundThread
 from faabric_tpu.util.testing import is_mock_mode
 
 logger = get_logger(__name__)
+
+_FAULTS = faults_enabled()
+_FP_KEEPALIVE = fault_point("keepalive")
 
 # ---------------------------------------------------------------------------
 # Mock recording
@@ -68,7 +72,13 @@ class KeepAliveThread(PeriodicBackgroundThread):
         self.n_devices = n_devices
 
     def do_work(self) -> None:
-        self.client.register_host(self.slots, self.n_devices)
+        if _FAULTS and _FP_KEEPALIVE.fire(
+                host=self.client.this_host) is SUPPRESS:
+            # Injected keep-alive loss: the planner expires this (alive)
+            # host — the chaos recipe for exercising expiry recovery and
+            # the rejoin path without killing a process
+            return
+        self.client.register_host(self.slots, self.n_devices, rejoin=True)
 
 
 class PlannerClient(MessageEndpointClient):
@@ -104,12 +114,28 @@ class PlannerClient(MessageEndpointClient):
         return bool(resp.header.get("pong"))
 
     def register_host(self, slots: int, n_devices: int = 0,
-                      overwrite: bool = False, start_keep_alive: bool = False) -> float:
+                      overwrite: bool = False, start_keep_alive: bool = False,
+                      rejoin: bool = False) -> float:
         resp = self.sync_send(int(PlannerCalls.REGISTER_HOST), {
             "host": self.this_host, "slots": slots,
             "n_devices": n_devices, "overwrite": overwrite,
         }, idempotent=True)
         timeout = float(resp.header.get("host_timeout", 30.0))
+        if rejoin and not overwrite and not resp.header.get("known", True):
+            # Keep-alive found us UNKNOWN to the planner: we expired off
+            # the registry (paused past the timeout, partitioned, or the
+            # planner restarted) while staying alive. Re-register with
+            # overwrite=True so the planner treats this as a boot and
+            # drops any pooled connections to our assumed-dead
+            # incarnation — otherwise we stay invisible forever while
+            # dutifully keep-aliving a registry entry that isn't there.
+            logger.warning(
+                "Host %s was expired/unknown at the planner; rejoining",
+                self.this_host)
+            self.sync_send(int(PlannerCalls.REGISTER_HOST), {
+                "host": self.this_host, "slots": slots,
+                "n_devices": n_devices, "overwrite": True,
+            }, idempotent=True)
         if start_keep_alive and self._keep_alive is None:
             self._keep_alive = KeepAliveThread(self, slots, n_devices)
             self._keep_alive.start(max(0.5, timeout / 2))

@@ -45,6 +45,7 @@ from faabric_tpu.proto import (
     ReturnValue,
     update_batch_exec_group_id,
 )
+from faabric_tpu.faults import DROP, fault_point, faults_enabled
 from faabric_tpu.telemetry import get_metrics, span
 from faabric_tpu.transport.common import MPI_BASE_PORT, MPI_PORTS_PER_HOST
 from faabric_tpu.util.config import get_system_config
@@ -52,6 +53,9 @@ from faabric_tpu.util.gids import generate_gid
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
+
+_FAULTS = faults_enabled()
+_FP_DISPATCH = fault_point("planner.dispatch")
 
 _metrics = get_metrics()
 _SCHEDULE_SECONDS = _metrics.histogram(
@@ -70,6 +74,19 @@ _RESULT_ROUNDTRIP = _metrics.histogram(
     "faabric_planner_result_roundtrip_seconds",
     "Message creation to result recorded at the planner (wall clocks of "
     "the submitting host and the planner: cross-machine skew shifts it)")
+_REQUEUES_TOTAL = _metrics.counter(
+    "faabric_planner_requeues_total",
+    "Recovery requeues performed (one per affected app per failure)")
+_REQUEUED_MESSAGES = _metrics.counter(
+    "faabric_planner_requeued_messages_total",
+    "Messages moved to surviving hosts by recovery requeues")
+_RETRY_EXHAUSTED = _metrics.counter(
+    "faabric_planner_retry_exhausted_total",
+    "Messages terminally failed after the requeue budget ran out")
+_RECOVERY_SECONDS = _metrics.histogram(
+    "faabric_planner_recovery_seconds",
+    "Failure detection to requeued messages re-dispatched (includes the "
+    "backoff delay)")
 
 
 class PlannerHost:
@@ -125,6 +142,9 @@ class Planner:
         self._completed_order: list[int] = []
         # (app_id, msg_id) → hosts to push the result to
         self._waiters: dict[tuple[int, int], set[str]] = {}
+        # app_id → recovery requeues already spent (bounded by
+        # conf.planner_max_requeues; cleared when the app completes)
+        self._requeue_attempts: dict[int, int] = {}
         # app_id → decision preloaded via REST/tests
         self._preloaded: dict[int, SchedulingDecision] = {}
         # app_id → frozen request (spot eviction)
@@ -194,6 +214,13 @@ class Planner:
             self._snapshot_clients.drop(ip)
         return conf.planner_host_timeout
 
+    def is_host_registered(self, ip: str) -> bool:
+        """Whether the host currently exists in the registry — the bit a
+        keep-alive response carries so an expired-but-alive worker can
+        detect it fell out and rejoin with overwrite=True."""
+        with self._lock:
+            return ip in self._hosts
+
     def remove_host(self, ip: str) -> None:
         with self._lock:
             self._hosts.pop(ip, None)
@@ -201,7 +228,7 @@ class Planner:
     def expire_hosts(self) -> None:
         conf = get_system_config()
         now = time.monotonic()
-        doomed: list[Message] = []
+        doomed: dict[int, list[Message]] = {}
         with self._lock:
             stale = [ip for ip, h in self._hosts.items()
                      if now - h.register_ts > conf.planner_host_timeout]
@@ -209,45 +236,30 @@ class Planner:
                 logger.warning("Expiring host %s (no keep-alive)", ip)
                 del self._hosts[ip]
             if stale:
-                # A dead worker cannot report results: fail its in-flight
-                # messages so batch waiters unblock instead of hanging
-                # forever (dispatch is async fire-and-forget — a write
-                # onto a pooled connection to a just-killed process can
-                # "succeed" into the kernel buffer, so dispatch-time
-                # error handling alone cannot catch this)
+                # A dead worker cannot report results: recover its
+                # in-flight messages so batch waiters unblock instead of
+                # hanging forever (dispatch is async fire-and-forget — a
+                # write onto a pooled connection to a just-killed
+                # process can "succeed" into the kernel buffer, so
+                # dispatch-time error handling alone cannot catch this)
                 stale_set = set(stale)
                 for app_id, (req, decision) in self._in_flight.items():
                     for i, h in enumerate(decision.hosts):
                         if h in stale_set:
                             mid = decision.message_ids[i]
-                            doomed.extend(m for m in req.messages
-                                          if m.id == mid)
+                            doomed.setdefault(app_id, []).extend(
+                                m for m in req.messages if m.id == mid)
         if doomed:
             # expire_hosts runs under callers' locks (_policy_host_map);
-            # set_message_result re-enters the RLock and pushes to result
-            # waiters over the network — defer to a thread so no network
-            # I/O ever happens under the planner lock
-            def _fail_expired(msgs=doomed):
-                for m in msgs:
-                    # A host that was merely SLOW (paused past the
-                    # keep-alive timeout, then resumed) may have reported
-                    # a genuine result between collection and now. This
-                    # pre-check just skips the obvious cases; the
-                    # authoritative guard is set_message_result's
-                    # first-write-wins check, which closes the remaining
-                    # check-then-act window under one lock hold.
-                    with self._lock:
-                        if m.id in self._results.get(m.app_id, {}):
-                            continue
-                    m.return_value = int(ReturnValue.FAILED)
-                    m.output_data = b"Host expired"
-                    try:
-                        self.set_message_result(m)
-                    except Exception:  # noqa: BLE001
-                        logger.exception("Failing expired-host msg %d", m.id)
-
-            threading.Thread(target=_fail_expired, name="expiry-fail",
-                             daemon=True).start()
+            # recovery re-enters the RLock and pushes over the network —
+            # defer to a thread so no network I/O ever happens under the
+            # planner lock. One thread per affected app: their backoffs
+            # must not serialize behind each other.
+            for app_id, msgs in doomed.items():
+                threading.Thread(
+                    target=self._recover_messages,
+                    args=(app_id, msgs, b"Host expired"),
+                    name=f"recover-{app_id}", daemon=True).start()
 
     def get_available_hosts(self) -> list[HostState]:
         self.expire_hosts()
@@ -641,6 +653,214 @@ class Planner:
                 host.release_mpi_port(decision.mpi_ports[i])
             host.release_device(decision.device_ids[i])
 
+    # ------------------------------------------------------------------
+    # Automatic recovery: requeue-with-backoff (the planner is the
+    # cluster's single recovery authority — worker loss mid-batch moves
+    # the affected messages to survivors under a per-app retry budget
+    # instead of terminally failing them)
+    # ------------------------------------------------------------------
+    def _fail_messages(self, msgs: list[Message], reason: bytes) -> None:
+        """Terminal path: record FAILED results so batch waiters
+        unblock. First-write-wins in set_message_result still protects a
+        genuine late result racing this."""
+        for m in msgs:
+            with self._lock:
+                if m.id in self._results.get(m.app_id, {}):
+                    continue
+            m.return_value = int(ReturnValue.FAILED)
+            m.output_data = reason
+            try:
+                self.set_message_result(m)
+            except Exception:  # noqa: BLE001
+                logger.exception("Failing msg %d", m.id)
+
+    def _recover_messages(self, app_id: int, msgs: list[Message],
+                          reason: bytes) -> None:
+        """Recovery state machine entry (runs on its own thread, never
+        under the planner lock's callers):
+
+        ``failed`` → (budget left, app retryable) → backoff → ``requeue``
+        onto surviving hosts → re-dispatch; otherwise → terminal FAILED.
+
+        MPI batches are not requeued: a world's collective state dies
+        with its ranks — surviving ranks get a bounded MpiWorldAborted
+        from the transport layer instead, and the guest (or its
+        checkpoint/restore loop) owns the restart. THREADS batches
+        requeue naturally: dispatch re-pushes the app's registered
+        snapshot to the new host before the tasks restore."""
+        t_detect = time.monotonic()
+        conf = get_system_config()
+        with self._lock:
+            msgs = [m for m in msgs
+                    if m.id not in self._results.get(app_id, {})]
+            if not msgs:
+                return
+            record = self._in_flight.get(app_id)
+            in_flight = record is not None
+            used = self._requeue_attempts.get(app_id, 0)
+            # MPI detection must scan the WHOLE app, not just the doomed
+            # subset: the root message of an MPI batch often has
+            # is_mpi=False on the planner's copy (it is set worker-side
+            # during create_world) — but the scale-up rank messages the
+            # world chained through us carry it, so once a world exists
+            # anywhere, the app reads as MPI here. A root that died
+            # BEFORE chaining its ranks has no world to corrupt and may
+            # requeue like any plain function.
+            app_is_mpi = in_flight and any(m.is_mpi
+                                           for m in record[0].messages)
+            retryable = (in_flight and not app_is_mpi
+                         and not any(m.is_mpi for m in msgs)
+                         and used < conf.planner_max_requeues)
+            if retryable:
+                self._requeue_attempts[app_id] = used + 1
+        if not retryable:
+            if in_flight and used >= conf.planner_max_requeues:
+                _RETRY_EXHAUSTED.inc(len(msgs))
+                logger.warning(
+                    "Requeue budget (%d) exhausted for app %d; failing "
+                    "%d msgs", conf.planner_max_requeues, app_id, len(msgs))
+            self._fail_messages(msgs, reason)
+            return
+        # Exponential backoff + jitter before re-placing: an immediate
+        # requeue would race the failure that displaced us (a flapping
+        # host re-registering, a planner-side connection reset) and
+        # synchronized retries from many apps would stampede survivors
+        time.sleep(self._requeue_delay(used))
+        self._requeue(app_id, msgs, t_detect, reason)
+
+    @staticmethod
+    def _requeue_delay(used: int) -> float:
+        """One schedule implementation for all recovery backoff: the
+        transport clients' RetryPolicy with the planner's base knob."""
+        from faabric_tpu.util.retry import RetryPolicy
+
+        conf = get_system_config()
+        return RetryPolicy(
+            max_attempts=conf.planner_max_requeues + 1,
+            backoff=conf.planner_requeue_backoff,
+            max_backoff=30.0).delay(used)
+
+    def _requeue(self, app_id: int, msgs: list[Message], t_detect: float,
+                 reason: bytes) -> None:
+        """Move the affected messages onto surviving hosts: release the
+        dead placements, re-place through the scheduling policy, merge
+        the new rows into the live decision, then re-send mappings and
+        re-dispatch (network strictly outside the lock)."""
+        from faabric_tpu.batch_scheduler.decision import is_sentinel_decision
+
+        fail: Optional[list[Message]] = None
+        fail_reason = reason
+        retry_later = False
+        conf = get_system_config()
+        with self._lock:
+            pending = [m for m in msgs
+                       if m.id not in self._results.get(app_id, {})]
+            if not pending:
+                return  # genuine late results won every race
+            todo = [m.id for m in pending]
+            todo_set = set(todo)
+            in_flight = self._in_flight.get(app_id)
+            if in_flight is None:
+                # The app left _in_flight during the backoff: our rows
+                # were already extracted, so the other messages' results
+                # drove n_messages to 0 and "completed" the app. These
+                # messages have no placement and no results — they MUST
+                # fail now or the batch stays unfinishable forever
+                # (finished requires len(results) >= expected).
+                fail = pending
+                fail_reason = reason + b" (app completed around requeue)"
+            else:
+                req, decision = in_flight
+                for mid in todo:
+                    # Rows may already be extracted by an earlier
+                    # no-capacity round of this same recovery; only live
+                    # rows release
+                    if mid in decision.message_ids:
+                        self._release_message(app_id, mid)  # dead: no-op
+                        decision.remove_message(mid)
+                retry_msgs = [m for m in req.messages if m.id in todo_set]
+                sub = BatchExecuteRequest(
+                    app_id=req.app_id, group_id=req.group_id, user=req.user,
+                    function=req.function, type=req.type,
+                    subtype=req.subtype, snapshot_key=req.snapshot_key)
+                sub.messages = retry_msgs
+                host_map = self._policy_host_map()
+                scheduler = get_batch_scheduler()
+                # Empty in-flight view: the retry slice places like a NEW
+                # batch of just these messages (their app/group idxs ride
+                # along on the messages themselves)
+                new_decision = scheduler.make_scheduling_decision(
+                    host_map, {}, sub)
+                if is_sentinel_decision(new_decision):
+                    # No capacity right now. Capacity frees as running
+                    # messages complete, so spend another budget unit on
+                    # a longer-backoff round rather than failing outright.
+                    used = self._requeue_attempts.get(app_id, 0)
+                    if used < conf.planner_max_requeues:
+                        self._requeue_attempts[app_id] = used + 1
+                        retry_later = True
+                    else:
+                        fail = retry_msgs
+                        fail_reason = reason + b" (no requeue capacity)"
+                else:
+                    new_decision.group_id = decision.group_id
+                    self._claim_for_decision(new_decision, sub)
+                    for i in range(new_decision.n_messages):
+                        decision.add_message(
+                            new_decision.hosts[i],
+                            new_decision.message_ids[i],
+                            new_decision.app_idxs[i],
+                            new_decision.group_idxs[i],
+                            new_decision.mpi_ports[i],
+                            new_decision.device_ids[i])
+                    dispatches = self._build_dispatches(sub, new_decision)
+                    # A requeued slice of a multi-host app must not claim
+                    # single-host: the flag gates THREADS snapshot
+                    # pushes, and the new host needs the snapshot
+                    single = len(decision.unique_hosts()) == 1
+                    for _, s in dispatches:
+                        s.single_host = single
+                    mappings = decision.clone()
+                    gids, hosts = self._group_hosts.get(app_id,
+                                                        (set(), set()))
+                    self._group_hosts[app_id] = (
+                        gids | {mappings.group_id},
+                        hosts | set(mappings.hosts))
+                    _REQUEUES_TOTAL.inc()
+                    _REQUEUED_MESSAGES.inc(len(todo))
+        if retry_later:
+            used = self._requeue_attempts.get(app_id, 1)
+            delay = self._requeue_delay(used)
+            logger.warning(
+                "No capacity to requeue %d msgs of app %d yet; retrying "
+                "in %.2fs (attempt %d/%d)", len(todo), app_id, delay,
+                used, conf.planner_max_requeues)
+            time.sleep(delay)
+            self._requeue(app_id, pending, t_detect, reason)
+            return
+        if fail is not None:
+            logger.warning("Failing %d unrecoverable msgs of app %d: %s",
+                           len(fail), app_id, fail_reason.decode())
+            _RETRY_EXHAUSTED.inc(len(fail))
+            self._fail_messages(fail, fail_reason)
+            return
+        logger.warning("Requeued %d msgs of app %d onto %s after: %s",
+                       len(todo), app_id,
+                       sorted(set(new_decision.hosts)), reason.decode())
+        self._send_mappings(mappings)
+        self._do_dispatch(dispatches)
+        _RECOVERY_SECONDS.observe(time.monotonic() - t_detect)
+
+    def _recover_dispatch(self, sub: BatchExecuteRequest, ip: str,
+                          reason: bytes) -> None:
+        """A failed dispatch re-enters the recovery machine on its own
+        thread (the caller may hold no lock but sits on the dispatch
+        path — the backoff sleep must not stall sibling dispatches)."""
+        threading.Thread(
+            target=self._recover_messages,
+            args=(sub.app_id, list(sub.messages), reason),
+            name=f"recover-{sub.app_id}", daemon=True).start()
+
     def _decision_from_cache(self, req: BatchExecuteRequest,
                              host_map) -> Optional[SchedulingDecision]:
         """Rebuild a decision from the cached placement of an identical
@@ -744,28 +964,28 @@ class Planner:
             is_threads = sub.type == int(BatchExecuteType.THREADS)
             if is_threads and not sub.single_host:
                 if not self._push_snapshot_for_threads(sub, ip):
-                    # Dispatching without the snapshot would hang the batch
-                    # in restore(); fail the messages so waiters unblock
-                    self._fail_dispatch(sub, ip, b"Snapshot push failed")
+                    # Dispatching without the snapshot would hang the
+                    # batch in restore(); recover the messages onto a
+                    # host that can be given it
+                    self._recover_dispatch(sub, ip, b"Snapshot push failed")
                     continue
             try:
+                if _FAULTS:
+                    verdict = _FP_DISPATCH.fire(host=ip, app_id=sub.app_id)
+                    if verdict is DROP:
+                        # Injected silent dispatch loss: the messages
+                        # strand until the target's keep-alive expiry
+                        # recovers them — the chaos scenario dispatch-
+                        # time error handling cannot see
+                        continue
                 self._get_client(ip).execute_functions(sub)
             except Exception:  # noqa: BLE001 — a dead host must not stall others
                 logger.exception("Dispatch of app %d to %s failed",
                                  sub.app_id, ip)
-                self._fail_dispatch(sub, ip, b"Dispatch failed")
+                self._recover_dispatch(sub, ip, b"Dispatch failed")
                 continue
             logger.debug("Dispatched %d msgs of app %d to %s",
                          sub.n_messages(), sub.app_id, ip)
-
-    def _fail_dispatch(self, sub: BatchExecuteRequest, ip: str,
-                       reason: bytes) -> None:
-        logger.warning("Failing %d msgs of app %d for %s: %s",
-                       sub.n_messages(), sub.app_id, ip, reason.decode())
-        for m in sub.messages:
-            m.return_value = int(ReturnValue.FAILED)
-            m.output_data = reason
-            self.set_message_result(m)
 
     def _push_snapshot_for_threads(self, req: BatchExecuteRequest,
                                    host: str) -> bool:
@@ -846,6 +1066,7 @@ class Planner:
                         del self._in_flight[app_id]
                         self._next_idx.pop(app_id, None)
                         self._preloaded.pop(app_id, None)
+                        self._requeue_attempts.pop(app_id, None)
                         self._completed_order.append(app_id)
                         self._evict_old_results()
                         logger.debug("App %d complete", app_id)
@@ -1085,6 +1306,7 @@ class Planner:
             self._next_idx.clear()
             self._completed_order.clear()
             self._waiters.clear()
+            self._requeue_attempts.clear()
             self._preloaded.clear()
             self._evicted.clear()
             self._next_evicted_ips.clear()
@@ -1110,6 +1332,7 @@ class Planner:
             self._next_idx.clear()
             self._completed_order.clear()
             self._waiters.clear()
+            self._requeue_attempts.clear()
             self._preloaded.clear()
             for h in self._hosts.values():
                 h.state.used_slots = 0

@@ -18,9 +18,25 @@ from faabric_tpu.proto import (
 from faabric_tpu.transport.common import PLANNER_ASYNC_PORT, PLANNER_SYNC_PORT
 from faabric_tpu.transport.message import TransportMessage
 from faabric_tpu.transport.server import MessageEndpointServer, handler_response
+from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.periodic import PeriodicBackgroundThread
 
 logger = get_logger(__name__)
+
+
+class _ExpiryReaper(PeriodicBackgroundThread):
+    """Drives host expiry (and therefore failure RECOVERY) on a clock.
+    Expiry is otherwise lazy — piggybacked on scheduling and host
+    listings — so a dead worker's in-flight messages would only be
+    requeued when some client happened to poke the planner."""
+
+    def __init__(self, planner) -> None:
+        super().__init__()
+        self.planner = planner
+
+    def do_work(self) -> None:
+        self.planner.expire_hosts()
 
 
 class PlannerCalls(enum.IntEnum):
@@ -60,6 +76,7 @@ class PlannerServer(MessageEndpointServer):
         self.snapshot_server = SnapshotServer(
             self.planner.snapshot_registry, host="planner",
             port_offset=port_offset)
+        self.expiry_reaper = _ExpiryReaper(self.planner)
 
     def start(self) -> None:
         from faabric_tpu.telemetry import set_process_label
@@ -67,8 +84,13 @@ class PlannerServer(MessageEndpointServer):
         set_process_label("planner")
         super().start()
         self.snapshot_server.start()
+        # Check at quarter-timeout: worst-case detection latency stays
+        # well inside one extra keep-alive period
+        timeout = get_system_config().planner_host_timeout
+        self.expiry_reaper.start(max(0.5, timeout / 4.0))
 
     def stop(self) -> None:
+        self.expiry_reaper.stop()
         self.snapshot_server.stop()
         super().stop()
 
@@ -89,10 +111,16 @@ class PlannerServer(MessageEndpointServer):
             return handler_response(header={"pong": True})
 
         if code == int(PlannerCalls.REGISTER_HOST):
+            # "known" tells a keep-alive caller whether the planner had
+            # this host BEFORE the call: False on a keep-alive means the
+            # host expired (or the planner restarted) — the worker
+            # rejoins with overwrite=True (planner/client.py)
+            known = self.planner.is_host_registered(h["host"])
             timeout = self.planner.register_host(
                 h["host"], h["slots"], h.get("n_devices", 0),
                 overwrite=h.get("overwrite", False))
-            return handler_response(header={"host_timeout": timeout})
+            return handler_response(header={"host_timeout": timeout,
+                                            "known": known})
 
         if code == int(PlannerCalls.REMOVE_HOST):
             self.planner.remove_host(h["host"])

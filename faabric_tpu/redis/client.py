@@ -56,8 +56,10 @@ class RedisClient:
     def _connect(self) -> socket.socket:
         if self._sock is None:
             try:
-                s = socket.create_connection((self.host, self.port),
-                                             timeout=self.timeout)
+                from faabric_tpu.util.network import safe_create_connection
+
+                s = safe_create_connection((self.host, self.port),
+                                           timeout=self.timeout)
             except OSError as e:
                 raise RedisConnectionError(
                     f"Cannot reach redis at {self.host}:{self.port}: {e}"

@@ -32,6 +32,7 @@ import time
 
 import numpy as np
 
+from faabric_tpu.faults import fault_point, faults_enabled
 from faabric_tpu.telemetry import (
     NULL_SPAN,
     get_metrics,
@@ -77,6 +78,12 @@ _BULK_SEND_SECONDS = {
         "Bulk-plane per-frame send latency", path=path)
     for path in ("tcp", "shm")
 }
+_BULK_RECONNECTS = _metrics.counter(
+    "faabric_bulk_reconnects_total",
+    "Reconnect-and-resend recoveries after a stale/reset bulk connection")
+
+_FAULTS = faults_enabled()
+_FP_BULK = fault_point("transport.bulk")
 
 BULK_PORT = 8014
 # Below this the RPC plane wins (no extra connection, lower latency)
@@ -362,9 +369,11 @@ class BulkClient:
         self.shm_frames = 0  # observability: frames that rode the ring
 
     def _dial(self) -> socket.socket:
+        from faabric_tpu.util.network import safe_create_connection
+
         ip, port = resolve_host(self.host, BULK_PORT)
-        s = socket.create_connection((ip, port),
-                                     timeout=DEFAULT_SOCKET_TIMEOUT)
+        s = safe_create_connection((ip, port),
+                                   timeout=DEFAULT_SOCKET_TIMEOUT)
         _tune(s)
         s.settimeout(None)
         self._maybe_announce_ring(s, ip)
@@ -462,6 +471,11 @@ class BulkClient:
                 self._ring_refused = True
             t0 = time.monotonic()
             try:
+                if _FAULTS:
+                    # kill_conn rules land in the except below and drive
+                    # the reconnect-and-resend path, exactly like a peer
+                    # that closed the keep-alive connection
+                    _FP_BULK.fire(dest=self.host, bytes=nbytes)
                 with span("transport.bulk", "tcp_send", bytes=nbytes,
                           dest=self.host) if tracing_enabled() \
                         else NULL_SPAN:
@@ -472,15 +486,21 @@ class BulkClient:
                 _BULK_TX_BYTES["tcp"].inc(nbytes)
                 _BULK_SEND_SECONDS["tcp"].observe(time.monotonic() - t0)
             except OSError:
-                # One reconnect attempt (idle reset). A partial frame on
-                # the dead connection is discarded by the receiver with
-                # it; a frame that DID fully land before the error
-                # surfaces arrives twice — the ordered-recv path drops
-                # duplicate sequence numbers. Known limitation: an RST
-                # that discards a delivered-but-unread earlier frame on a
-                # LIVE peer leaves a seq gap this retry cannot heal;
-                # ordered recvs then time out rather than hang silently.
-                # (The reference's raw-TCP plane has no reliability layer
+                # One reconnect-and-resend attempt: the dominant failure
+                # here is the STALE-SOCKET signature — the peer closed
+                # the keep-alive bulk connection (worker restart, idle
+                # reset) and the first write after that surfaces
+                # EPIPE/ECONNRESET. Failing the collective outright for
+                # that would turn a routine reconnect into a batch
+                # failure. A partial frame on the dead connection is
+                # discarded by the receiver with it; a frame that DID
+                # fully land before the error surfaces arrives twice —
+                # the ordered-recv path drops duplicate sequence
+                # numbers. Known limitation: an RST that discards a
+                # delivered-but-unread earlier frame on a LIVE peer
+                # leaves a seq gap this retry cannot heal; ordered recvs
+                # then time out rather than hang silently. (The
+                # reference's raw-TCP plane has no reliability layer
                 # either — its per-rank-pair sockets never reconnect, and
                 # its "unacked message buffers", MpiWorld.cpp:1963-2030,
                 # are the receiver-side irecv-pending queues, which this
@@ -491,6 +511,7 @@ class BulkClient:
                     self._sock.sendall(head)
                     for v in views:
                         self._sock.sendall(v)
+                    _BULK_RECONNECTS.inc()
                     _BULK_TX_FRAMES["tcp"].inc()
                     _BULK_TX_BYTES["tcp"].inc(nbytes)
                     _BULK_SEND_SECONDS["tcp"].observe(

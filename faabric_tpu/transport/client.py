@@ -2,9 +2,16 @@
 (include/faabric/transport/MessageEndpointClient.h:95-133).
 
 Holds one persistent connection per plane (async push / sync req-rep) with
-lazy dial, retry-once on failure, and per-plane send locks. Resolves logical
-hosts through the alias table so in-process multi-host tests work
-(transport/common.py).
+lazy dial, a RetryPolicy-driven retry loop (exponential backoff + jitter,
+per-peer circuit breaker — util/retry.py), and per-plane send locks.
+Resolves logical hosts through the alias table so in-process multi-host
+tests work (transport/common.py).
+
+A client IS the per-peer unit: its breaker opens after
+``breaker_threshold`` consecutive failures to that peer, after which
+calls fail immediately (RpcError "circuit open") instead of re-paying
+connect/timeout latency — bounded-time failure propagation for the
+layers above (MPI abort, planner requeue).
 """
 
 from __future__ import annotations
@@ -14,6 +21,7 @@ import threading
 import time
 from typing import Any
 
+from faabric_tpu.faults import DROP, fault_point, faults_enabled
 from faabric_tpu.telemetry import get_metrics
 from faabric_tpu.transport.common import DEFAULT_SOCKET_TIMEOUT, resolve_host
 from faabric_tpu.transport.message import (
@@ -24,8 +32,12 @@ from faabric_tpu.transport.message import (
     send_frame,
 )
 from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.retry import RetryPolicy, default_transport_retry_policy
 
 logger = get_logger(__name__)
+
+_FAULTS = faults_enabled()
+_FP_SEND = fault_point("transport.send")
 
 _metrics = get_metrics()
 _TX_FRAMES = {
@@ -51,18 +63,31 @@ class RpcError(Exception):
 
 class MessageEndpointClient:
     def __init__(self, host: str, async_port: int, sync_port: int,
-                 timeout: float = DEFAULT_SOCKET_TIMEOUT) -> None:
+                 timeout: float = DEFAULT_SOCKET_TIMEOUT,
+                 retry_policy: RetryPolicy | None = None) -> None:
         self.host = host
         self.async_port = async_port
         self.sync_port = sync_port
         self.timeout = timeout
+        self.retry = retry_policy or default_transport_retry_policy()
+        # One breaker per peer (this client IS per-peer); both planes
+        # share it — a dead process is dead on both ports
+        self.breaker = self.retry.new_breaker()
         self._socks: dict[str, socket.socket | None] = {"async": None, "sync": None}
         self._locks = {"async": threading.Lock(), "sync": threading.Lock()}
 
+    def _check_breaker(self, plane: str) -> None:
+        if not self.breaker.allow():
+            raise RpcError(
+                f"circuit open to {self.host} "
+                f"({plane}; {self.breaker.threshold} consecutive failures)")
+
     def _dial(self, plane: str) -> socket.socket:
+        from faabric_tpu.util.network import safe_create_connection
+
         port = self.async_port if plane == "async" else self.sync_port
         ip, real_port = resolve_host(self.host, port)
-        s = socket.create_connection((ip, real_port), timeout=self.timeout)
+        s = safe_create_connection((ip, real_port), timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
@@ -85,18 +110,32 @@ class MessageEndpointClient:
         msg = TransportMessage(code=code, header=header or {}, payload=payload,
                                seqnum=seqnum)
         with self._locks["async"]:
-            for attempt in (0, 1):
+            self._check_breaker("async")
+            last = self.retry.max_attempts - 1
+            for attempt in range(self.retry.max_attempts):
                 try:
+                    if _FAULTS and _FP_SEND.fire(
+                            host=self.host, plane="async",
+                            code=code) is DROP:
+                        # Injected silent loss. The caller believes the
+                        # send happened, so the breaker must agree — and
+                        # a half-open trial must never exit without an
+                        # outcome (it would strand allow() at False)
+                        self.breaker.record_success()
+                        return
                     send_frame(self._get_sock("async"), msg)
                     _TX_FRAMES["async"].inc()
                     _TX_BYTES["async"].inc(len(payload))
+                    self.breaker.record_success()
                     return
                 except (OSError, TransportError) as e:
                     self._reset_sock("async")
-                    if attempt == 1:
+                    self.breaker.record_failure()
+                    if attempt == last:
                         raise RpcError(
                             f"async send to {self.host}:{self.async_port} failed: {e}"
                         ) from e
+                    self.retry.sleep(attempt)
 
     def sync_send(self, code: int, header: dict[str, Any] | None = None,
                   payload: bytes = b"", idempotent: bool = False) -> TransportMessage:
@@ -117,29 +156,46 @@ class MessageEndpointClient:
         msg = TransportMessage(code=code, header=header or {}, payload=payload)
         t0 = time.monotonic()
         with self._locks["sync"]:
-            for attempt in (0, 1):
+            self._check_breaker("sync")
+            last = self.retry.max_attempts - 1
+            for attempt in range(self.retry.max_attempts):
                 fresh = self._socks["sync"] is None
                 sent = False
                 try:
+                    if _FAULTS and _FP_SEND.fire(
+                            host=self.host, plane="sync",
+                            code=code) is DROP:
+                        # A dropped sync request has no response to wait
+                        # for: surface it as the failure the caller
+                        # would eventually see, bounded and honest —
+                        # recorded as one, so a half-open breaker trial
+                        # is never stranded without an outcome
+                        self.breaker.record_failure()
+                        raise RpcError(
+                            f"injected drop of sync RPC {code} to "
+                            f"{self.host}:{self.sync_port}")
                     sock = self._get_sock("sync")
                     send_frame(sock, msg)
                     sent = True
                     _TX_FRAMES["sync"].inc()
                     _TX_BYTES["sync"].inc(len(payload))
                     resp = recv_frame(sock)
+                    self.breaker.record_success()
                     break
                 except (OSError, TransportError) as e:
                     self._reset_sock("sync")
+                    self.breaker.record_failure()
                     likely_stale = (
                         idempotent
                         and not fresh
                         and not isinstance(e, socket.timeout)
                         and getattr(e, "no_response_data", False)
                     )
-                    if attempt == 1 or (sent and not likely_stale):
+                    if attempt == last or (sent and not likely_stale):
                         raise RpcError(
                             f"sync send to {self.host}:{self.sync_port} failed: {e}"
                         ) from e
+                    self.retry.sleep(attempt)
             else:  # pragma: no cover
                 raise RpcError("unreachable")
         _RPC_SECONDS.observe(time.monotonic() - t0)

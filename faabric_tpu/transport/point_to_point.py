@@ -20,6 +20,7 @@ builds its ``jax.sharding`` mesh accordingly.
 from __future__ import annotations
 
 import collections
+import socket
 import struct
 import threading
 import time
@@ -27,6 +28,7 @@ from typing import Optional
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
 from faabric_tpu.proto import PointToPointMapping, PointToPointMappings
+from faabric_tpu.telemetry import get_metrics
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.latch import FlagWaiter
 from faabric_tpu.util.logging import get_logger
@@ -37,6 +39,32 @@ logger = get_logger(__name__)
 POINT_TO_POINT_MAIN_IDX = 0
 NO_LOCK_OWNER_IDX = -1
 NO_SEQUENCE_NUM = -1
+
+# How long a liveness probe's connect may take. Probes only run while a
+# watched recv is already blocked past the check interval, so this sits
+# on the failure path, never the hot path.
+PEER_PROBE_TIMEOUT = 0.5
+
+_GROUP_ABORTS = get_metrics().counter(
+    "faabric_ptp_group_aborts_total",
+    "Watched groups aborted after a peer failure")
+
+
+class GroupAbortedError(RuntimeError):
+    """A watched group (an MPI world) was aborted: a peer's host is dead
+    or a send to it failed terminally. Blocked recvs/barriers raise this
+    within ~one liveness-check interval instead of hanging to the raw
+    socket timeout. Re-exported by the MPI layer as ``MpiWorldAborted``."""
+
+    def __init__(self, group_id: int, reason: str = "") -> None:
+        super().__init__(f"group {group_id} aborted: {reason or 'unknown'}")
+        self.group_id = group_id
+        self.reason = reason
+
+
+# Sentinel delivered into every queue of an aborted group so blocked
+# consumers wake immediately; compared by identity.
+_ABORT = object()
 
 # Channel namespaces: group coordination traffic (lock grants, barrier
 # releases, notify) must never share a delivery queue with application
@@ -69,6 +97,13 @@ class PointToPointBroker:
         self._clients: dict[str, object] = {}
         self._bulk_clients: dict[str, object] = {}
         self._bulk_down_until: dict[str, float] = {}
+
+        # Fault propagation: groups whose blocked recvs probe the
+        # expected sender's liveness (MPI worlds register themselves),
+        # group → abort reason, and the probe-success cache
+        self._watched: set[int] = set()
+        self._aborted: dict[int, str] = {}
+        self._peer_ok_until: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # Mappings
@@ -131,6 +166,111 @@ class PointToPointBroker:
             return len(self._mappings.get(group_id, {}))
 
     # ------------------------------------------------------------------
+    # Fault propagation (bounded-time abort for watched groups)
+    # ------------------------------------------------------------------
+    def watch_group(self, group_id: int) -> None:
+        """Arm peer-liveness checking for a group: while one of its
+        recvs blocks past ``mpi_abort_check_seconds``, the expected
+        sender's host is probed; a refused connection aborts the whole
+        group. MPI worlds register themselves at construction."""
+        with self._lock:
+            self._watched.add(group_id)
+
+    def _is_watched(self, group_id: int) -> bool:
+        with self._lock:
+            return group_id in self._watched
+
+    def group_aborted(self, group_id: int) -> Optional[str]:
+        with self._lock:
+            return self._aborted.get(group_id)
+
+    def abort_group(self, group_id: int, reason: str,
+                    propagate: bool = True) -> None:
+        """Mark a group aborted and wake every blocked consumer: each of
+        the group's delivery queues gets an abort sentinel, and later
+        recvs fail at entry. Idempotent. With ``propagate`` (the
+        locally-originated case) the abort is also broadcast to every
+        other host in the group's mappings, so ranks on a THIRD host —
+        blocked on a live peer and therefore never probing the dead one
+        — learn within one RPC instead of timing out."""
+        with self._lock:
+            if group_id in self._aborted:
+                return
+            self._aborted[group_id] = reason
+            queues = [q for k, q in self._queues.items() if k[0] == group_id]
+            peer_hosts = {m.host for m in
+                          self._mappings.get(group_id, {}).values()
+                          if m.host != self.host} if propagate else set()
+        _GROUP_ABORTS.inc()
+        logger.warning("Aborting group %d on %s: %s", group_id, self.host,
+                       reason)
+        for q in queues:
+            q.enqueue((NO_SEQUENCE_NUM, _ABORT))
+        for host in sorted(peer_hosts):
+            try:
+                self._get_client(host).abort_group(group_id, reason)
+            except Exception:  # noqa: BLE001 — best-effort; an unreachable
+                # peer's own probes (or its death) end its waits anyway
+                logger.debug("Could not propagate abort of group %d to %s",
+                             group_id, host)
+
+    def _raise_if_aborted(self, group_id: int) -> None:
+        with self._lock:
+            reason = self._aborted.get(group_id)
+        if reason is not None:
+            raise GroupAbortedError(group_id, reason)
+
+    def _probe_sender(self, key: tuple[int, int, int, int]) -> None:
+        """Called while a watched recv is blocked: check the expected
+        sender's host is still accepting connections; abort the group if
+        it refuses (its process is gone — waiting out the socket timeout
+        would just delay the inevitable by ~a minute)."""
+        group_id, send_idx = key[0], key[1]
+        with self._lock:
+            m = self._mappings.get(group_id, {}).get(send_idx)
+        host = m.host if m is not None else ""
+        if not host or host == self.host:
+            return
+        if not self._peer_alive(host):
+            reason = f"peer host {host} is unreachable (connection refused)"
+            self.abort_group(group_id, reason)
+            raise GroupAbortedError(group_id, reason)
+
+    def _peer_alive(self, host: str) -> bool:
+        """One bounded TCP dial of the peer's PTP port. Only a REFUSED
+        connection counts as dead — a slow or unroutable peer keeps the
+        recv waiting (its real timeout still applies). Successes are
+        cached for one check interval so a stalled multi-recv collective
+        probes each host once per interval, not once per recv."""
+        from faabric_tpu.util.testing import is_mock_mode
+
+        if is_mock_mode():
+            return True  # no real sockets to probe in mock tests
+        now = time.monotonic()
+        with self._lock:
+            if now < self._peer_ok_until.get(host, 0.0):
+                return True
+        from faabric_tpu.transport.common import (
+            POINT_TO_POINT_SYNC_PORT,
+            resolve_host,
+        )
+        from faabric_tpu.util.network import safe_create_connection
+
+        ip, port = resolve_host(host, POINT_TO_POINT_SYNC_PORT)
+        try:
+            s = safe_create_connection((ip, port),
+                                       timeout=PEER_PROBE_TIMEOUT)
+            s.close()
+        except ConnectionRefusedError:
+            return False
+        except OSError:
+            return True  # can't tell (slow / unroutable): keep waiting
+        conf = get_system_config()
+        with self._lock:
+            self._peer_ok_until[host] = now + conf.mpi_abort_check_seconds
+        return True
+
+    # ------------------------------------------------------------------
     # Messaging
     # ------------------------------------------------------------------
     def send_message(self, group_id: int, send_idx: int, recv_idx: int,
@@ -181,8 +321,21 @@ class PointToPointBroker:
             if not isinstance(data, (bytes, bytearray, memoryview)) \
                     and hasattr(data, "to_bytes"):
                 data = data.to_bytes()
-            self._get_client(dst_host).send_message(
-                group_id, send_idx, recv_idx, data, seq, channel)
+            from faabric_tpu.transport.client import RpcError
+
+            try:
+                self._get_client(dst_host).send_message(
+                    group_id, send_idx, recv_idx, data, seq, channel)
+            except RpcError as e:
+                if self._is_watched(group_id):
+                    # A terminally-failed send to a watched peer dooms
+                    # the whole group: surface one typed abort (bounded
+                    # — the client's retry/breaker already ran) instead
+                    # of letting every rank discover it separately
+                    reason = f"send to {dst_host} failed: {e}"
+                    self.abort_group(group_id, reason)
+                    raise GroupAbortedError(group_id, reason) from e
+                raise
 
     def deliver(self, group_id: int, send_idx: int, recv_idx: int,
                 data: bytes, seq: int = NO_SEQUENCE_NUM,
@@ -199,6 +352,9 @@ class PointToPointBroker:
         timeout = timeout if timeout is not None else conf.global_message_timeout
         key = (group_id, send_idx, recv_idx, channel)
         q = self._get_queue(key)
+        watched = self._is_watched(group_id)
+        if watched:
+            self._raise_if_aborted(group_id)
 
         if not must_order:
             # A probe may have staged messages out of the raw queue;
@@ -214,12 +370,25 @@ class PointToPointBroker:
                     self._recv_seq[key] = max(
                         self._recv_seq.get(key, -1), seq)
                     return buf.pop(seq)
-            try:
-                _, data = q.dequeue(timeout=timeout)
-            except QueueTimeoutException as e:
-                raise TimeoutError(
-                    f"PTP recv timed out on {key}") from e
-            return data
+            deadline = time.monotonic() + timeout
+            while True:
+                slice_t = max(0.0, deadline - time.monotonic())
+                if watched:
+                    slice_t = min(slice_t, conf.mpi_abort_check_seconds)
+                try:
+                    _, data = q.dequeue(timeout=slice_t)
+                except QueueTimeoutException as e:
+                    if watched:
+                        self._probe_sender(key)  # may abort + raise
+                        self._raise_if_aborted(group_id)
+                        if time.monotonic() < deadline:
+                            continue
+                    raise TimeoutError(
+                        f"PTP recv timed out on {key}") from e
+                if data is _ABORT:
+                    raise GroupAbortedError(
+                        group_id, self._aborted.get(group_id, ""))
+                return data
 
         # Ordered path: consume in seq order, buffering whatever arrives
         # early (reference PointToPointBroker.cpp:778-862).
@@ -245,6 +414,9 @@ class PointToPointBroker:
         already-delivered seqs (bulk-plane reconnect resends) are
         dropped. Shared by ordered recv, probe and iprobe."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        watched = self._is_watched(key[0])
+        check = get_system_config().mpi_abort_check_seconds if watched \
+            else None
         with self._lock:
             buf = self._ooo.setdefault(key, {})
             backlog = self._unseq.setdefault(key, collections.deque())
@@ -255,6 +427,8 @@ class PointToPointBroker:
                 expected = self._recv_seq.get(key, -1) + 1
                 if expected in buf:
                     return ("seq", buf[expected])
+            if watched:
+                self._raise_if_aborted(key[0])
             if not blocking:
                 item = q.try_dequeue()
                 if item is None:
@@ -262,11 +436,23 @@ class PointToPointBroker:
             else:
                 remaining = None if deadline is None else \
                     max(0.0, deadline - time.monotonic())
+                slice_t = remaining
+                if check is not None:
+                    slice_t = check if remaining is None \
+                        else min(remaining, check)
                 try:
-                    item = q.dequeue(timeout=remaining)
+                    item = q.dequeue(timeout=slice_t)
                 except QueueTimeoutException:
+                    if watched:
+                        self._probe_sender(key)  # may abort + raise
+                        self._raise_if_aborted(key[0])
+                        if deadline is None or time.monotonic() < deadline:
+                            continue
                     return None
             seq, data = item
+            if data is _ABORT:
+                raise GroupAbortedError(key[0],
+                                        self._aborted.get(key[0], ""))
             with self._lock:
                 if seq == NO_SEQUENCE_NUM:
                     backlog.append(data)
@@ -323,6 +509,8 @@ class PointToPointBroker:
             self._groups.pop(group_id, None)
             self._mappings.pop(group_id, None)
             self._flags.pop(group_id, None)
+            self._watched.discard(group_id)
+            self._aborted.pop(group_id, None)
             for key in [k for k in self._queues if k[0] == group_id]:
                 del self._queues[key]
             for d in (self._sent_seq, self._recv_seq, self._ooo,
@@ -347,6 +535,9 @@ class PointToPointBroker:
             self._recv_seq.clear()
             self._ooo.clear()
             self._unseq.clear()
+            self._watched.clear()
+            self._aborted.clear()
+            self._peer_ok_until.clear()
             for c in list(self._clients.values()) \
                     + list(self._bulk_clients.values()):
                 try:

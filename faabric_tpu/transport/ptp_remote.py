@@ -45,6 +45,7 @@ class PointToPointCall(enum.IntEnum):
     UNLOCK_GROUP_RECURSIVE = 5
     MAPPING = 6
     CLEAR_GROUP = 7
+    ABORT_GROUP = 8
 
 
 # Lock/unlock handlers run on the shared server worker pool; they must not
@@ -144,6 +145,12 @@ class PointToPointClient(MessageEndpointClient):
         self.async_send(int(PointToPointCall.CLEAR_GROUP),
                         {"group_id": group_id})
 
+    def abort_group(self, group_id: int, reason: str) -> None:
+        if is_mock_mode():
+            return
+        self.async_send(int(PointToPointCall.ABORT_GROUP),
+                        {"group_id": group_id, "reason": reason})
+
 
 class PointToPointServer(MessageEndpointServer):
     def __init__(self, broker: "PointToPointBroker") -> None:
@@ -200,6 +207,13 @@ class PointToPointServer(MessageEndpointServer):
                 group.unlock(h["group_idx"], recursive)
         elif code == int(PointToPointCall.CLEAR_GROUP):
             self.broker.clear_group(h["group_id"])
+        elif code == int(PointToPointCall.ABORT_GROUP):
+            # propagate=False: the originator already told every member
+            # host — re-broadcasting would just bounce the (idempotent)
+            # abort around the group
+            self.broker.abort_group(h["group_id"],
+                                    h.get("reason", "remote abort"),
+                                    propagate=False)
         else:
             logger.warning("Unknown async PTP call %d", code)
 

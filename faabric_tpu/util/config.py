@@ -86,6 +86,16 @@ class SystemConfig:
     # Hosts expire if they miss keep-alives for this long (reference
     # PlannerConfig.hostTimeout; workers re-register every half-timeout)
     planner_host_timeout: float = 30.0
+    # Recovery: per-app requeue budget when a host dies or a dispatch
+    # fails, and the base of the exponential requeue backoff
+    planner_max_requeues: int = 3
+    planner_requeue_backoff: float = 0.2
+
+    # MPI fault propagation: while a recv on a watched (MPI) group
+    # blocks, the expected sender's host is probed every this many
+    # seconds; a refused connection aborts the world within ~one probe
+    # interval instead of hanging to the socket timeout
+    mpi_abort_check_seconds: float = 2.0
 
     # Transport
     serialisation: str = "json"
@@ -147,6 +157,11 @@ class SystemConfig:
         self.planner_host = _env("PLANNER_HOST", "localhost")
         self.planner_port = _env_int("PLANNER_PORT", 8011)
         self.planner_host_timeout = _env_float("PLANNER_HOST_TIMEOUT", 30.0)
+        self.planner_max_requeues = _env_int("PLANNER_MAX_REQUEUES", 3)
+        self.planner_requeue_backoff = _env_float(
+            "PLANNER_REQUEUE_BACKOFF", 0.2)
+        self.mpi_abort_check_seconds = _env_float(
+            "MPI_ABORT_CHECK_SECONDS", 2.0)
 
         self.serialisation = _env("SERIALISATION", "json")
         self.mesh_device_kind = _env("MESH_DEVICE_KIND", "auto")

@@ -38,3 +38,42 @@ def get_free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+# The framework's listener plan spans 8003..~30000 (canonical ports
+# 8003-8014, the per-host MPI pool up to 8532, all shifted by multi-host
+# alias offsets up to ~21000). The kernel's ephemeral range is NOT
+# guaranteed to start above that — containers commonly run with
+# ip_local_port_range = 16000 65535 — so a plain connect() can squat a
+# future listener's port for the pooled connection's whole lifetime and
+# fail that server's bind with EADDRINUSE. Client dials therefore pin
+# their SOURCE port above the plan.
+SAFE_CLIENT_PORT_MIN = 30500
+SAFE_CLIENT_PORT_MAX = 60000
+
+
+def safe_create_connection(address: tuple[str, int],
+                           timeout: float | None = None) -> socket.socket:
+    """``socket.create_connection`` with the local port drawn from
+    [SAFE_CLIENT_PORT_MIN, SAFE_CLIENT_PORT_MAX) so outgoing connections
+    never collide with the listener plan. Falls back to a plain
+    ephemeral connect if the safe range is (improbably) exhausted."""
+    import random
+
+    for _ in range(20):
+        port = random.randrange(SAFE_CLIENT_PORT_MIN, SAFE_CLIENT_PORT_MAX)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.settimeout(timeout)
+            s.bind(("", port))
+            s.connect(address)
+            return s
+        except OSError as e:
+            s.close()
+            import errno as _errno
+
+            if e.errno in (_errno.EADDRINUSE, _errno.EADDRNOTAVAIL):
+                continue  # unlucky draw: that port is taken
+            raise
+    return socket.create_connection(address, timeout)

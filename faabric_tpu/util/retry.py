@@ -1,0 +1,152 @@
+"""Retry policy + per-peer circuit breaker.
+
+Replaces the transport layer's ad-hoc retry-once: a ``RetryPolicy``
+names the whole discipline (attempt budget, exponential backoff with
+jitter, breaker thresholds) so the planner's requeue backoff and the
+RPC clients share one schedule implementation, and failure propagation
+stays *bounded* — a peer that keeps failing trips its breaker and
+subsequent calls fail immediately instead of re-paying connect/timeout
+latency (the fabric-lib "peer failure is a first-class, bounded-latency
+event" stance, arXiv:2510.27656).
+
+Jitter is drawn from a policy-owned ``random.Random`` so tests can seed
+it; by default it decorrelates retry storms across peers.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class CircuitBreaker:
+    """Per-peer failure gate: CLOSED → (threshold consecutive failures)
+    → OPEN → (reset_after elapses) → HALF_OPEN → one trial call →
+    CLOSED on success / OPEN on failure.
+
+    ``allow()`` is asked before an attempt; ``record_success`` /
+    ``record_failure`` report its outcome. While OPEN, ``allow()`` is an
+    immediate False — the caller fails fast without touching the
+    network."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, threshold: int = 5, reset_after: float = 5.0,
+                 clock=time.monotonic) -> None:
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = threshold
+        self.reset_after = reset_after
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._trial_in_flight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _maybe_half_open_locked(self) -> None:
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.reset_after):
+            self._state = self.HALF_OPEN
+            self._trial_in_flight = False
+
+    def allow(self) -> bool:
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.HALF_OPEN and not self._trial_in_flight:
+                self._trial_in_flight = True  # exactly one concurrent trial
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._trial_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                # Failed trial: straight back to OPEN, fresh timer
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._trial_in_flight = False
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+
+
+class RetryPolicy:
+    """Attempt budget + exponential backoff with jitter + breaker
+    parameters, as one named object."""
+
+    def __init__(self, max_attempts: int = 2, backoff: float = 0.05,
+                 multiplier: float = 2.0, max_backoff: float = 2.0,
+                 jitter: float = 0.2, breaker_threshold: int = 5,
+                 breaker_reset: float = 5.0,
+                 rng: random.Random | None = None) -> None:
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.backoff = backoff
+        self.multiplier = multiplier
+        self.max_backoff = max_backoff
+        self.jitter = jitter
+        self.breaker_threshold = breaker_threshold
+        self.breaker_reset = breaker_reset
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt + 1`` (0-based: delay(0)
+        separates attempts 1 and 2). Exponential, capped, jittered to
+        ±jitter fraction."""
+        base = min(self.backoff * (self.multiplier ** attempt),
+                   self.max_backoff)
+        if self.jitter <= 0:
+            return base
+        return base * (1.0 + self.jitter * (2.0 * self._rng.random() - 1.0))
+
+    def schedule(self) -> list[float]:
+        """The full backoff schedule (max_attempts - 1 sleeps)."""
+        return [self.delay(i) for i in range(self.max_attempts - 1)]
+
+    def sleep(self, attempt: int) -> None:
+        d = self.delay(attempt)
+        if d > 0:
+            time.sleep(d)
+
+    def new_breaker(self, clock=time.monotonic) -> CircuitBreaker:
+        return CircuitBreaker(threshold=self.breaker_threshold,
+                              reset_after=self.breaker_reset, clock=clock)
+
+
+def default_transport_retry_policy() -> RetryPolicy:
+    """The RPC clients' policy, env-tunable (defaults reproduce the old
+    retry-once behaviour plus a short decorrelating backoff)."""
+    import os
+
+    def _f(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return default
+
+    return RetryPolicy(
+        max_attempts=max(1, int(_f("TRANSPORT_RETRY_ATTEMPTS", 2))),
+        backoff=_f("TRANSPORT_RETRY_BACKOFF", 0.05),
+        breaker_threshold=max(1, int(_f("TRANSPORT_BREAKER_THRESHOLD", 6))),
+        breaker_reset=_f("TRANSPORT_BREAKER_RESET", 5.0),
+    )

@@ -32,12 +32,13 @@ import pytest  # noqa: E402
 # Port-range allocator for fixtures that stand up aliased hosts. Two
 # constraints learned the hard way: (a) bases must be session-unique so
 # concurrent fixture ranges never overlap (random bases collided ~1/150
-# runs); (b) every listener port (canonical 8003-8012 + offset) must stay
-# BELOW the ephemeral range (32768+), where the kernel hands out client
-# ports — binding there intermittently hits EADDRINUSE against outgoing
-# connections from earlier tests. Bases cycle through 7 slots; sequential
-# fixtures reuse a slot only after its predecessor tore down
-# (SO_REUSEADDR covers TIME_WAIT).
+# runs); (b) outgoing connections must not squat listener ports — this
+# container's ephemeral range starts at 16000, INSIDE the listener plan,
+# so the framework pins client SOURCE ports above 30500
+# (util/network.py safe_create_connection); a stray plain connect() in a
+# test can still intermittently EADDRINUSE a later fixture's bind.
+# Bases cycle through 7 slots; sequential fixtures reuse a slot only
+# after its predecessor tore down (SO_REUSEADDR covers TIME_WAIT).
 _BASES = [1000, 4000, 7000, 10000, 13000, 16000, 19000]
 _port_iter = itertools.count(random.randrange(len(_BASES)))
 

@@ -568,6 +568,39 @@ class DistExecutor(Executor):
         msg.output_data = b"slept"
         return int(ReturnValue.SUCCESS)
 
+    def fn_mpi_abort(self, msg, req):
+        """Chaos behaviour: loop small allreduces with think-time. When
+        a peer worker is SIGKILLed mid-loop, the surviving ranks'
+        collective must raise MpiWorldAborted within the configured
+        bound (MPI_ABORT_CHECK_SECONDS + probe) instead of hanging to
+        the 60s socket timeout. Reports 'aborted:<secs-to-abort>' with
+        the time from entering the failing collective to the raise."""
+        from faabric_tpu.mpi import MpiOp, MpiWorldAborted, get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 9100
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        data = np.ones(1024, np.float32)
+        t0 = time.monotonic()
+        for _ in range(600):  # ≤30s of rounds; the test kills a peer early
+            t_round = time.monotonic()
+            try:
+                world.allreduce(rank, data, MpiOp.SUM)
+            except MpiWorldAborted:
+                elapsed = time.monotonic() - t_round
+                msg.output_data = f"aborted:{elapsed:.2f}".encode()
+                return int(ReturnValue.SUCCESS)
+            time.sleep(0.05)
+        msg.output_data = f"done:{time.monotonic() - t0:.1f}".encode()
+        return int(ReturnValue.SUCCESS)
+
     @staticmethod
     def _all_to_all_round(world, rank, i) -> bool:
         """The reference's doAllToAll (tests/dist/mpi/mpi_native.cpp):
@@ -807,11 +840,12 @@ def run_planner(port_offset: int = 0) -> None:
     server.stop()
 
 
-def run_worker(host: str, planner_host: str = "127.0.0.1") -> None:
+def run_worker(host: str, planner_host: str = "127.0.0.1",
+               slots: int = 4) -> None:
     from faabric_tpu.runner import WorkerRuntime
 
-    w = WorkerRuntime(host=host, slots=4, n_devices=4, factory=DistFactory(),
-                      planner_host=planner_host)
+    w = WorkerRuntime(host=host, slots=slots, n_devices=4,
+                      factory=DistFactory(), planner_host=planner_host)
     w.start()
     print("READY", flush=True)
     time.sleep(int(os.environ.get("DIST_PROC_TTL", "120")))
@@ -960,4 +994,5 @@ if __name__ == "__main__":
         run_plane_worker(sys.argv[2], int(sys.argv[3]))
     else:
         run_worker(sys.argv[2],
-                   sys.argv[3] if len(sys.argv) > 3 else "127.0.0.1")
+                   sys.argv[3] if len(sys.argv) > 3 else "127.0.0.1",
+                   int(sys.argv[4]) if len(sys.argv) > 4 else 4)

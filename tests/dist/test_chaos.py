@@ -1,0 +1,300 @@
+"""Chaos tests: real worker processes SIGKILLed mid-batch.
+
+Acceptance (ISSUE 2): with a worker killed mid-batch the planner
+requeues the dead host's messages onto survivors and the batch COMPLETES
+within the retry budget; a collective on the broken MPI world raises
+MpiWorldAborted in bounded time (well under the raw socket timeout); an
+expired-but-alive worker rejoins automatically.
+
+Every test stands up its own cluster on randomized port offsets (the
+kill leaves no reusable fixture behind). Kill tests are chaos+slow —
+tier-1 runs the fast in-process chaos subset in tests/unit/test_faults.py.
+"""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from faabric_tpu.proto import ReturnValue, batch_exec_factory
+
+PROCS = os.path.join(os.path.dirname(__file__), "procs.py")
+
+pytestmark = pytest.mark.chaos
+
+
+class ChaosCluster:
+    """Planner + n workers as real OS processes on a private port range;
+    the test process joins as a 0-slot client host."""
+
+    def __init__(self, tag: str, n_workers: int = 2, slots=(4, 4),
+                 extra_env: dict | None = None, worker_env: dict | None = None):
+        from faabric_tpu.transport.common import clear_host_aliases
+
+        # Randomized per-run offsets, below the module-fixture 10000+
+        # bases and the ephemeral range (see test_multiprocess.py)
+        b = 100 * random.randint(1, 24)
+        self.tag = tag
+        self.workers = [f"{tag}w{i}" for i in range(n_workers)]
+        alias_parts = [f"{tag}pl=127.0.0.1+{b}"]
+        for i, w in enumerate(self.workers):
+            alias_parts.append(f"{w}=127.0.0.1+{b + 2500 * (i + 1)}")
+        alias_parts.append(f"{tag}cli=127.0.0.1+{b + 2500 * (n_workers + 1)}")
+        self.aliases = ",".join(alias_parts)
+        self.base = b
+        self.env = dict(os.environ, FAABRIC_HOST_ALIASES=self.aliases,
+                        JAX_PLATFORMS="cpu", **(extra_env or {}))
+        self.worker_env = dict(self.env, **(worker_env or {}))
+        self.slots = slots
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.me = None
+        self._saved_env: dict[str, str | None] = {}
+        self._clear_aliases = clear_host_aliases
+
+    def _spawn(self, name, *args, env=None):
+        p = subprocess.Popen([sys.executable, PROCS, *args],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True,
+                             env=env or self.env)
+        self.procs[name] = p
+        return p
+
+    def start(self):
+        from tests.dist.test_multiprocess import drain_stdout
+
+        for key in ("FAABRIC_HOST_ALIASES", "PLANNER_HOST_TIMEOUT",
+                    "PLANNER_REQUEUE_BACKOFF", "PLANNER_MAX_REQUEUES",
+                    "MPI_ABORT_CHECK_SECONDS"):
+            self._saved_env[key] = os.environ.get(key)
+            if key in self.env:
+                os.environ[key] = self.env[key]
+        os.environ["FAABRIC_HOST_ALIASES"] = self.aliases
+        self._clear_aliases()
+        from faabric_tpu.util.config import get_system_config
+
+        get_system_config().reset()
+
+        def await_ready(p):
+            # Log lines (e.g. "Fault injection armed") may precede READY
+            while True:
+                line = p.stdout.readline()
+                assert line, "child exited before READY"
+                if line.strip() == "READY":
+                    return
+
+        planner = self._spawn("planner", "planner", str(self.base))
+        await_ready(planner)
+        for i, w in enumerate(self.workers):
+            p = self._spawn(w, "worker", w, f"{self.tag}pl",
+                            str(self.slots[i]), env=self.worker_env)
+            await_ready(p)
+        for p in self.procs.values():
+            drain_stdout(p)
+
+        from faabric_tpu.executor import ExecutorFactory
+        from faabric_tpu.runner import WorkerRuntime
+
+        class NullFactory(ExecutorFactory):
+            def create_executor(self, msg):
+                raise RuntimeError("client runs nothing")
+
+        self.me = WorkerRuntime(host=f"{self.tag}cli", slots=0,
+                                factory=NullFactory(),
+                                planner_host=f"{self.tag}pl")
+        self.me.start()
+        return self
+
+    def kill(self, worker: str):
+        p = self.procs[worker]
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=5)
+        return time.monotonic()
+
+    def stop(self):
+        if self.me is not None:
+            self.me.shutdown()
+        for p in self.procs.values():
+            p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for key, val in self._saved_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        self._clear_aliases()
+        from faabric_tpu.util.config import get_system_config
+
+        get_system_config().reset()
+
+
+def wait_finished(me, app_id, timeout):
+    deadline = time.time() + timeout
+    status = me.planner_client.get_batch_results(app_id)
+    while not status.finished and time.time() < deadline:
+        time.sleep(0.2)
+        status = me.planner_client.get_batch_results(app_id)
+    assert status.finished, (
+        f"batch {app_id} never finished: "
+        f"{len(status.message_results)}/{status.expected_num_messages}")
+    return status
+
+
+@pytest.mark.slow
+def test_chaos_kill_worker_mid_batch_requeues_and_completes():
+    """SIGKILL a worker holding live messages mid-batch: the planner's
+    expiry → requeue-with-backoff recovery moves them to the survivor
+    and the batch completes fully SUCCESS within the retry budget."""
+    cluster = ChaosCluster(
+        "ckA", n_workers=2, slots=(8, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "3",
+                   "PLANNER_REQUEUE_BACKOFF": "0.3",
+                   "PLANNER_MAX_REQUEUES": "5"}).start()
+    try:
+        me = cluster.me
+        wa, wb = cluster.workers
+        # 12 × 2.5s sleeps over 8+4 slots: 8 land on the big worker, 4
+        # on the one we are about to kill
+        req = batch_exec_factory("dist", "sleep", 12)
+        for m in req.messages:
+            m.input_data = b"2.5"
+        decision = me.planner_client.call_functions(req)
+        placed = {}
+        for h in decision.hosts:
+            placed[h] = placed.get(h, 0) + 1
+        assert placed.get(wb), f"nothing placed on {wb}: {placed}"
+
+        time.sleep(0.5)  # the batch is genuinely mid-flight
+        t_kill = cluster.kill(wb)
+
+        status = wait_finished(me, req.app_id, timeout=60)
+        recovery_s = time.monotonic() - t_kill
+        assert status.expected_num_messages == 12
+        assert len(status.message_results) == 12
+        bad = [(m.id, m.return_value, m.output_data)
+               for m in status.message_results
+               if m.return_value != int(ReturnValue.SUCCESS)]
+        assert not bad, f"requeued batch had failures: {bad}"
+        # The killed worker's messages re-ran on the survivor
+        by_host = {m.executed_host for m in status.message_results}
+        assert by_host == {wa}, by_host
+        # Recovery latency: comfortably inside expiry (3s) + backoff
+        # budget, nowhere near the 60s socket timeout
+        assert recovery_s < 45, f"recovery took {recovery_s:.1f}s"
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
+def test_chaos_mpi_world_abort_is_bounded():
+    """SIGKILL a worker hosting half an MPI world mid-collective: the
+    surviving ranks raise MpiWorldAborted within the liveness-check
+    bound instead of hanging to the 60s socket timeout; the dead ranks'
+    messages are failed by expiry (MPI is never requeued) so the batch
+    still completes."""
+    cluster = ChaosCluster(
+        "ckB", n_workers=2, slots=(4, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "3",
+                   "MPI_ABORT_CHECK_SECONDS": "1"}).start()
+    try:
+        me = cluster.me
+        req = batch_exec_factory("dist", "mpi_abort", 1)
+        req.messages[0].mpi_rank = 0
+        me.planner_client.call_functions(req)
+
+        # Wait for the world to form (all 8 rank messages placed)
+        deadline = time.time() + 30
+        live = None
+        while time.time() < deadline:
+            live = me.planner_client.get_scheduling_decision(req.app_id)
+            if live is not None and live.n_messages == 8 \
+                    and len(set(live.hosts)) == 2:
+                break
+            time.sleep(0.2)
+        assert live is not None and live.n_messages == 8, live
+        # Kill the worker NOT hosting rank 0 (group idx 0), so the
+        # result of the root rank reports the abort
+        rank0_host = live.hosts[live.group_idxs.index(0)]
+        victim = next(w for w in cluster.workers if w != rank0_host)
+        time.sleep(1.0)  # let the collective loop get going
+        cluster.kill(victim)
+
+        status = wait_finished(me, req.app_id, timeout=90)
+        aborted, dead = [], []
+        for m in status.message_results:
+            if m.return_value == int(ReturnValue.SUCCESS):
+                assert m.output_data.startswith(b"aborted:"), m.output_data
+                aborted.append(float(m.output_data.split(b":")[1]))
+            else:
+                dead.append(m)
+        # Every survivor rank aborted, in bounded time: well under the
+        # 60s socket timeout (1s check interval + probe + slack)
+        assert len(aborted) == 4, (aborted, dead)
+        assert max(aborted) < 15.0, f"abort took {max(aborted):.1f}s"
+        # The killed ranks were failed (not requeued — MPI is terminal)
+        assert len(dead) == 4
+        assert all(b"expired" in m.output_data or b"failed" in
+                   m.output_data.lower() for m in dead), dead
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
+def test_chaos_suppressed_keepalives_expire_then_rejoin():
+    """FAABRIC_FAULTS=keepalive=suppress@times=N on a worker: the
+    planner expires the (alive) worker; when its keep-alives resume, the
+    'known: False' response triggers an automatic overwrite re-register
+    and the worker rejoins the pool — no restart needed."""
+    cluster = ChaosCluster(
+        "ckC", n_workers=2, slots=(4, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "2"},
+        worker_env={"FAABRIC_FAULTS": "keepalive=suppress@times=4@host=ckCw1"},
+    ).start()
+    try:
+        me = cluster.me
+        w0, w1 = cluster.workers
+
+        def hosts():
+            return {h["ip"] for h in me.planner_client.get_available_hosts()}
+
+        # Worker w1's first ~4 keep-alives (1/s at timeout 2) are
+        # suppressed: it must drop off the registry...
+        deadline = time.time() + 20
+        gone = False
+        while time.time() < deadline:
+            if w1 not in hosts():
+                gone = True
+                break
+            time.sleep(0.25)
+        assert gone, f"{w1} never expired: {hosts()}"
+        assert w0 in hosts()
+
+        # ...and once the suppression budget is spent, rejoin on its own
+        deadline = time.time() + 20
+        back = False
+        while time.time() < deadline:
+            if w1 in hosts():
+                back = True
+                break
+            time.sleep(0.25)
+        assert back, f"{w1} never rejoined: {hosts()}"
+
+        # And it takes work again: a batch sized for both workers lands
+        # on both and completes
+        req = batch_exec_factory("dist", "square", 8)
+        for i, m in enumerate(req.messages):
+            m.input_data = str(i + 1).encode()
+        d = me.planner_client.call_functions(req)
+        assert set(d.hosts) == {w0, w1}, d.hosts
+        status = wait_finished(me, req.app_id, timeout=30)
+        assert all(m.return_value == int(ReturnValue.SUCCESS)
+                   for m in status.message_results)
+    finally:
+        cluster.stop()

@@ -1,0 +1,478 @@
+"""Fault-injection subsystem: spec parser, deterministic firing,
+RetryPolicy backoff schedule, circuit-breaker transitions, and the
+planner's requeue-with-backoff recovery (in mock mode).
+
+The fast chaos subset — everything here is in-process and sub-second,
+so it runs in tier-1; the process-kill chaos tests live in
+tests/dist/test_chaos.py and are additionally marked slow.
+"""
+
+import time
+
+import pytest
+
+from faabric_tpu.faults import (
+    DROP,
+    NULL_FAULT,
+    SUPPRESS,
+    FaultConnectionError,
+    FaultInjected,
+    FaultPoint,
+    clear_faults,
+    fault_point,
+    faults_enabled,
+    install_faults,
+    parse_fault_spec,
+    set_faults_enabled,
+)
+from faabric_tpu.util.retry import CircuitBreaker, RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# Spec parser
+# ---------------------------------------------------------------------------
+
+def test_parse_fault_spec_full_grammar():
+    rules = parse_fault_spec(
+        "transport.send=delay:50ms@p=0.25;"
+        "planner.dispatch=kill_conn@times=2@host=w2;"
+        "executor.run=raise:boom@after=3;"
+        "keepalive=suppress;"
+        "transport.bulk=drop")
+    assert [r.point for r in rules] == [
+        "transport.send", "planner.dispatch", "executor.run", "keepalive",
+        "transport.bulk"]
+    assert rules[0].action == "delay"
+    assert rules[0].delay_seconds == pytest.approx(0.05)
+    assert rules[0].p == 0.25
+    assert rules[1].times == 2
+    assert rules[1].matchers == {"host": "w2"}
+    assert rules[2].after == 3
+    assert rules[2].arg == "boom"
+
+
+@pytest.mark.parametrize("bad", [
+    "transport.send",            # no action
+    "x=explode",                 # unknown action
+    "x=delay:1s@oops",           # modifier without value
+])
+def test_parse_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_duration_forms():
+    assert parse_fault_spec("a=delay:250ms")[0].delay_seconds == \
+        pytest.approx(0.25)
+    assert parse_fault_spec("a=delay:1.5s")[0].delay_seconds == \
+        pytest.approx(1.5)
+    assert parse_fault_spec("a=delay:0.02")[0].delay_seconds == \
+        pytest.approx(0.02)
+
+
+# ---------------------------------------------------------------------------
+# Firing semantics
+# ---------------------------------------------------------------------------
+
+def _point_with(spec, seed=0):
+    rules = parse_fault_spec(spec, seed=seed)
+    pt = FaultPoint(rules[0].point)
+    pt.set_rules(rules)
+    return pt
+
+
+def test_fire_actions_and_verdicts():
+    assert _point_with("p=drop").fire() is DROP
+    assert _point_with("p=suppress").fire() is SUPPRESS
+    with pytest.raises(FaultInjected, match="boom"):
+        _point_with("p=raise:boom").fire()
+    with pytest.raises(FaultConnectionError):
+        _point_with("p=kill_conn").fire()
+    # kill_conn must look like a real peer failure to transport code
+    assert issubclass(FaultConnectionError, ConnectionError)
+    assert issubclass(FaultConnectionError, OSError)
+
+
+def test_after_and_times_modifiers():
+    pt = _point_with("p=drop@after=2@times=2")
+    # first two arrivals pass, next two fire, then disarmed
+    assert [pt.fire() for _ in range(6)] == [
+        None, None, DROP, DROP, None, None]
+
+
+def test_ctx_matchers_filter():
+    pt = _point_with("p=suppress@host=w2")
+    assert pt.fire(host="w1") is None
+    assert pt.fire(host="w2-worker") is SUPPRESS  # substring match
+    assert pt.fire() is None  # missing key never matches
+
+
+def test_probability_is_seed_deterministic():
+    def draws(seed):
+        pt = _point_with("p=drop@p=0.5", seed=seed)
+        return [pt.fire() is DROP for _ in range(64)]
+
+    a, b = draws(7), draws(7)
+    assert a == b  # identical across runs for one seed
+    assert draws(8) != a  # and the seed actually matters
+    assert 10 < sum(a) < 54  # p=0.5 actually gates
+
+
+def test_delay_action_sleeps():
+    pt = _point_with("p=delay:30ms")
+    t0 = time.monotonic()
+    assert pt.fire() is None  # delay lets the operation proceed
+    assert time.monotonic() - t0 >= 0.025
+
+
+# ---------------------------------------------------------------------------
+# Enable/disable: the no-op handle trick
+# ---------------------------------------------------------------------------
+
+def test_disabled_fault_point_is_shared_noop():
+    set_faults_enabled(False)
+    h1, h2 = fault_point("transport.send"), fault_point("anything.else")
+    assert h1 is NULL_FAULT and h2 is NULL_FAULT
+    assert h1.fire(host="x") is None
+    assert not faults_enabled()
+
+
+def test_install_faults_arms_live_handles():
+    install_faults("executor.run=raise@times=1")
+    pt = fault_point("executor.run")
+    assert pt is not NULL_FAULT and pt.active
+    with pytest.raises(FaultInjected):
+        pt.fire()
+    assert pt.fire() is None  # times=1 disarmed
+    # clear_faults disarms but the handle object survives for re-install
+    clear_faults()
+    assert not pt.active and pt.fire() is None
+    install_faults("executor.run=suppress")
+    assert fault_point("executor.run") is pt  # per-name singleton
+    assert pt.fire() is SUPPRESS
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_backoff_schedule():
+    p = RetryPolicy(max_attempts=5, backoff=0.1, multiplier=2.0,
+                    max_backoff=0.5, jitter=0.0)
+    assert p.schedule() == pytest.approx([0.1, 0.2, 0.4, 0.5])
+
+
+def test_retry_policy_jitter_bounds():
+    import random
+
+    p = RetryPolicy(max_attempts=2, backoff=1.0, jitter=0.25,
+                    rng=random.Random(3))
+    for _ in range(100):
+        d = p.delay(0)
+        assert 0.75 <= d <= 1.25
+
+
+def test_retry_policy_validates():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(threshold=0)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker state machine
+# ---------------------------------------------------------------------------
+
+def test_breaker_closed_to_open_to_half_open_to_closed():
+    t = [0.0]
+    b = CircuitBreaker(threshold=3, reset_after=10.0, clock=lambda: t[0])
+    assert b.state == CircuitBreaker.CLOSED
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+    b.record_failure()  # third consecutive failure trips it
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    t[0] = 9.9
+    assert not b.allow()
+    t[0] = 10.1  # reset window elapsed: half-open, ONE trial allowed
+    assert b.allow()
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.allow()  # second concurrent trial refused
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+
+
+def test_breaker_failed_trial_reopens_with_fresh_timer():
+    t = [0.0]
+    b = CircuitBreaker(threshold=1, reset_after=5.0, clock=lambda: t[0])
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    t[0] = 5.5
+    assert b.allow()  # half-open trial
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    t[0] = 10.0  # 4.5s after reopen: still open
+    assert not b.allow()
+    t[0] = 10.6
+    assert b.allow()
+
+
+def test_breaker_success_resets_failure_streak():
+    b = CircuitBreaker(threshold=2, reset_after=5.0)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED  # streak broken, never trips
+
+
+def test_drop_verdict_does_not_strand_half_open_breaker(monkeypatch):
+    """A DROP drawn on the half-open trial attempt must record an
+    outcome — otherwise the trial flag stays set and the breaker rejects
+    forever (an injected transient becomes a permanent node loss)."""
+    from faabric_tpu.faults.registry import FaultPoint
+    from faabric_tpu.transport import client as tclient
+
+    pt = FaultPoint("transport.send")
+    pt.set_rules(parse_fault_spec("transport.send=drop"))
+    monkeypatch.setattr(tclient, "_FAULTS", True)
+    monkeypatch.setattr(tclient, "_FP_SEND", pt)
+    c = tclient.MessageEndpointClient("nowhere.invalid", 1, 2)
+    t = [0.0]
+    c.breaker = CircuitBreaker(threshold=1, reset_after=5.0,
+                               clock=lambda: t[0])
+    c.breaker.record_failure()  # OPEN
+    t[0] = 5.5  # reset elapsed: next allow() is the half-open trial
+    c.async_send(1)  # trial draws DROP (silent loss, caller sees success)
+    assert c.breaker.allow(), "breaker stranded after injected drop"
+    # Sync plane: the drop surfaces as RpcError AND counts as a failure
+    c2 = tclient.MessageEndpointClient("nowhere.invalid", 1, 2)
+    c2.breaker = CircuitBreaker(threshold=1, reset_after=5.0,
+                                clock=lambda: t[0])
+    with pytest.raises(tclient.RpcError, match="injected drop"):
+        c2.sync_send(1)
+    assert c2.breaker.state == CircuitBreaker.OPEN
+
+
+def test_client_fails_fast_when_circuit_open():
+    """An open breaker short-circuits sync_send with RpcError before any
+    dial — bounded-time failure propagation for callers."""
+    from faabric_tpu.transport.client import MessageEndpointClient, RpcError
+
+    c = MessageEndpointClient("nowhere.invalid", 1, 2,
+                              retry_policy=RetryPolicy(max_attempts=1))
+    c.breaker = CircuitBreaker(threshold=1, reset_after=60.0)
+    c.breaker.record_failure()
+    t0 = time.monotonic()
+    with pytest.raises(RpcError, match="circuit open"):
+        c.sync_send(1)
+    with pytest.raises(RpcError, match="circuit open"):
+        c.async_send(1)
+    assert time.monotonic() - t0 < 0.5  # no connect attempt happened
+
+
+# ---------------------------------------------------------------------------
+# Planner recovery: requeue-with-backoff (mock mode — no sockets)
+# ---------------------------------------------------------------------------
+
+def _make_batch(n, function="echo"):
+    from faabric_tpu.proto import batch_exec_factory
+
+    return batch_exec_factory("ft", function, n)
+
+
+def _fresh_planner(monkeypatch):
+    from faabric_tpu.planner.planner import Planner
+
+    monkeypatch.setenv("PLANNER_REQUEUE_BACKOFF", "0.01")
+    from faabric_tpu.util.config import get_system_config
+
+    get_system_config().reset()
+    return Planner()
+
+
+def test_expired_host_requeues_onto_survivor(monkeypatch):
+    """SURVEY §5.3 upgraded: host expiry moves the dead host's in-flight
+    messages to a survivor (with budget + backoff) instead of failing
+    them terminally."""
+    from faabric_tpu.util.testing import set_mock_mode
+
+    set_mock_mode(True)
+    planner = _fresh_planner(monkeypatch)
+    planner.register_host("hA", 4)
+    planner.register_host("hB", 4)
+    req = _make_batch(8)
+    decision = planner.call_batch(req)
+    assert sorted(set(decision.hosts)) == ["hA", "hB"]
+    dead_msgs = [decision.message_ids[i] for i, h in
+                 enumerate(decision.hosts) if h == "hB"]
+    assert dead_msgs
+
+    # Replacement capacity joins, then hB silently dies: wind its
+    # keep-alive back past the timeout
+    planner.register_host("hA", 8)  # keep-alive grows hA's slots
+    with planner._lock:
+        planner._hosts["hB"].register_ts -= 10_000
+    planner.expire_hosts()
+
+    deadline = time.time() + 5
+    moved = None
+    while time.time() < deadline:
+        live = planner.get_scheduling_decision(req.app_id)
+        if live is not None and set(live.hosts) == {"hA"} \
+                and live.n_messages == 8:
+            moved = live
+            break
+        time.sleep(0.02)
+    assert moved is not None, "messages were not requeued onto hA"
+    # The moved messages kept their identity and none were failed
+    assert sorted(moved.message_ids) == sorted(decision.message_ids)
+    assert not planner._results.get(req.app_id, {})
+    with planner._lock:
+        assert planner._requeue_attempts.get(req.app_id) == 1
+        # Survivor accounting is consistent: all 8 slots on hA
+        assert planner._hosts["hA"].state.used_slots == 8
+
+
+def test_requeue_budget_exhaustion_fails_terminally(monkeypatch):
+    from faabric_tpu.proto import ReturnValue
+    from faabric_tpu.util.testing import set_mock_mode
+
+    set_mock_mode(True)
+    monkeypatch.setenv("PLANNER_MAX_REQUEUES", "0")
+    planner = _fresh_planner(monkeypatch)
+    planner.register_host("hA", 4)
+    planner.register_host("hB", 4)
+    req = _make_batch(8)
+    decision = planner.call_batch(req)
+    dead = {decision.message_ids[i] for i, h in enumerate(decision.hosts)
+            if h == "hB"}
+    with planner._lock:
+        planner._hosts["hB"].register_ts -= 10_000
+    planner.expire_hosts()
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        results = planner._results.get(req.app_id, {})
+        if dead <= set(results):
+            break
+        time.sleep(0.02)
+    results = planner._results.get(req.app_id, {})
+    assert dead <= set(results), "budget-0 messages must fail terminally"
+    for mid in dead:
+        assert results[mid].return_value == int(ReturnValue.FAILED)
+        assert b"expired" in results[mid].output_data
+
+
+def test_mpi_messages_are_not_requeued(monkeypatch):
+    """A dead rank's world state is unrecoverable: MPI messages fail
+    fast (survivors get MpiWorldAborted from the transport layer)."""
+    from faabric_tpu.proto import ReturnValue
+    from faabric_tpu.util.testing import set_mock_mode
+
+    set_mock_mode(True)
+    planner = _fresh_planner(monkeypatch)
+    planner.register_host("hA", 4)
+    planner.register_host("hB", 4)
+    req = _make_batch(8, function="mpi")
+    for m in req.messages:
+        m.is_mpi = True
+    decision = planner.call_batch(req)
+    dead = {decision.message_ids[i] for i, h in enumerate(decision.hosts)
+            if h == "hB"}
+    with planner._lock:
+        planner._hosts["hB"].register_ts -= 10_000
+    planner.expire_hosts()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if dead <= set(planner._results.get(req.app_id, {})):
+            break
+        time.sleep(0.02)
+    results = planner._results.get(req.app_id, {})
+    assert dead <= set(results)
+    assert all(results[mid].return_value == int(ReturnValue.FAILED)
+               for mid in dead)
+    with planner._lock:
+        assert req.app_id not in planner._requeue_attempts
+
+
+def test_mpi_app_detected_from_any_message(monkeypatch):
+    """The planner's copy of an MPI ROOT message often has is_mpi=False
+    (it's set worker-side during create_world); the chained rank
+    messages carry it. The never-requeue-MPI guard must therefore scan
+    the whole app — a doomed root must fail, not requeue."""
+    from faabric_tpu.proto import ReturnValue
+    from faabric_tpu.util.testing import set_mock_mode
+
+    set_mock_mode(True)
+    planner = _fresh_planner(monkeypatch)
+    planner.register_host("hA", 8)
+    planner.register_host("hB", 8)
+    req = _make_batch(8, function="mpi")
+    for m in req.messages[1:]:
+        m.is_mpi = True  # scale-up ranks; messages[0] is the bare root
+    decision = planner.call_batch(req)
+    dead = {decision.message_ids[i] for i, h in enumerate(decision.hosts)
+            if h == "hB"}
+    assert dead
+    with planner._lock:
+        planner._hosts["hB"].register_ts -= 10_000
+    planner.expire_hosts()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if dead <= set(planner._results.get(req.app_id, {})):
+            break
+        time.sleep(0.02)
+    results = planner._results.get(req.app_id, {})
+    assert dead <= set(results), "MPI app messages must fail, not requeue"
+    assert all(results[mid].return_value == int(ReturnValue.FAILED)
+               for mid in dead)
+    with planner._lock:
+        assert req.app_id not in planner._requeue_attempts
+
+
+def test_requeue_skips_messages_with_late_results(monkeypatch):
+    """A slow-but-alive host's genuine result recorded during the
+    backoff window wins; only the still-missing messages move."""
+    from faabric_tpu.proto import ReturnValue
+    from faabric_tpu.util.testing import set_mock_mode
+
+    set_mock_mode(True)
+    monkeypatch.setenv("PLANNER_REQUEUE_BACKOFF", "0.3")
+    planner = _fresh_planner(monkeypatch)
+    planner.register_host("hA", 4)
+    planner.register_host("hB", 4)
+    req = _make_batch(8)
+    decision = planner.call_batch(req)
+    dead_ids = [decision.message_ids[i] for i, h in
+                enumerate(decision.hosts) if h == "hB"]
+    planner.register_host("hA", 8)  # replacement capacity via keep-alive
+    with planner._lock:
+        planner._hosts["hB"].register_ts -= 10_000
+    planner.expire_hosts()
+    # During the backoff, one "dead" message reports a genuine result
+    late = next(m for m in req.messages if m.id == dead_ids[0])
+    late.return_value = int(ReturnValue.SUCCESS)
+    late.output_data = b"late but real"
+    planner.set_message_result(late)
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        live = planner.get_scheduling_decision(req.app_id)
+        if live is not None and set(live.hosts) == {"hA"}:
+            break
+        time.sleep(0.02)
+    results = planner._results.get(req.app_id, {})
+    assert results[late.id].output_data == b"late but real"
+    live = planner.get_scheduling_decision(req.app_id)
+    # 7 in flight on hA (8 minus the completed one), nothing failed
+    assert live.n_messages == 7
+    assert set(live.hosts) == {"hA"}
