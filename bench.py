@@ -247,6 +247,80 @@ def _mpi_sum():
     return MpiOp.SUM
 
 
+def _comm_cells_delta(before: dict, after: dict) -> list[dict]:
+    """Per-(src, dst, plane) growth between two CommMatrix snapshots."""
+    idx = {(c["src"], c["dst"], c["plane"]): c
+           for c in (before or {}).get("cells", [])}
+    out = []
+    for c in (after or {}).get("cells", []):
+        prev = idx.get((c["src"], c["dst"], c["plane"]))
+        d_bytes = c["bytes"] - (prev["bytes"] if prev else 0)
+        d_msgs = c["messages"] - (prev["messages"] if prev else 0)
+        if not d_msgs:
+            continue
+        d_lat = c["lat_sum"] - (prev["lat_sum"] if prev else 0.0)
+        d_n = c["lat_count"] - (prev["lat_count"] if prev else 0)
+        out.append({
+            "src": c["src"], "dst": c["dst"], "plane": c["plane"],
+            "messages": d_msgs, "bytes": d_bytes,
+            "mean_send_ms": round(d_lat / d_n * 1000, 3) if d_n else None,
+            "gibs": (round(d_bytes / d_lat / (1 << 30), 2)
+                     if d_lat > 0 else None),
+        })
+    out.sort(key=lambda r: -r["bytes"])
+    return out
+
+
+def _bandwidth_attribution(prof0: dict, prof1: dict,
+                           cm0: dict, cm1: dict,
+                           wall_s: float, n_local_ranks: int) -> dict:
+    """Decompose a collective's wall time into per-hop phases (this
+    process's ranks only — each bench process attributes its own side):
+
+    - ``serialize``    — building the wire payload (mpi.wire/serialize)
+    - ``enqueue_wait`` — consumer blocked before the message was
+      deliverable (ptp/recv span time, minus nothing: overlap with the
+      peer's compute IS the wait)
+    - ``wire``         — socket/ring occupancy (transport.bulk tcp_send
+      + shm_push spans)
+    - ``deserialize``  — wire bytes → array (mpi.wire/deserialize)
+
+    plus the per-link comm-matrix delta and a ranked suspect list, so a
+    0.62-vs-6.01 GiB/s gap reads as "enqueue_wait is 71% of rank-time on
+    link 1→2(shm)" instead of one number."""
+    def tot(prof, key):
+        return (prof.get(key) or {}).get("total_s", 0.0)
+
+    def delta(key):
+        return tot(prof1, key) - tot(prof0, key)
+
+    phases = {
+        "serialize_s": delta("mpi.wire/serialize"),
+        "enqueue_wait_s": delta("ptp/recv"),
+        "wire_s": (delta("transport.bulk/tcp_send")
+                   + delta("transport.bulk/shm_push")),
+        "deserialize_s": delta("mpi.wire/deserialize"),
+    }
+    rank_time = wall_s * max(1, n_local_ranks)
+    accounted = sum(v for v in phases.values() if v > 0)
+    suspects = sorted(((k, v) for k, v in phases.items() if v > 0),
+                      key=lambda kv: -kv[1])
+    links = _comm_cells_delta(cm0, cm1)
+    return {
+        "phases": {k: round(v, 4) for k, v in phases.items()},
+        "wall_s": round(wall_s, 4),
+        "rank_seconds": round(rank_time, 4),
+        "accounted_share": (round(accounted / rank_time, 4)
+                            if rank_time > 0 else None),
+        "suspects": [{"phase": k, "seconds": round(v, 4),
+                      "share_of_rank_time": (round(v / rank_time, 4)
+                                             if rank_time > 0 else None)}
+                     for k, v in suspects],
+        "links": links,
+        "commmatrix_bytes": sum(r["bytes"] for r in links),
+    }
+
+
 def _bench_world(my_host: str, app_id: int = 3):
     """Both bench processes build the same 4-rank/2-host world: ranks 0-1
     on xbenchA, 2-3 on xbenchB (mappings installed directly — the planner
@@ -351,6 +425,8 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
         assert line == "READY", f"worker said {line!r}"
 
         try:
+            from faabric_tpu.telemetry import get_comm_matrix, summary_data
+
             results = {}
 
             def rank_fn(rank):
@@ -362,6 +438,7 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
                 world.barrier(rank)
                 results[rank] = (time.perf_counter() - t0, out[0])
 
+            cm0, prof0 = get_comm_matrix().snapshot(), summary_data()
             threads = [threading.Thread(target=rank_fn, args=(r,))
                        for r in (0, 1)]
             for t in threads:
@@ -375,10 +452,18 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
 
             payload_bytes = elems * 4
             effective = 4 * 3 * payload_bytes * rounds  # np=4
+            # Bandwidth attribution (this process's ranks 0-1): ranked
+            # per-hop decomposition of where the wall time went, plus
+            # the per-link comm-matrix delta — the 0.62-vs-6.01 GiB/s
+            # investigation reads from here
+            attribution = _bandwidth_attribution(
+                prof0, summary_data(), cm0, get_comm_matrix().snapshot(),
+                elapsed, n_local_ranks=2)
             return {"effective_gibs": effective / elapsed / (1 << 30),
                     "np": 4, "n_processes": 2,
                     "payload_mib": payload_bytes / (1 << 20),
-                    "rounds": rounds}
+                    "rounds": rounds,
+                    "attribution": attribution}
         finally:
             server.stop()
             broker.clear()
@@ -402,6 +487,7 @@ def bench_robustness(quick: bool = False) -> dict:
     caught by the round-over-round JSON."""
     import signal
     import subprocess
+    import tempfile
     import timeit
 
     from faabric_tpu.faults import NULL_FAULT
@@ -417,8 +503,12 @@ def bench_robustness(quick: bool = False) -> dict:
     b = random.randint(10, 120) * 100
     aliases = (f"rbpl=127.0.0.1+{b},rbw0=127.0.0.1+{b + 2500},"
                f"rbw1=127.0.0.1+{b + 5000},rbcli=127.0.0.1+{b + 7500}")
+    # Every process (planner + workers) records into the flight ring and
+    # dumps on its trigger; the section reports the merged black box
+    flight_dir = tempfile.mkdtemp(prefix="bench_flight_")
     knobs = {"PLANNER_HOST_TIMEOUT": "3", "PLANNER_REQUEUE_BACKOFF": "0.3",
-             "PLANNER_MAX_REQUEUES": "5"}
+             "PLANNER_MAX_REQUEUES": "5",
+             "FAABRIC_FLIGHT_DIR": flight_dir}
     env = {**os.environ, "FAABRIC_HOST_ALIASES": aliases,
            "JAX_PLATFORMS": "cpu", **knobs}
     saved = {k: os.environ.get(k)
@@ -480,6 +570,18 @@ def bench_robustness(quick: bool = False) -> dict:
         ok = status.finished and all(
             m.return_value == int(ReturnValue.SUCCESS)
             for m in status.message_results)
+
+        # Black-box check: the SIGKILL scenario must leave flight dumps
+        # (the planner dumps on the recovery requeue; survivors on any
+        # abort) — the merged ring is the section's post-mortem evidence
+        from faabric_tpu.runner import flightdump
+
+        merged = flightdump.merge(flight_dir)
+        flight = {
+            "dumps": len(flightdump.load_dumps(flight_dir)),
+            "events": len(merged),
+            "kinds": sorted({e.get("kind", "?") for e in merged}),
+        }
         return {
             "kill_to_complete_s": round(kill_to_complete, 3),
             "recovered_messages": n_on_victim,
@@ -487,6 +589,7 @@ def bench_robustness(quick: bool = False) -> dict:
             "host_timeout_s": 3.0, "requeue_backoff_s": 0.3,
             "all_success": ok,
             "noop_fault_point_ns": round(noop_ns, 1),
+            "flight": flight,
         }
     finally:
         if me is not None:
@@ -505,6 +608,9 @@ def bench_robustness(quick: bool = False) -> dict:
                 os.environ[k] = v
         clear_host_aliases()
         get_system_config().reset()
+        import shutil
+
+        shutil.rmtree(flight_dir, ignore_errors=True)
 
 
 def _sendrecv_sizes() -> list[int]:

@@ -97,6 +97,12 @@ class PlannerHttpEndpoint:
                     elif path == "/trace":
                         body = endpoint.trace_json().encode()
                         ctype = "application/json"
+                    elif path == "/commmatrix":
+                        body = endpoint.commmatrix_json().encode()
+                        ctype = "application/json"
+                    elif path == "/healthz":
+                        body = endpoint.healthz_json().encode()
+                        ctype = "application/json"
                     else:
                         body = b'{"status": "running"}'
                         ctype = "application/json"
@@ -139,12 +145,41 @@ class PlannerHttpEndpoint:
     # ------------------------------------------------------------------
     def metrics_text(self) -> str:
         """Prometheus text exposition merging every registered host's
-        local registry (plus the planner's own) under a ``host`` label."""
-        from faabric_tpu.telemetry import render_snapshots
+        local registry (plus the planner's own) under a ``host`` label.
+        Each host's communication matrix rides along as
+        ``faabric_comm_*`` families with ``src``/``dst``/``plane``
+        labels (cardinality-capped at the source — commmatrix.py)."""
+        from faabric_tpu.telemetry import (
+            families_from_cells,
+            render_snapshots,
+        )
 
         tel = self.planner.collect_telemetry()
-        return render_snapshots(
-            {host: t.get("metrics", {}) for host, t in tel.items()})
+        merged = {}
+        for host, t in tel.items():
+            snap = dict(t.get("metrics", {}))
+            cells = (t.get("commmatrix") or {}).get("cells", [])
+            snap.update(families_from_cells(cells))
+            merged[host] = snap
+        return render_snapshots(merged)
+
+    def commmatrix_json(self) -> str:
+        """Per-link communication matrix: every host's (src rank, dst
+        rank, plane) send counters, plus a cross-host merged totals view
+        (hosts only report their own outbound sends, so the merge is a
+        plain sum)."""
+        from faabric_tpu.telemetry import merge_cell_rows
+
+        tel = self.planner.collect_telemetry()
+        per_host = {host: (t.get("commmatrix") or {}).get("cells", [])
+                    for host, t in tel.items()}
+        return json.dumps({
+            "hosts": per_host,
+            "total": merge_cell_rows(per_host),
+        })
+
+    def healthz_json(self) -> str:
+        return json.dumps(self.planner.health_summary())
 
     def trace_json(self) -> str:
         """Chrome trace_event JSON merging every host's span buffer onto

@@ -1,16 +1,20 @@
 """Worker HTTP endpoint.
 
 Reference analog: src/endpoint/FaabricEndpointHandler.cpp:16-56 — the
-worker's HTTP surface deliberately rejects every request, directing
-clients to the planner, which owns the REST API. Kept for wire parity
-(deployments probe worker ports) and as the hook point if a direct worker
-API ever returns.
+worker's HTTP surface rejects every functional request, directing
+clients to the planner, which owns the REST API. One exception:
+``GET /healthz`` answers locally (liveness must not depend on the
+planner being up), reporting the worker's identity, uptime and executor
+load. Started by the WorkerRuntime when ``WORKER_HTTP_PORT`` (or an
+explicit port) is set.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -25,29 +29,69 @@ REJECTION = json.dumps({
 
 
 class WorkerHttpEndpoint:
-    def __init__(self, port: int) -> None:
+    def __init__(self, port: int, runtime=None) -> None:
         self.port = port
+        self.runtime = runtime
+        self._started_at = time.monotonic()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    def healthz(self) -> dict:
+        body = {
+            "status": "ok",
+            "pid": os.getpid(),
+            "uptimeSeconds": round(time.monotonic() - self._started_at, 3),
+        }
+        rt = self.runtime
+        if rt is not None:
+            body["host"] = rt.host
+            body["slots"] = rt.slots
+            scheduler = getattr(rt, "scheduler", None)
+            if scheduler is not None:
+                body["executors"] = scheduler.get_executor_count()
+        return body
+
     def start(self) -> None:
+        """Best-effort: a health probe must never take the worker down.
+        A bind failure (e.g. two aliased workers on one box sharing
+        WORKER_HTTP_PORT) logs a warning and disables the endpoint."""
         if self._server is not None:
             return
+        endpoint = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _reject(self) -> None:
-                self.send_response(403)
+            def _respond(self, status: int, body: bytes) -> None:
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(REJECTION)))
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(REJECTION)
+                self.wfile.write(body)
 
-            do_GET = do_POST = do_PUT = do_DELETE = _reject
+            def _reject(self) -> None:
+                self._respond(403, REJECTION)
+
+            def do_GET(self) -> None:  # noqa: N802 — stdlib API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/healthz":
+                    self._respond(200,
+                                  json.dumps(endpoint.healthz()).encode())
+                else:
+                    self._reject()
+
+            do_POST = do_PUT = do_DELETE = _reject
 
             def log_message(self, fmt, *args):
                 logger.debug("worker-http: " + fmt, *args)
 
-        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        try:
+            self._server = ThreadingHTTPServer(("0.0.0.0", self.port),
+                                               Handler)
+        except OSError as e:
+            logger.warning("Worker /healthz endpoint on :%d unavailable "
+                           "(%s); continuing without it", self.port, e)
+            self._server = None
+            return
+        self.port = self._server.server_address[1]  # resolve port 0
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="worker-http", daemon=True)
         self._thread.start()

@@ -307,6 +307,23 @@ class Executor:
             logger.exception("%s task %d failed", self.id, msg.id)
             ret = int(ReturnValue.FAILED)
             msg.output_data = str(e).encode()
+            # Post-mortem: the unhandled guest exception is a flight-dump
+            # trigger — the ring's recent sends/faults around it are the
+            # context a stack trace alone cannot give. Guarded: recording
+            # must never replace the handled guest error (the FAILED
+            # result still has to reach the planner).
+            try:
+                from faabric_tpu.telemetry import (
+                    flight_dump,
+                    flight_record,
+                )
+
+                flight_record("executor_exception", msg_id=msg.id,
+                              function=f"{msg.user}/{msg.function}",
+                              error=str(e)[:200])
+                flight_dump("executor_exception")
+            except Exception:  # noqa: BLE001
+                logger.exception("Flight dump on task failure failed")
         finally:
             ExecutorContext.unset()
 

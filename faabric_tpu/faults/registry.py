@@ -241,11 +241,20 @@ def _count_fired(point: str, action: str) -> None:
     # Lazy import: telemetry must not become a hard dependency of the
     # fault layer (and this only runs when a fault actually fires)
     try:
-        from faabric_tpu.telemetry import get_metrics
+        from faabric_tpu.telemetry import (
+            flight_record,
+            get_metrics,
+            instant,
+        )
 
         get_metrics().counter(
             "faabric_faults_fired_total", "Injected faults fired",
             point=point, action=action).inc()
+        # Visible in /trace (instant marker on the firing thread's row)
+        # and in the post-mortem flight ring — an injected fault must be
+        # distinguishable from a real one after the fact
+        instant("faults", point, action=action)
+        flight_record("fault_fired", point=point, action=action)
     except Exception:  # noqa: BLE001 — counting must never mask the fault
         pass
 

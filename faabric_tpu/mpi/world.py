@@ -42,7 +42,12 @@ from faabric_tpu.mpi.types import (
     unpack_mpi_payload,
 )
 from faabric_tpu.faults import fault_point, faults_enabled
-from faabric_tpu.telemetry import get_metrics, span
+from faabric_tpu.telemetry import (
+    NULL_SPAN,
+    get_metrics,
+    span,
+    tracing_enabled,
+)
 from faabric_tpu.transport.bulk import MAX_FRAME_BYTES
 from faabric_tpu.transport.point_to_point import GroupAbortedError
 from faabric_tpu.util.logging import get_logger
@@ -332,8 +337,14 @@ class MpiWorld:
                                        owned=_transfer)
         else:
             # Lazy wire form: the bulk plane sends header + array buffer
-            # straight from this rank's memory, no concatenation copy
-            payload = MpiWirePayload(msg_type, np.asarray(data), request_id)
+            # straight from this rank's memory, no concatenation copy.
+            # The serialize span exists for the bandwidth-attribution
+            # report: with zero-copy framing it SHOULD be ~0, and a fat
+            # one (non-contiguous input forcing a copy) is a suspect.
+            with span("mpi.wire", "serialize", rank=send_rank) \
+                    if tracing_enabled() else NULL_SPAN:
+                payload = MpiWirePayload(msg_type, np.asarray(data),
+                                         request_id)
         self.broker.send_message(self.group_id, send_rank, recv_rank,
                                  payload, must_order=True)
 
@@ -360,13 +371,22 @@ class MpiWorld:
             arr = raw.data
             owned = raw.owned
         else:
-            _, arr, _req = unpack_mpi_payload(raw)
+            _, arr, _req = self._unpack_wire(raw)
             # Wire arrays are exclusively ours but frombuffer-read-only;
             # writable ones (bytearray-backed) may be folded in place
             owned = arr.flags.writeable
         status = MpiStatus(source=send_rank, count=arr.size,
                            dtype=int(mpi_dtype_for(arr.dtype)))
         return arr, status, owned
+
+    @staticmethod
+    def _unpack_wire(raw):
+        """Wire unpack with a deserialize span for the attribution
+        report (zero-copy wrap for bulk-plane buffers; the span being
+        fat means the RPC plane's bytes→array copy is the suspect)."""
+        with span("mpi.wire", "deserialize", bytes=len(raw)) \
+                if tracing_enabled() else NULL_SPAN:
+            return unpack_mpi_payload(raw)
 
     def recv(self, send_rank: int, recv_rank: int,
              timeout: float | None = None) -> tuple[np.ndarray, MpiStatus]:
@@ -386,7 +406,7 @@ class MpiWorld:
                 except ValueError:
                     arr = arr.copy()
         else:
-            _, arr, _req = unpack_mpi_payload(raw)
+            _, arr, _req = self._unpack_wire(raw)
         status = MpiStatus(source=send_rank, count=arr.size,
                            dtype=int(mpi_dtype_for(arr.dtype)))
         return arr, status
@@ -731,7 +751,7 @@ class MpiWorld:
                                        must_order=True)
         if isinstance(raw, _LocalMpiPayload):
             return raw.msg_type, raw.data
-        msg_type, arr, _req = unpack_mpi_payload(raw)
+        msg_type, arr, _req = self._unpack_wire(raw)
         return msg_type, arr
 
     @staticmethod

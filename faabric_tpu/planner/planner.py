@@ -46,7 +46,12 @@ from faabric_tpu.proto import (
     update_batch_exec_group_id,
 )
 from faabric_tpu.faults import DROP, fault_point, faults_enabled
-from faabric_tpu.telemetry import get_metrics, span
+from faabric_tpu.telemetry import (
+    flight_dump,
+    flight_record,
+    get_metrics,
+    span,
+)
 from faabric_tpu.transport.common import MPI_BASE_PORT, MPI_PORTS_PER_HOST
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.gids import generate_gid
@@ -234,6 +239,7 @@ class Planner:
                      if now - h.register_ts > conf.planner_host_timeout]
             for ip in stale:
                 logger.warning("Expiring host %s (no keep-alive)", ip)
+                flight_record("host_expired", host=ip)
                 del self._hosts[ip]
             if stale:
                 # A dead worker cannot report results: recover its
@@ -713,6 +719,15 @@ class Planner:
                          and used < conf.planner_max_requeues)
             if retryable:
                 self._requeue_attempts[app_id] = used + 1
+        # Black-box entry: a recovery pass is exactly the moment a
+        # post-mortem wants the planner's recent history on disk.
+        # Recorded AFTER the already-completed filter and with the
+        # actual decision, so the dump never claims a requeue of
+        # messages that were in fact failed (or already done).
+        flight_record("planner_recovery", app=app_id,
+                      n_messages=len(msgs), retryable=retryable,
+                      reason=reason.decode("utf-8", "replace"))
+        flight_dump("planner_recovery")
         if not retryable:
             if in_flight and used >= conf.planner_max_requeues:
                 _RETRY_EXHAUSTED.inc(len(msgs))
@@ -847,6 +862,8 @@ class Planner:
         logger.warning("Requeued %d msgs of app %d onto %s after: %s",
                        len(todo), app_id,
                        sorted(set(new_decision.hosts)), reason.decode())
+        flight_record("planner_requeued", app=app_id, n_messages=len(todo),
+                      hosts=sorted(set(new_decision.hosts)))
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         _RECOVERY_SECONDS.observe(time.monotonic() - t_detect)
@@ -1214,6 +1231,47 @@ class Planner:
             "frozenApps": frozen,
         }
 
+    def health_summary(self) -> dict:
+        """Aggregate liveness view behind the planner's ``GET /healthz``:
+        per registered host the last keep-alive age and this planner's
+        circuit-breaker state toward it, plus in-flight counts. Built
+        entirely from planner-local state — a health probe must never
+        block on the workers it is asking about."""
+        conf = get_system_config()
+        now = time.monotonic()
+        with self._lock:
+            hosts = [{
+                "host": ip,
+                "slots": h.state.slots,
+                "usedSlots": h.state.used_slots,
+                "keepAliveAgeSeconds": round(now - h.register_ts, 3),
+                "timeoutSeconds": conf.planner_host_timeout,
+            } for ip, h in self._hosts.items()]
+            in_flight_apps = len(self._in_flight)
+            in_flight_messages = sum(
+                d.n_messages for _, d in self._in_flight.values())
+        # Breaker states live on the pooled dispatch clients; a host with
+        # no client yet simply has no breaker row
+        breakers = {}
+        for ip, client in self._clients.items():
+            b = getattr(client, "breaker", None)
+            if b is not None:
+                # .state/.failures, NOT .allow(): allow() consumes the
+                # half-open trial slot — a health probe must never eat
+                # the one attempt that would have closed the breaker
+                breakers[ip] = {
+                    "state": b.state,
+                    "consecutiveFailures": b.failures,
+                }
+        for row in hosts:
+            row["breaker"] = breakers.get(row["host"])
+        return {
+            "status": "ok",
+            "hosts": hosts,
+            "inFlightApps": in_flight_apps,
+            "inFlightMessages": in_flight_messages,
+        }
+
     def collect_telemetry(self, include_trace: bool = False,
                           timeout: float = 5.0) -> dict:
         """host label → {"metrics": snapshot, "trace": [events]} from this
@@ -1223,9 +1281,11 @@ class Planner:
         fails — or is wedged past ``timeout`` — is skipped, not fatal; a
         scrape must not go down (or block a Prometheus scrape window)
         with one bad host."""
-        from faabric_tpu.telemetry import trace_events
+        from faabric_tpu.telemetry import get_comm_matrix, trace_events
 
-        out: dict = {"planner": {"metrics": get_metrics().snapshot()}}
+        out: dict = {"planner": {"metrics": get_metrics().snapshot(),
+                                 "commmatrix":
+                                 get_comm_matrix().snapshot()}}
         if include_trace:
             out["planner"]["trace"] = trace_events()
 

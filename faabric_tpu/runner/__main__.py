@@ -49,8 +49,25 @@ def main(argv: list[str] | None = None) -> int:
     install_crash_handler()
 
     stop = threading.Event()
+
+    def _on_signal(signum, _frame):
+        # Dump the flight ring while the process state is still intact —
+        # but the shutdown signal must survive ANY flight failure. The
+        # recorded kind names the ACTUAL signal (a post-mortem must not
+        # claim a SIGTERM for an operator's Ctrl-C).
+        try:
+            from faabric_tpu.telemetry import flight_dump, flight_record
+
+            name = signal.Signals(signum).name.lower()
+            flight_record(name, role=args.role)
+            flight_dump(name)
+        except Exception:  # noqa: BLE001 — never lose the shutdown
+            pass
+        finally:
+            stop.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
+        signal.signal(sig, _on_signal)
 
     if args.role == "planner":
         from faabric_tpu.endpoint import PlannerHttpEndpoint

@@ -1,0 +1,116 @@
+"""Merge and pretty-print flight-recorder dumps from multiple hosts.
+
+    python -m faabric_tpu.runner.flightdump <dir> [--json] [--last N]
+                                            [--kind K]
+
+Each process that hit a dump trigger (MpiWorldAborted, planner requeue,
+unhandled executor exception, SIGTERM) left one
+``flight-<label>-<pid>-<ns>.json`` file in ``FAABRIC_FLIGHT_DIR``
+(telemetry/flight.py). This tool merges their event rings onto one
+wall-clock timeline — the black-box readout after a chaos run or a
+production incident.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_dumps(directory: str) -> list[dict]:
+    """Every parseable ``flight-*.json`` in ``directory`` (unreadable or
+    truncated files are skipped with a note on stderr, not fatal — a
+    post-mortem tool must tolerate a dump cut short by the crash)."""
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(directory, "flight-*.json"))):
+        try:
+            with open(path) as f:
+                body = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {path}: {e}", file=sys.stderr)
+            continue
+        body["_file"] = os.path.basename(path)
+        dumps.append(body)
+    return dumps
+
+
+def merge(directory: str) -> list[dict]:
+    """All dumps' events on one timeline: each event gains ``process``/
+    ``pid``/``dump_reason`` provenance and the list sorts by wall-clock
+    timestamp (hosts share the tracer's wall-anchored convention).
+
+    A process that hit several dump triggers (e.g. group abort then
+    SIGTERM) left overlapping ring snapshots; events dedupe on
+    (process, pid, ring seq), the NEWEST dump's copy winning, so the
+    merged black box reports each real event once."""
+    dumps = load_dumps(directory)
+    # Newest file last: its copy of a shared (pid, seq) event wins
+    dumps.sort(key=lambda d: d.get("dumped_at", 0.0))
+    by_key: dict[tuple, dict] = {}
+    for dump in dumps:
+        for e in dump.get("events", []):
+            key = (dump.get("process", "?"), dump.get("pid", 0),
+                   e.get("seq", -1))
+            by_key[key] = {**e,
+                           "process": dump.get("process", "?"),
+                           "pid": dump.get("pid", 0),
+                           "dump_reason": dump.get("reason", "?")}
+    events = list(by_key.values())
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return events
+
+
+def _fmt_fields(event: dict) -> str:
+    skip = ("ts", "seq", "kind", "process", "pid", "dump_reason")
+    return " ".join(f"{k}={event[k]}" for k in event if k not in skip)
+
+
+def render(events: list[dict], last: int | None = None) -> str:
+    if last is not None:
+        events = events[-last:]
+    if not events:
+        return "(no flight events)"
+    t0 = events[0].get("ts", 0.0)
+    lines = []
+    for e in events:
+        lines.append(
+            f"{e.get('ts', 0.0) - t0:+10.3f}s "
+            f"{e.get('process', '?'):<22} "
+            f"{e.get('kind', '?'):<20} {_fmt_fields(e)}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="faabric_tpu.runner.flightdump",
+        description="Merge + pretty-print flight-recorder dumps")
+    parser.add_argument("directory", nargs="?",
+                        default=os.environ.get("FAABRIC_FLIGHT_DIR", "."))
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable merged event list")
+    parser.add_argument("--last", type=int, default=None,
+                        help="only the final N events")
+    parser.add_argument("--kind", default=None,
+                        help="filter by event kind (e.g. group_abort)")
+    args = parser.parse_args(argv)
+
+    events = merge(args.directory)
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    if args.json:
+        if args.last is not None:
+            events = events[-args.last:]
+        print(json.dumps(events, indent=1))
+    else:
+        dumps = load_dumps(args.directory)
+        print(f"{len(dumps)} dump(s), {len(events)} event(s) "
+              f"from {args.directory}")
+        print(render(events, last=args.last))
+    return 0 if events else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,24 @@ class WorkerRuntime:
             StateServer(self.state, self.host),
         ]
 
+        # Liveness surface: GET /healthz answered locally (the chaos
+        # tests and deployment probes must not infer worker liveness
+        # from planner registration state). Opt-in by port — workers in
+        # in-process multi-host tests would otherwise fight over it.
+        import os
+
+        try:
+            http_port = int(os.environ.get("WORKER_HTTP_PORT", "0"))
+        except ValueError:
+            logger.warning("Ignoring malformed WORKER_HTTP_PORT=%r",
+                           os.environ.get("WORKER_HTTP_PORT"))
+            http_port = 0
+        if http_port:
+            from faabric_tpu.endpoint import WorkerHttpEndpoint
+
+            self.extra_servers.append(
+                WorkerHttpEndpoint(http_port, runtime=self))
+
         self._started = False
 
     # ------------------------------------------------------------------

@@ -164,9 +164,14 @@ class FunctionCallServer(MessageEndpointServer):
         if msg.code == int(FunctionCalls.GET_TELEMETRY):
             import json as _json
 
-            from faabric_tpu.telemetry import get_metrics, trace_events
+            from faabric_tpu.telemetry import (
+                get_comm_matrix,
+                get_metrics,
+                trace_events,
+            )
 
-            body: dict = {"metrics": get_metrics().snapshot()}
+            body: dict = {"metrics": get_metrics().snapshot(),
+                          "commmatrix": get_comm_matrix().snapshot()}
             if msg.header.get("trace"):
                 body["trace"] = trace_events()
             # Payload, not header: a full trace buffer is bulk data

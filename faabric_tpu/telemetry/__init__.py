@@ -12,6 +12,20 @@ Two layers, one import surface:
 See docs/telemetry.md for env vars and capture recipes.
 """
 
+from faabric_tpu.telemetry.commmatrix import (
+    NULL_COMM_MATRIX,
+    CommMatrix,
+    families_from_cells,
+    get_comm_matrix,
+    merge_cell_rows,
+)
+from faabric_tpu.telemetry.flight import (
+    NULL_FLIGHT,
+    FlightRecorder,
+    flight_dump,
+    flight_record,
+    get_flight,
+)
 from faabric_tpu.telemetry.metrics import (
     DEFAULT_BUCKETS,
     NULL_METRIC,
@@ -30,11 +44,19 @@ from faabric_tpu.telemetry.tracer import (
     Tracer,
     chrome_trace,
     chrome_trace_json,
+    current_trace_context,
+    decode_trace_context,
+    encode_trace_context,
+    flow_end,
+    flow_id_for,
+    flow_start,
     get_tracer,
+    instant,
     reset_tracing,
     set_process_label,
     set_tracing,
     span,
+    span_from_remote,
     summary_data,
     text_summary,
     trace_events,
@@ -43,17 +65,34 @@ from faabric_tpu.telemetry.tracer import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "NULL_COMM_MATRIX",
+    "NULL_FLIGHT",
     "NULL_METRIC",
     "NULL_SPAN",
+    "CommMatrix",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "Tracer",
     "chrome_trace",
     "chrome_trace_json",
+    "current_trace_context",
+    "decode_trace_context",
+    "encode_trace_context",
+    "families_from_cells",
+    "flight_dump",
+    "flight_record",
+    "flow_end",
+    "flow_id_for",
+    "flow_start",
+    "get_comm_matrix",
+    "get_flight",
     "get_metrics",
     "get_tracer",
+    "instant",
+    "merge_cell_rows",
     "metrics_enabled",
     "render_snapshots",
     "reset_tracing",
@@ -62,6 +101,7 @@ __all__ = [
     "set_tracing",
     "snapshot_delta",
     "span",
+    "span_from_remote",
     "summary_data",
     "text_summary",
     "trace_events",

@@ -1,0 +1,216 @@
+"""Per-link communication matrix: who sends how much to whom, over what.
+
+Every REMOTE send is attributed to a ``(src rank, dst rank, plane)``
+cell — plane ∈ {``ptp`` (shared RPC plane), ``bulk-tcp`` (dedicated
+tuned-socket data plane), ``shm`` (same-machine ring)} — counting
+messages, payload bytes and a small send-latency histogram. Same-host
+in-process queue delivery is deliberately NOT counted: it is the 6 GiB/s
+hot path and carries no wire to attribute.
+
+This is the data HiCCL-style collective tuning needs before any
+optimization: the 0.62-vs-6.01 GiB/s allreduce gap stops being a single
+mystery number once each (src, dst, plane) link reports its own
+bytes/latency and the bench's attribution report ranks the suspects.
+
+Cardinality guard: ranks ≥ ``FAABRIC_COMMMATRIX_MAX_RANKS`` (default 64)
+collapse into one ``other`` bucket per direction, so a 256-rank world
+yields at most (N+1)² × 3 series instead of 196k — ``/metrics`` stays
+kilobytes.
+
+Export: ``snapshot()`` is the JSON-safe wire form riding GET_TELEMETRY;
+``families()`` renders the same cells in the metrics-registry snapshot
+schema so the planner can merge them into the Prometheus ``/metrics``
+page (labels ``src``, ``dst``, ``plane`` + the per-host ``host`` label).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from faabric_tpu.telemetry.metrics import metrics_enabled
+
+PLANES = ("ptp", "bulk-tcp", "shm")
+
+# Send-latency buckets (seconds): sub-ms ring pushes to multi-second
+# wedged sockets. Coarser than DEFAULT_BUCKETS — per-link histograms
+# multiply by rank-pair cardinality.
+LATENCY_BUCKETS = (0.0001, 0.001, 0.01, 0.1, 1.0, 10.0)
+
+DEFAULT_MAX_RANKS = 64
+OTHER = "other"
+
+
+class _Cell:
+    __slots__ = ("messages", "bytes", "lat_sum", "lat_count", "lat_counts",
+                 "_lock")
+
+    def __init__(self) -> None:
+        self.messages = 0
+        self.bytes = 0
+        self.lat_sum = 0.0
+        self.lat_count = 0
+        self.lat_counts = [0] * len(LATENCY_BUCKETS)
+        self._lock = threading.Lock()
+
+    def add(self, nbytes: int, seconds: float | None) -> None:
+        with self._lock:
+            self.messages += 1
+            self.bytes += nbytes
+            if seconds is not None:
+                self.lat_sum += seconds
+                self.lat_count += 1
+                for i, ub in enumerate(LATENCY_BUCKETS):
+                    if seconds <= ub:
+                        self.lat_counts[i] += 1
+                        break
+
+
+class _NullCommMatrix:
+    """Shared no-op returned while metrics are disabled."""
+
+    __slots__ = ()
+
+    def record(self, src, dst, plane, nbytes, seconds=None) -> None:
+        pass
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def families(self) -> dict:
+        return {}
+
+    def reset(self) -> None:
+        pass
+
+
+NULL_COMM_MATRIX = _NullCommMatrix()
+
+
+class CommMatrix:
+    def __init__(self, max_ranks: int | None = None) -> None:
+        if max_ranks is None:
+            try:
+                max_ranks = int(os.environ.get(
+                    "FAABRIC_COMMMATRIX_MAX_RANKS", DEFAULT_MAX_RANKS))
+            except ValueError:
+                # Malformed knob degrades to the default; the matrix is
+                # created lazily from send hot paths and must not raise
+                max_ranks = DEFAULT_MAX_RANKS
+        self.max_ranks = max_ranks
+        self._lock = threading.Lock()
+        # (src_label, dst_label, plane) → _Cell; cell creation takes the
+        # registry lock, updates take only the cell's own
+        self._cells: dict[tuple, _Cell] = {}
+
+    def _rank_label(self, rank) -> str:
+        try:
+            r = int(rank)
+        except (TypeError, ValueError):
+            return OTHER
+        return str(r) if 0 <= r < self.max_ranks else OTHER
+
+    def record(self, src, dst, plane: str, nbytes: int,
+               seconds: float | None = None) -> None:
+        key = (self._rank_label(src), self._rank_label(dst), plane)
+        cell = self._cells.get(key)
+        if cell is None:
+            with self._lock:
+                cell = self._cells.setdefault(key, _Cell())
+        cell.add(int(nbytes), seconds)
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-safe wire form: ``{"max_ranks", "cells": [...]}`` with one
+        row per live (src, dst, plane)."""
+        with self._lock:
+            items = list(self._cells.items())
+        cells = []
+        for (src, dst, plane), c in items:
+            with c._lock:
+                cells.append({
+                    "src": src, "dst": dst, "plane": plane,
+                    "messages": c.messages, "bytes": c.bytes,
+                    "lat_sum": round(c.lat_sum, 9),
+                    "lat_count": c.lat_count,
+                    "lat_buckets": [[b, n] for b, n in
+                                    zip(LATENCY_BUCKETS, c.lat_counts)],
+                })
+        cells.sort(key=lambda r: -r["bytes"])
+        return {"max_ranks": self.max_ranks, "cells": cells}
+
+    def families(self) -> dict:
+        """The same cells in the metrics-registry ``snapshot()`` schema,
+        mergeable by ``render_snapshots`` into Prometheus exposition."""
+        return families_from_cells(self.snapshot().get("cells", []))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells.clear()
+
+
+def families_from_cells(cells: list[dict]) -> dict:
+    """Registry-schema families from a snapshot's cell rows (used both
+    process-locally and planner-side on scraped worker snapshots)."""
+    msgs, byts, lat = [], [], []
+    for c in cells:
+        labels = {"src": c["src"], "dst": c["dst"], "plane": c["plane"]}
+        msgs.append({"labels": labels, "value": c["messages"]})
+        byts.append({"labels": labels, "value": c["bytes"]})
+        lat.append({"labels": labels, "sum": c.get("lat_sum", 0.0),
+                    "count": c.get("lat_count", 0),
+                    "buckets": c.get("lat_buckets", [])})
+    if not cells:
+        return {}
+    return {
+        "faabric_comm_messages_total": {
+            "type": "counter",
+            "help": "Remote messages sent per (src, dst, plane) link",
+            "series": msgs},
+        "faabric_comm_bytes_total": {
+            "type": "counter",
+            "help": "Remote payload bytes sent per (src, dst, plane) link",
+            "series": byts},
+        "faabric_comm_send_seconds": {
+            "type": "histogram",
+            "help": "Per-message send latency per (src, dst, plane) link",
+            "series": lat},
+    }
+
+
+def merge_cell_rows(per_host: dict[str, list[dict]]) -> list[dict]:
+    """Merge hosts' cell rows for the JSON ``/commmatrix`` totals view:
+    same (src, dst, plane) across hosts sums (each host only reports its
+    own outbound sends, so summing never double-counts)."""
+    merged: dict[tuple, dict] = {}
+    for _host, cells in per_host.items():
+        for c in cells:
+            key = (c["src"], c["dst"], c["plane"])
+            m = merged.get(key)
+            if m is None:
+                merged[key] = {"src": c["src"], "dst": c["dst"],
+                               "plane": c["plane"], "messages": 0,
+                               "bytes": 0, "lat_sum": 0.0, "lat_count": 0}
+                m = merged[key]
+            m["messages"] += c.get("messages", 0)
+            m["bytes"] += c.get("bytes", 0)
+            m["lat_sum"] += c.get("lat_sum", 0.0)
+            m["lat_count"] += c.get("lat_count", 0)
+    out = list(merged.values())
+    out.sort(key=lambda r: -r["bytes"])
+    return out
+
+
+_matrix: CommMatrix | None = None
+_matrix_lock = threading.Lock()
+
+
+def get_comm_matrix() -> CommMatrix | _NullCommMatrix:
+    if not metrics_enabled():
+        return NULL_COMM_MATRIX
+    global _matrix
+    if _matrix is None:
+        with _matrix_lock:
+            if _matrix is None:
+                _matrix = CommMatrix()
+    return _matrix

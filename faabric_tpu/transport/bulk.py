@@ -34,7 +34,10 @@ import numpy as np
 
 from faabric_tpu.faults import fault_point, faults_enabled
 from faabric_tpu.telemetry import (
+    NULL_FLIGHT,
     NULL_SPAN,
+    get_comm_matrix,
+    get_flight,
     get_metrics,
     span,
     tracing_enabled,
@@ -81,6 +84,12 @@ _BULK_SEND_SECONDS = {
 _BULK_RECONNECTS = _metrics.counter(
     "faabric_bulk_reconnects_total",
     "Reconnect-and-resend recoveries after a stale/reset bulk connection")
+
+# Per-(src, dst, plane) link attribution; shared no-op when metrics off.
+# The flight handle is held the same way: with FAABRIC_FLIGHT=0 the
+# per-frame record must not even build its kwargs dict.
+_COMM = get_comm_matrix()
+_FLIGHT = get_flight()
 
 _FAULTS = faults_enabled()
 _FP_BULK = fault_point("transport.bulk")
@@ -454,7 +463,14 @@ class BulkClient:
                     self.shm_frames += 1
                     _BULK_TX_FRAMES["shm"].inc()
                     _BULK_TX_BYTES["shm"].inc(nbytes)
-                    _BULK_SEND_SECONDS["shm"].observe(time.monotonic() - t0)
+                    elapsed = time.monotonic() - t0
+                    _BULK_SEND_SECONDS["shm"].observe(elapsed)
+                    _COMM.record(send_idx, recv_idx, "shm", nbytes,
+                                 elapsed)
+                    if _FLIGHT is not NULL_FLIGHT:
+                        _FLIGHT.record("send", group=group_id,
+                                       src=send_idx, dst=recv_idx,
+                                       plane="shm", bytes=nbytes)
                     return
                 logger.warning("Shm ring for %s stalled; abandoning ring, "
                                "staying on TCP", self.host)
@@ -484,7 +500,14 @@ class BulkClient:
                         self._sock.sendall(v)
                 _BULK_TX_FRAMES["tcp"].inc()
                 _BULK_TX_BYTES["tcp"].inc(nbytes)
-                _BULK_SEND_SECONDS["tcp"].observe(time.monotonic() - t0)
+                elapsed = time.monotonic() - t0
+                _BULK_SEND_SECONDS["tcp"].observe(elapsed)
+                _COMM.record(send_idx, recv_idx, "bulk-tcp", nbytes,
+                             elapsed)
+                if _FLIGHT is not NULL_FLIGHT:
+                    _FLIGHT.record("send", group=group_id, src=send_idx,
+                                   dst=recv_idx, plane="bulk-tcp",
+                                   bytes=nbytes)
             except OSError:
                 # One reconnect-and-resend attempt: the dominant failure
                 # here is the STALE-SOCKET signature — the peer closed
@@ -514,8 +537,14 @@ class BulkClient:
                     _BULK_RECONNECTS.inc()
                     _BULK_TX_FRAMES["tcp"].inc()
                     _BULK_TX_BYTES["tcp"].inc(nbytes)
-                    _BULK_SEND_SECONDS["tcp"].observe(
-                        time.monotonic() - t0)
+                    elapsed = time.monotonic() - t0
+                    _BULK_SEND_SECONDS["tcp"].observe(elapsed)
+                    _COMM.record(send_idx, recv_idx, "bulk-tcp", nbytes,
+                                 elapsed)
+                    if _FLIGHT is not NULL_FLIGHT:
+                        _FLIGHT.record("send", group=group_id,
+                                       src=send_idx, dst=recv_idx,
+                                       plane="bulk-tcp", bytes=nbytes)
                 except BaseException:
                     # A half-written frame must never linger on a kept
                     # socket — the receiver would splice the NEXT frame

@@ -22,7 +22,11 @@ import time
 from typing import Any
 
 from faabric_tpu.faults import DROP, fault_point, faults_enabled
-from faabric_tpu.telemetry import get_metrics
+from faabric_tpu.telemetry import (
+    current_trace_context,
+    get_metrics,
+    tracing_enabled,
+)
 from faabric_tpu.transport.common import DEFAULT_SOCKET_TIMEOUT, resolve_host
 from faabric_tpu.transport.message import (
     MessageResponseCode,
@@ -105,10 +109,25 @@ class MessageEndpointClient:
                 pass
         self._socks[plane] = None
 
+    @staticmethod
+    def _with_trace_context(header: dict[str, Any] | None) -> dict[str, Any]:
+        """Stamp the active span's (trace id, span id) into the outbound
+        JSON header (``_tc``) so the server's handler span links to this
+        caller across the host boundary. Copy-on-write: callers may
+        share header dicts."""
+        if tracing_enabled():
+            tc = current_trace_context()
+            if tc is not None:
+                header = dict(header) if header else {}
+                header["_tc"] = tc
+                return header
+        return header or {}
+
     def async_send(self, code: int, header: dict[str, Any] | None = None,
                    payload: bytes = b"", seqnum: int = -1) -> None:
-        msg = TransportMessage(code=code, header=header or {}, payload=payload,
-                               seqnum=seqnum)
+        msg = TransportMessage(code=code,
+                               header=self._with_trace_context(header),
+                               payload=payload, seqnum=seqnum)
         with self._locks["async"]:
             self._check_breaker("async")
             last = self.retry.max_attempts - 1
@@ -153,7 +172,9 @@ class MessageEndpointClient:
           zero response bytes, not a timeout — i.e. a server restart
           between requests).
         """
-        msg = TransportMessage(code=code, header=header or {}, payload=payload)
+        msg = TransportMessage(code=code,
+                               header=self._with_trace_context(header),
+                               payload=payload)
         t0 = time.monotonic()
         with self._locks["sync"]:
             self._check_breaker("sync")

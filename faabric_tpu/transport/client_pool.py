@@ -46,6 +46,13 @@ class ClientPool(Generic[T]):
             except Exception:  # noqa: BLE001
                 pass
 
+    def items(self) -> list[tuple[str, T]]:
+        """Snapshot of (host, client) pairs — observability surfaces
+        (e.g. the planner's /healthz breaker report) read this without
+        creating clients."""
+        with self._lock:
+            return list(self._clients.items())
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._clients)

@@ -28,7 +28,18 @@ from typing import Optional
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
 from faabric_tpu.proto import PointToPointMapping, PointToPointMappings
-from faabric_tpu.telemetry import get_metrics
+from faabric_tpu.telemetry import (
+    NULL_FLIGHT,
+    flight_dump,
+    flight_record,
+    flow_id_for,
+    get_comm_matrix,
+    get_flight,
+    get_metrics,
+    get_tracer,
+    span,
+    tracing_enabled,
+)
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.latch import FlagWaiter
 from faabric_tpu.util.logging import get_logger
@@ -48,6 +59,12 @@ PEER_PROBE_TIMEOUT = 0.5
 _GROUP_ABORTS = get_metrics().counter(
     "faabric_ptp_group_aborts_total",
     "Watched groups aborted after a peer failure")
+
+# Per-link attribution for remote sends (telemetry/commmatrix.py); the
+# handle is a shared no-op when metrics are disabled. Flight handle held
+# the same way so a disabled recorder costs one identity check per send.
+_COMM = get_comm_matrix()
+_FLIGHT = get_flight()
 
 
 class GroupAbortedError(RuntimeError):
@@ -204,6 +221,11 @@ class PointToPointBroker:
         _GROUP_ABORTS.inc()
         logger.warning("Aborting group %d on %s: %s", group_id, self.host,
                        reason)
+        # Black-box record: the abort transition lands in the flight ring
+        # and the ring is dumped — this IS the MpiWorldAborted post-mortem
+        flight_record("group_abort", group=group_id, host=self.host,
+                      reason=reason)
+        flight_dump("mpi_world_aborted")
         for q in queues:
             q.enqueue((NO_SEQUENCE_NUM, _ABORT))
         for host in sorted(peer_hosts):
@@ -289,53 +311,82 @@ class PointToPointBroker:
         if dst_host == self.host:
             self.deliver(group_id, send_idx, recv_idx, data, seq, channel)
         else:
-            # Large payloads ride the dedicated bulk plane (tuned sockets,
-            # scatter-gather send straight from the source buffers,
-            # recv_into preallocated buffers — transport/bulk.py); peers
-            # without a bulk server fall back to the RPC plane
-            from faabric_tpu.transport.bulk import (
-                BULK_THRESHOLD,
-                MAX_FRAME_BYTES,
-            )
-            from faabric_tpu.util.testing import is_mock_mode
+            # Cross-host: a send span + a flow-start event (same
+            # deterministic id the receiving host's recv derives from
+            # the sequence tuple) turn the merged /trace into causal
+            # send→recv arrows instead of per-host islands
+            if tracing_enabled():
+                with span("ptp", "send", group=group_id, src=send_idx,
+                          dst=recv_idx, dest_host=dst_host,
+                          bytes=len(data), remote=True):
+                    if seq != NO_SEQUENCE_NUM:
+                        get_tracer().flow_start(
+                            flow_id_for(group_id, send_idx, recv_idx,
+                                        channel, seq))
+                    self._send_remote(group_id, send_idx, recv_idx, data,
+                                      seq, channel, dst_host)
+            else:
+                self._send_remote(group_id, send_idx, recv_idx, data, seq,
+                                  channel, dst_host)
 
-            if (BULK_THRESHOLD <= len(data) <= MAX_FRAME_BYTES
-                    and not is_mock_mode()
-                    and not self._bulk_down(dst_host)):
-                bufs = (data.buffers() if hasattr(data, "buffers")
-                        else [data])
-                try:
-                    self._get_bulk_client(dst_host).send(
-                        group_id, send_idx, recv_idx, bufs, seq, channel)
-                    return
-                except (OSError, ValueError, struct.error) as e:
-                    # Remember the outage so chunk streams don't pay a
-                    # connect attempt (or timeout) per chunk
-                    self._mark_bulk_down(dst_host)
-                    logger.debug("Bulk send to %s unavailable (%s); using "
-                                 "RPC plane for %.0fs", dst_host, e,
-                                 self.BULK_RETRY_SECONDS)
-            # Lazy wire payloads (and zero-copy local payloads re-routed
-            # remote under live migration) convert to contiguous bytes
-            # late, only for the RPC plane
-            if not isinstance(data, (bytes, bytearray, memoryview)) \
-                    and hasattr(data, "to_bytes"):
-                data = data.to_bytes()
-            from faabric_tpu.transport.client import RpcError
+    def _send_remote(self, group_id: int, send_idx: int, recv_idx: int,
+                     data, seq: int, channel: int, dst_host: str) -> None:
+        # Large payloads ride the dedicated bulk plane (tuned sockets,
+        # scatter-gather send straight from the source buffers,
+        # recv_into preallocated buffers — transport/bulk.py); peers
+        # without a bulk server fall back to the RPC plane
+        from faabric_tpu.transport.bulk import (
+            BULK_THRESHOLD,
+            MAX_FRAME_BYTES,
+        )
+        from faabric_tpu.util.testing import is_mock_mode
 
+        if (BULK_THRESHOLD <= len(data) <= MAX_FRAME_BYTES
+                and not is_mock_mode()
+                and not self._bulk_down(dst_host)):
+            bufs = (data.buffers() if hasattr(data, "buffers")
+                    else [data])
             try:
-                self._get_client(dst_host).send_message(
-                    group_id, send_idx, recv_idx, data, seq, channel)
-            except RpcError as e:
-                if self._is_watched(group_id):
-                    # A terminally-failed send to a watched peer dooms
-                    # the whole group: surface one typed abort (bounded
-                    # — the client's retry/breaker already ran) instead
-                    # of letting every rank discover it separately
-                    reason = f"send to {dst_host} failed: {e}"
-                    self.abort_group(group_id, reason)
-                    raise GroupAbortedError(group_id, reason) from e
-                raise
+                # The bulk client attributes the send to the comm matrix
+                # itself — it alone knows whether the frame rode the shm
+                # ring or the tuned TCP connection
+                self._get_bulk_client(dst_host).send(
+                    group_id, send_idx, recv_idx, bufs, seq, channel)
+                return
+            except (OSError, ValueError, struct.error) as e:
+                # Remember the outage so chunk streams don't pay a
+                # connect attempt (or timeout) per chunk
+                self._mark_bulk_down(dst_host)
+                logger.debug("Bulk send to %s unavailable (%s); using "
+                             "RPC plane for %.0fs", dst_host, e,
+                             self.BULK_RETRY_SECONDS)
+        # Lazy wire payloads (and zero-copy local payloads re-routed
+        # remote under live migration) convert to contiguous bytes
+        # late, only for the RPC plane
+        if not isinstance(data, (bytes, bytearray, memoryview)) \
+                and hasattr(data, "to_bytes"):
+            data = data.to_bytes()
+        from faabric_tpu.transport.client import RpcError
+
+        t0 = time.monotonic()
+        try:
+            self._get_client(dst_host).send_message(
+                group_id, send_idx, recv_idx, data, seq, channel)
+        except RpcError as e:
+            if self._is_watched(group_id):
+                # A terminally-failed send to a watched peer dooms
+                # the whole group: surface one typed abort (bounded
+                # — the client's retry/breaker already ran) instead
+                # of letting every rank discover it separately
+                reason = f"send to {dst_host} failed: {e}"
+                self.abort_group(group_id, reason)
+                raise GroupAbortedError(group_id, reason) from e
+            raise
+        _COMM.record(send_idx, recv_idx, "ptp", len(data),
+                     time.monotonic() - t0)
+        if _FLIGHT is not NULL_FLIGHT:
+            _FLIGHT.record("send", group=group_id, src=send_idx,
+                           dst=recv_idx, plane="ptp", bytes=len(data))
 
     def deliver(self, group_id: int, send_idx: int, recv_idx: int,
                 data: bytes, seq: int = NO_SEQUENCE_NUM,
@@ -348,6 +399,33 @@ class PointToPointBroker:
                      must_order: bool = False,
                      timeout: float | None = None,
                      channel: int = DATA_CHANNEL) -> bytes:
+        if not tracing_enabled():
+            return self._recv_message_impl(group_id, send_idx, recv_idx,
+                                           must_order, timeout, channel)[0]
+        # The recv span's duration IS the enqueue-wait (time this
+        # consumer blocked before the message was deliverable); the
+        # flow-end event (same id the sender derived from the sequence
+        # tuple) closes the cross-host send→recv arrow. Emitted only
+        # when the sender is REMOTE — the local send path emits no
+        # flow-start, and an unmatched finish per local message would
+        # evict real spans from the bounded trace ring.
+        with span("ptp", "recv", group=group_id, src=send_idx,
+                  dst=recv_idx):
+            data, seq = self._recv_message_impl(
+                group_id, send_idx, recv_idx, must_order, timeout, channel)
+            if seq != NO_SEQUENCE_NUM:
+                with self._lock:
+                    m = self._mappings.get(group_id, {}).get(send_idx)
+                if m is not None and m.host != self.host:
+                    get_tracer().flow_end(
+                        flow_id_for(group_id, send_idx, recv_idx, channel,
+                                    seq))
+            return data
+
+    def _recv_message_impl(self, group_id: int, send_idx: int,
+                           recv_idx: int, must_order: bool,
+                           timeout: float | None,
+                           channel: int) -> tuple[bytes, int]:
         conf = get_system_config()
         timeout = timeout if timeout is not None else conf.global_message_timeout
         key = (group_id, send_idx, recv_idx, channel)
@@ -363,20 +441,20 @@ class PointToPointBroker:
             with self._lock:
                 backlog = self._unseq.get(key)
                 if backlog:
-                    return backlog.popleft()
+                    return backlog.popleft(), NO_SEQUENCE_NUM
                 buf = self._ooo.get(key)
                 if buf:
                     seq = min(buf)
                     self._recv_seq[key] = max(
                         self._recv_seq.get(key, -1), seq)
-                    return buf.pop(seq)
+                    return buf.pop(seq), seq
             deadline = time.monotonic() + timeout
             while True:
                 slice_t = max(0.0, deadline - time.monotonic())
                 if watched:
                     slice_t = min(slice_t, conf.mpi_abort_check_seconds)
                 try:
-                    _, data = q.dequeue(timeout=slice_t)
+                    seq, data = q.dequeue(timeout=slice_t)
                 except QueueTimeoutException as e:
                     if watched:
                         self._probe_sender(key)  # may abort + raise
@@ -388,7 +466,7 @@ class PointToPointBroker:
                 if data is _ABORT:
                     raise GroupAbortedError(
                         group_id, self._aborted.get(group_id, ""))
-                return data
+                return data, seq
 
         # Ordered path: consume in seq order, buffering whatever arrives
         # early (reference PointToPointBroker.cpp:778-862).
@@ -398,10 +476,10 @@ class PointToPointBroker:
         kind, payload = nxt
         with self._lock:
             if kind == "unseq":
-                return self._unseq[key].popleft()
+                return self._unseq[key].popleft(), NO_SEQUENCE_NUM
             expected = self._recv_seq.get(key, -1) + 1
             self._recv_seq[key] = expected
-            return self._ooo[key].pop(expected)
+            return self._ooo[key].pop(expected), expected
 
     def _scan_next(self, key, q, timeout: float | None,
                    blocking: bool = True):

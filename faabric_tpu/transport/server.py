@@ -25,7 +25,7 @@ from faabric_tpu.telemetry import (
     NULL_METRIC,
     NULL_SPAN,
     get_metrics,
-    span,
+    span_from_remote,
     tracing_enabled,
 )
 from faabric_tpu.transport.message import (
@@ -268,9 +268,14 @@ class MessageEndpointServer:
     def _handle_sync(self, msg: TransportMessage, conn: socket.socket) -> None:
         t0 = time.monotonic()
         try:
-            # Per-RPC: skip even the kwargs-dict build when tracing is off
-            with span("transport", "sync_handle", server=self.label,
-                      code=msg.code) if tracing_enabled() else NULL_SPAN:
+            # Per-RPC: skip even the kwargs-dict build when tracing is
+            # off. The client's trace context ("_tc") makes this handler
+            # span a CHILD of the remote caller's span in the merged
+            # /trace instead of a per-host island.
+            with span_from_remote("transport", "sync_handle",
+                                  msg.header.get("_tc"), server=self.label,
+                                  code=msg.code) \
+                    if tracing_enabled() else NULL_SPAN:
                 resp = self.do_sync_recv(msg)
             if resp is None:
                 resp = TransportMessage(code=msg.code)
@@ -300,8 +305,10 @@ class MessageEndpointServer:
                 _QUEUE_DEPTH.set(self._work.size())
             t0 = time.monotonic()
             try:
-                with span("transport", "async_handle", server=self.label,
-                          code=msg.code) if tracing_enabled() \
+                with span_from_remote("transport", "async_handle",
+                                      msg.header.get("_tc"),
+                                      server=self.label,
+                                      code=msg.code) if tracing_enabled() \
                         else NULL_SPAN:
                     self.do_async_recv(msg)
             except Exception:  # noqa: BLE001

@@ -53,6 +53,14 @@ class CircuitBreaker:
             self._maybe_half_open_locked()
             return self._state
 
+    @property
+    def failures(self) -> int:
+        """Consecutive failures since the last success (observability
+        surfaces read this; unlike allow() it never consumes the
+        half-open trial slot)."""
+        with self._lock:
+            return self._failures
+
     def _maybe_half_open_locked(self) -> None:
         if (self._state == self.OPEN
                 and self._clock() - self._opened_at >= self.reset_after):

@@ -76,49 +76,54 @@ class DistExecutor(Executor):
         """12 MiB-per-rank allreduce: exercises the chunk-pipelined
         leader trees + bulk data plane inside a planner-scheduled world
         across real worker processes."""
-        from faabric_tpu.mpi import MpiOp, get_mpi_context
-
-        ctx = get_mpi_context()
-        if msg.mpi_rank == 0 and not msg.is_mpi:
-            msg.is_mpi = True
-            msg.mpi_world_id = 7500
-            msg.mpi_world_size = 8
-            world = ctx.create_world(msg)
-        else:
-            world = ctx.join_world(msg)
-        rank = msg.mpi_rank
-        world.refresh_rank_hosts()
-        n = (12 << 20) // 4
-        out = world.allreduce(rank, np.full(n, rank + 1, np.int32),
-                              MpiOp.SUM)
-        world.barrier(rank)
-        ok = bool((out == 36).all())  # sum of 1..8, EVERY chunk
-        msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
-        return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
+        return self._allreduce_workload(msg, 7500, 12 << 20)
 
     def fn_mpi_telemetry(self, msg, req):
         """12 MiB-per-rank allreduce on its OWN world id, driven by the
         telemetry acceptance test — worlds persist per worker process,
         so reusing mpi_big's id would collide with its test."""
+        return self._allreduce_workload(msg, 7510, 12 << 20)
+
+    def _allreduce_workload(self, msg, world_id: int, nbytes: int,
+                            rounds: int = 1):
+        """Shared body for the one-shot allreduce workloads: create/join
+        a world on ``world_id``, run ``rounds`` allreduces of
+        ``nbytes`` int32 per rank, verify every element equals
+        sum(1..size)."""
         from faabric_tpu.mpi import MpiOp, get_mpi_context
 
         ctx = get_mpi_context()
         if msg.mpi_rank == 0 and not msg.is_mpi:
             msg.is_mpi = True
-            msg.mpi_world_id = 7510
+            msg.mpi_world_id = world_id
             msg.mpi_world_size = 8
             world = ctx.create_world(msg)
         else:
             world = ctx.join_world(msg)
         rank = msg.mpi_rank
         world.refresh_rank_hosts()
-        n = (12 << 20) // 4
-        out = world.allreduce(rank, np.full(n, rank + 1, np.int32),
-                              MpiOp.SUM)
+        n = nbytes // 4
+        out = None
+        for _ in range(rounds):
+            out = world.allreduce(rank, np.full(n, rank + 1, np.int32),
+                                  MpiOp.SUM)
         world.barrier(rank)
-        ok = bool((out == 36).all())
+        expected = world.size * (world.size + 1) // 2
+        ok = bool((out == expected).all())
         msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
         return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
+
+    def fn_mpi_flow(self, msg, req):
+        """Cross-host trace-propagation workload (PR 3): a few 1 MiB
+        allreduces on a dedicated world id so the /trace scrape finds
+        fresh remote send/recv flow pairs across the worker processes."""
+        return self._allreduce_workload(msg, 7520, 1 << 20, rounds=3)
+
+    def fn_mpi_matrix(self, msg, req):
+        """Comm-matrix acceptance workload: a 12 MiB-per-rank allreduce
+        on its own world id so /commmatrix sees fresh bulk-plane bytes
+        regardless of which other dist tests ran first."""
+        return self._allreduce_workload(msg, 7530, 12 << 20)
 
     def fn_mpi_reduce_many(self, msg, req):
         """Port of the reference example mpi_reduce_many
@@ -987,6 +992,11 @@ if __name__ == "__main__":
     import signal
 
     faulthandler.register(signal.SIGUSR1)
+    # Black box on teardown: when FAABRIC_FLIGHT_DIR is set, SIGTERM
+    # leaves a flight dump before the process exits
+    from faabric_tpu.telemetry.flight import install_signal_dump
+
+    install_signal_dump()
     role = sys.argv[1]
     if role == "planner":
         run_planner(int(sys.argv[2]) if len(sys.argv) > 2 else 0)

@@ -247,6 +247,82 @@ def test_chaos_mpi_world_abort_is_bounded():
 
 
 @pytest.mark.slow
+def test_chaos_sigkill_leaves_flight_recorder_dumps(tmp_path):
+    """PR 3 acceptance: SIGKILL a worker hosting half an MPI world and
+    every SURVIVING process leaves a flight-recorder dump in
+    FAABRIC_FLIGHT_DIR — the surviving worker on the MpiWorldAborted
+    transition, the planner on its recovery pass — and the merged ring
+    contains both the injected fault firings (armed via FAABRIC_FAULTS
+    on the workers) and the group-abort transition."""
+    flight_dir = str(tmp_path / "flight")
+    cluster = ChaosCluster(
+        "ckF", n_workers=2, slots=(4, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "3",
+                   "MPI_ABORT_CHECK_SECONDS": "1",
+                   "FAABRIC_FLIGHT_DIR": flight_dir},
+        # Harmless injected delays on the collective path: chaos runs
+        # must be distinguishable from real faults in the black box
+        worker_env={"FAABRIC_FAULTS": "mpi.collective=delay:1ms@times=3"},
+    ).start()
+    try:
+        me = cluster.me
+        req = batch_exec_factory("dist", "mpi_abort", 1)
+        req.messages[0].mpi_rank = 0
+        me.planner_client.call_functions(req)
+
+        deadline = time.time() + 30
+        live = None
+        while time.time() < deadline:
+            live = me.planner_client.get_scheduling_decision(req.app_id)
+            if live is not None and live.n_messages == 8 \
+                    and len(set(live.hosts)) == 2:
+                break
+            time.sleep(0.2)
+        assert live is not None and live.n_messages == 8, live
+        rank0_host = live.hosts[live.group_idxs.index(0)]
+        victim = next(w for w in cluster.workers if w != rank0_host)
+        survivor = next(w for w in cluster.workers if w != victim)
+        time.sleep(1.0)  # collective rounds (and fault firings) underway
+        cluster.kill(victim)
+
+        wait_finished(me, req.app_id, timeout=90)
+
+        # Give the planner's recovery thread a beat to write its dump
+        from faabric_tpu.runner import flightdump
+
+        deadline = time.time() + 15
+        dumps = []
+        while time.time() < deadline:
+            dumps = flightdump.load_dumps(flight_dir)
+            if len({d["process"] for d in dumps}) >= 2:
+                break
+            time.sleep(0.5)
+
+        processes = {d["process"] for d in dumps}
+        # Every surviving stateful host dumped: the survivor worker (on
+        # the abort) and the planner (on the recovery pass)
+        assert any(survivor in p for p in processes), (processes, dumps)
+        assert any(p == "planner" for p in processes), processes
+
+        merged = flightdump.merge(flight_dir)
+        assert merged, "merged flight ring is empty"
+        kinds = {e["kind"] for e in merged}
+        assert "fault_fired" in kinds, kinds
+        assert "group_abort" in kinds, kinds
+        # The injected firings are attributable (point + action survive)
+        fault = next(e for e in merged if e["kind"] == "fault_fired")
+        assert fault["point"] == "mpi.collective"
+        assert fault["action"] == "delay"
+        abort = next(e for e in merged if e["kind"] == "group_abort")
+        assert "reason" in abort and abort["group"]
+        # And the CLI renders the merged timeline
+        text = flightdump.render(merged, last=20)
+        assert "group_abort" in text
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
 def test_chaos_suppressed_keepalives_expire_then_rejoin():
     """FAABRIC_FAULTS=keepalive=suppress@times=N on a worker: the
     planner expires the (alive) worker; when its keep-alives resume, the

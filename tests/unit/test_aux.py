@@ -357,3 +357,32 @@ def test_memory_buffers():
             other.close()
     finally:
         sb.close(unlink=True)
+
+
+def test_worker_endpoint_healthz_and_bind_collision():
+    """GET /healthz answers locally; a second endpoint on the SAME port
+    (two aliased workers sharing WORKER_HTTP_PORT) must degrade to a
+    warning, never crash worker startup."""
+    import json as _json
+    import urllib.request
+
+    from faabric_tpu.endpoint import WorkerHttpEndpoint
+    from faabric_tpu.util.network import get_free_port
+
+    port = get_free_port()
+    ep = WorkerHttpEndpoint(port)
+    ep.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+            assert resp.status == 200
+            body = _json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["uptimeSeconds"] >= 0
+
+        rival = WorkerHttpEndpoint(port)
+        rival.start()  # EADDRINUSE → disabled, not raised
+        assert rival._server is None
+        rival.stop()  # no-op, no error
+    finally:
+        ep.stop()

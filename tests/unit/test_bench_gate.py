@@ -1,0 +1,86 @@
+"""tools/bench_gate.py: the machine-checked perf trajectory.
+
+Synthetic-round unit coverage plus the real gate over the repo's own
+``BENCH_r*.json`` history. Slow-marked: tier-1 stays unaffected, the
+nightly/full run enforces the trajectory.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                "tools"))
+
+import bench_gate  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def _write_round(tmp_path, name, value, summary):
+    path = tmp_path / name
+    path.write_text(json.dumps({"parsed": {
+        "metric": "ptp_dispatch_p50_ms", "value": value, "unit": "ms",
+        "summary": summary,
+    }}))
+    return str(path)
+
+
+def test_gate_passes_on_improvement(tmp_path):
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_gibs": 1.0, "step_ms": 30.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.04,
+                 {"host_allreduce_gibs": 1.3, "step_ms": 28.0})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 0
+
+
+def test_gate_fails_on_throughput_regression(tmp_path, capsys):
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_gibs": 2.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_allreduce_gibs": 1.0})  # -50%
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "host_allreduce_gibs" in out
+
+
+def test_gate_fails_on_latency_regression(tmp_path):
+    _write_round(tmp_path, "BENCH_r01.json", 0.04, {"step_ms": 30.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.06, {"step_ms": 30.0})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+
+
+def test_gate_tolerates_new_and_missing_keys(tmp_path):
+    """Rounds grow new sections; a key in only one round must never
+    fail the gate."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_gibs": 1.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"tokens_per_s": 8000.0})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 0
+
+
+def test_gate_within_threshold_passes(tmp_path):
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_gibs": 1.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.055,     # +10% latency
+                 {"host_allreduce_gibs": 0.85})          # -15%
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 0
+
+
+def test_gate_single_round_is_noop(tmp_path):
+    _write_round(tmp_path, "BENCH_r01.json", 0.05, {})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 0
+
+
+def test_gate_on_repo_history():
+    """The real trajectory check: newest round vs its predecessor must
+    hold the >20% line on every comparable throughput/latency figure."""
+    repo = os.path.join(os.path.dirname(__file__), "..", "..")
+    rounds = bench_gate.find_rounds(repo)
+    if len(rounds) < 2:
+        pytest.skip("fewer than 2 bench rounds in repo")
+    assert bench_gate.main(["--repo", repo]) == 0, (
+        "bench trajectory regressed >20% round-over-round")

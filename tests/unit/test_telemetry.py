@@ -278,3 +278,302 @@ def test_clock_prof_delegates_into_tracer(tracing):
         pass
     assert tracing.summary_data()["prof/legacy.label"]["count"] == 1
     assert "prof/legacy.label" in prof_summary()
+
+
+# ---------------------------------------------------------------------------
+# Trace context propagation (PR 3 tentpole)
+# ---------------------------------------------------------------------------
+
+def test_trace_context_encode_decode_roundtrip():
+    from faabric_tpu.telemetry import (
+        decode_trace_context,
+        encode_trace_context,
+    )
+
+    for trace_id, span_id in ((1, 2), (0xDEADBEEF, 0xCAFE),
+                              ((1 << 52) + 7, (1 << 53) - 1)):
+        wire = encode_trace_context(trace_id, span_id)
+        assert decode_trace_context(wire) == (trace_id, span_id)
+
+    # Malformed input degrades to None, never raises (server handler path)
+    for bad in (None, "", "nodot", "x.y", "1.", ".2", "0.5", "-1.2",
+                123, {"a": 1}, "1.2.3extra."):
+        assert decode_trace_context(bad) is None
+
+
+def test_current_trace_context_and_remote_parent(tracing):
+    from faabric_tpu.telemetry import (
+        current_trace_context,
+        current_trace_context as ctc,
+        span_from_remote,
+        trace_events,
+    )
+
+    assert current_trace_context() is None  # no open span
+
+    captured = {}
+    with span("planner", "call_batch"):
+        captured["tc"] = ctc()
+        assert captured["tc"] is not None
+
+    # "Another host" continues the trace from the wire context
+    with span_from_remote("transport", "sync_handle", captured["tc"],
+                          code=10):
+        with span("planner", "inner"):
+            pass
+
+    events = {e["name"]: e for e in trace_events() if e.get("ph") == "X"}
+    root = events["call_batch"]["args"]
+    handler = events["sync_handle"]["args"]
+    inner = events["inner"]["args"]
+    # Root mints the trace id; the remote handler joins the SAME trace
+    # with the root's span id as its parent
+    assert root["trace_id"] == root["span_id"]
+    assert handler["trace_id"] == root["trace_id"]
+    assert handler["parent_span_id"] == root["span_id"]
+    assert handler["remote_parent"] is True
+    # Locally-nested spans chain below the handler
+    assert inner["trace_id"] == root["trace_id"]
+    assert inner["parent_span_id"] == handler["span_id"]
+
+
+def test_remote_context_garbage_degrades_to_root_span(tracing):
+    from faabric_tpu.telemetry import span_from_remote, trace_events
+
+    with span_from_remote("transport", "handle", "not-a-context"):
+        pass
+    args = [e for e in trace_events() if e.get("ph") == "X"][0]["args"]
+    assert args["trace_id"] == args["span_id"]  # fresh root
+    assert "remote_parent" not in args
+
+
+def test_flow_events_and_deterministic_ids(tracing):
+    from faabric_tpu.telemetry import flow_id_for, trace_events
+
+    fid = flow_id_for(group_id=7, send_idx=0, recv_idx=2, channel=0,
+                      seq=13)
+    # Deterministic (cross-process derivable) and JSON-safe
+    assert fid == flow_id_for(7, 0, 2, 0, 13)
+    assert fid != flow_id_for(7, 0, 2, 0, 14)
+    assert 0 <= fid < (1 << 53)
+
+    tracing.flow_start(fid)
+    tracing.flow_end(fid)
+    tracing.instant("faults", "transport.send", action="drop")
+    events = trace_events()
+    assert any(e["ph"] == "s" and e["id"] == fid for e in events)
+    assert any(e["ph"] == "f" and e.get("bp") == "e" and e["id"] == fid
+               for e in events)
+    marks = [e for e in events if e["ph"] == "i"]
+    assert marks and marks[0]["name"] == "transport.send"
+    assert marks[0]["args"]["action"] == "drop"
+
+
+def test_fault_firing_is_visible_in_metrics_and_trace(tracing):
+    from faabric_tpu.faults import clear_faults, install_faults
+    from faabric_tpu.faults.registry import FaultInjected, get_fault_registry
+    from faabric_tpu.telemetry import get_metrics, trace_events
+
+    install_faults("ut.telemetry.point=raise:boom")
+    try:
+        with pytest.raises(FaultInjected):
+            get_fault_registry().point("ut.telemetry.point").fire(host="w9")
+        rows = get_metrics().snapshot().get("faabric_faults_fired_total",
+                                            {}).get("series", [])
+        mine = [r for r in rows
+                if r["labels"].get("point") == "ut.telemetry.point"]
+        assert mine and mine[0]["value"] >= 1
+        marks = [e for e in trace_events() if e.get("ph") == "i"
+                 and e["name"] == "ut.telemetry.point"]
+        assert marks and marks[0]["args"]["action"] == "raise"
+    finally:
+        clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_overwrites_oldest():
+    from faabric_tpu.telemetry import FlightRecorder
+
+    fr = FlightRecorder(size=8)
+    for i in range(20):
+        fr.record("tick", i=i)
+    events = fr.events()
+    assert len(events) == 8
+    assert [e["i"] for e in events] == list(range(12, 20))
+    assert all(e["kind"] == "tick" for e in events)
+    # Timestamps are monotone non-decreasing across the ring seam
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_flight_ring_capacity_is_preallocated_and_bounded():
+    from faabric_tpu.telemetry import FlightRecorder
+
+    fr = FlightRecorder(size=16)
+    assert len(fr._slots) == 16
+    for i in range(1000):
+        fr.record("e", n=i)
+    assert len(fr._slots) == 16
+    assert len(fr.events()) == 16
+
+
+def test_flight_dump_and_flightdump_merge(tmp_path, monkeypatch):
+    from faabric_tpu.runner import flightdump
+    from faabric_tpu.telemetry import FlightRecorder
+
+    monkeypatch.setenv("FAABRIC_FLIGHT_DIR", str(tmp_path))
+    a, b = FlightRecorder(size=32), FlightRecorder(size=32)
+    # merge() dedupes on (process, pid, ring seq) — in production one
+    # process owns ONE ring, so two recorders in this test process must
+    # not alias each other's sequence numbers
+    import itertools
+
+    b._n = itertools.count(100)
+    a.record("send", src=0, dst=2, plane="shm", bytes=4096)
+    a.record("group_abort", group=9, reason="peer dead")
+    b.record("fault_fired", point="transport.send", action="drop")
+    assert a.dump("mpi_world_aborted")
+    assert b.dump("planner_requeue")
+
+    merged = flightdump.merge(str(tmp_path))
+    assert len(merged) == 3
+    kinds = [e["kind"] for e in merged]
+    assert set(kinds) == {"send", "group_abort", "fault_fired"}
+    # Provenance rides each merged event
+    assert all("dump_reason" in e and "pid" in e for e in merged)
+    text = flightdump.render(merged)
+    assert "group_abort" in text and "fault_fired" in text
+
+    # Throttle: an immediate second dump for the same reason is skipped
+    assert a.dump("mpi_world_aborted") is None
+
+    # A second trigger re-dumps the (overlapping) ring; merge dedupes on
+    # ring seq so each real event still appears exactly once
+    a.record("sigterm")
+    assert a.dump("sigterm")
+    merged = flightdump.merge(str(tmp_path))
+    assert len(merged) == 4
+    assert [e["kind"] for e in merged].count("group_abort") == 1
+
+
+def test_flight_dump_without_dir_is_noop(monkeypatch):
+    from faabric_tpu.telemetry import FlightRecorder
+
+    monkeypatch.delenv("FAABRIC_FLIGHT_DIR", raising=False)
+    fr = FlightRecorder(size=8)
+    fr.record("x")
+    assert fr.dump("whatever") is None
+
+
+# ---------------------------------------------------------------------------
+# Communication matrix
+# ---------------------------------------------------------------------------
+
+def test_comm_matrix_records_per_link():
+    from faabric_tpu.telemetry import CommMatrix
+
+    cm = CommMatrix(max_ranks=16)
+    cm.record(0, 2, "shm", 1024, 0.001)
+    cm.record(0, 2, "shm", 2048, 0.002)
+    cm.record(1, 3, "bulk-tcp", 4096, 0.01)
+    cm.record(0, 1, "ptp", 64)  # latency optional
+
+    snap = cm.snapshot()
+    cells = {(c["src"], c["dst"], c["plane"]): c for c in snap["cells"]}
+    shm = cells[("0", "2", "shm")]
+    assert shm["messages"] == 2 and shm["bytes"] == 3072
+    assert shm["lat_count"] == 2
+    assert shm["lat_sum"] == pytest.approx(0.003)
+    assert cells[("0", "1", "ptp")]["lat_count"] == 0
+
+    fams = cm.families()
+    assert set(fams) == {"faabric_comm_messages_total",
+                         "faabric_comm_bytes_total",
+                         "faabric_comm_send_seconds"}
+    from faabric_tpu.telemetry import render_snapshots
+
+    text = render_snapshots({"w1": fams})
+    assert ('faabric_comm_bytes_total{dst="2",host="w1",plane="shm",'
+            'src="0"} 3072') in text
+
+
+def test_comm_matrix_cardinality_guard():
+    """A 256-rank world must not bloat /metrics: ranks beyond the cap
+    collapse into one 'other' bucket per direction."""
+    from faabric_tpu.telemetry import CommMatrix
+
+    cm = CommMatrix(max_ranks=4)
+    for src in range(256):
+        for dst in (0, 255):
+            cm.record(src, dst, "ptp", 10)
+    cells = cm.snapshot()["cells"]
+    # src ∈ {0..3, other} × dst ∈ {0, other} = at most 10 series
+    assert len(cells) <= (4 + 1) * 2
+    labels = {(c["src"], c["dst"]) for c in cells}
+    assert ("other", "other") in labels
+    assert ("0", "0") in labels
+    assert all(c["src"] in {"0", "1", "2", "3", "other"} for c in cells)
+    # Nothing lost: total messages survive the collapse
+    assert sum(c["messages"] for c in cells) == 256 * 2
+    # Garbage ranks collapse too instead of raising
+    cm.record("not-a-rank", -3, "ptp", 1)
+    assert any(c["src"] == "other" and c["dst"] == "other"
+               for c in cm.snapshot()["cells"])
+
+
+def test_comm_matrix_merge_cell_rows():
+    from faabric_tpu.telemetry import merge_cell_rows
+
+    merged = merge_cell_rows({
+        "w1": [{"src": "0", "dst": "2", "plane": "shm", "messages": 2,
+                "bytes": 100, "lat_sum": 0.1, "lat_count": 2}],
+        "w2": [{"src": "0", "dst": "2", "plane": "shm", "messages": 1,
+                "bytes": 50, "lat_sum": 0.05, "lat_count": 1},
+               {"src": "3", "dst": "1", "plane": "ptp", "messages": 1,
+                "bytes": 999, "lat_sum": 0.0, "lat_count": 0}],
+    })
+    by_key = {(r["src"], r["dst"], r["plane"]): r for r in merged}
+    assert by_key[("0", "2", "shm")]["bytes"] == 150
+    assert by_key[("0", "2", "shm")]["messages"] == 3
+    assert by_key[("3", "1", "ptp")]["bytes"] == 999
+    # Sorted by bytes, fattest link first
+    assert merged[0]["bytes"] == 999
+
+
+def test_malformed_ring_and_cardinality_knobs_degrade(monkeypatch):
+    """Telemetry knobs are parsed on hot-path-adjacent lazy inits: a
+    malformed value must degrade to the default, never raise out of a
+    send or recovery path."""
+    import faabric_tpu.telemetry.flight as flight_mod
+    from faabric_tpu.telemetry import CommMatrix
+
+    monkeypatch.setattr(flight_mod, "_flight", None)
+    monkeypatch.setenv("FAABRIC_FLIGHT_RING", "8k")
+    fr = flight_mod.get_flight()
+    assert fr.size == 4096
+    fr.record("x")  # and it records
+    monkeypatch.setattr(flight_mod, "_flight", None)
+
+    monkeypatch.setenv("FAABRIC_COMMMATRIX_MAX_RANKS", "lots")
+    cm = CommMatrix()
+    assert cm.max_ranks == 64
+    cm.record(0, 1, "ptp", 10)
+
+
+def test_flight_dump_pruning_bounds_directory(tmp_path, monkeypatch):
+    """A recurring dump trigger must not fill the disk: only the newest
+    FAABRIC_FLIGHT_MAX_DUMPS files of this process survive."""
+    from faabric_tpu.telemetry import FlightRecorder
+
+    monkeypatch.setenv("FAABRIC_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("FAABRIC_FLIGHT_MAX_DUMPS", "3")
+    fr = FlightRecorder(size=8)
+    fr.record("tick")
+    for i in range(6):
+        fr._last_dump.clear()  # bypass the 1s per-reason throttle
+        assert fr.dump(f"reason{i}")
+    files = [n for n in tmp_path.iterdir() if n.name.endswith(".json")]
+    assert len(files) == 3

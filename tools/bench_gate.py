@@ -1,0 +1,129 @@
+"""Round-over-round bench regression gate.
+
+    python tools/bench_gate.py [--repo DIR] [--threshold 0.2]
+
+Compares the newest ``BENCH_r*.json`` against the previous round and
+exits non-zero when any recorded throughput/latency figure regressed by
+more than the threshold (default 20%). Directionality is inferred from
+the metric name: ``*_gibs`` / ``tokens_per_s`` / ``mfu`` are
+higher-is-better; ``*_ms`` / ``*_s`` / ``*_ns`` (and the headline
+latency ``value``) are lower-is-better. A key present in only one round
+is reported as informational, never a failure — rounds grow new
+sections and that must not wedge the gate.
+
+Run as a ``slow``-marked test (tests/unit/test_bench_gate.py) so the
+perf trajectory is machine-checked without taxing tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+HIGHER_BETTER = re.compile(r"(_gibs|tokens_per_s|mfu|_speedup)")
+LOWER_BETTER = re.compile(r"(_ms|_ns|_s)$")
+
+
+def find_rounds(repo: str) -> list[str]:
+    """BENCH_r*.json paths, oldest → newest (lexicographic on the
+    zero-padded round number)."""
+    return sorted(glob.glob(os.path.join(repo, "BENCH_r[0-9]*.json")))
+
+
+def load_metrics(path: str) -> dict[str, float]:
+    """Flatten one round's comparable numbers: the headline ``value``
+    (latency) plus every numeric ``summary`` entry with an inferable
+    direction."""
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") or {}
+    out: dict[str, float] = {}
+    if isinstance(parsed.get("value"), (int, float)):
+        out["value"] = float(parsed["value"])
+    for key, val in (parsed.get("summary") or {}).items():
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        if HIGHER_BETTER.search(key) or LOWER_BETTER.search(key):
+            out[key] = float(val)
+    return out
+
+
+def direction(key: str) -> int:
+    """+1 = higher is better, -1 = lower is better."""
+    if key == "value" or (LOWER_BETTER.search(key)
+                          and not HIGHER_BETTER.search(key)):
+        return -1
+    return 1
+
+
+def compare(prev: dict[str, float], cur: dict[str, float],
+            threshold: float = 0.2) -> tuple[list[str], list[str]]:
+    """(regressions, notes). A regression is a >threshold move in the
+    bad direction on a key both rounds recorded (zero/absent previous
+    values are notes — no ratio exists)."""
+    regressions, notes = [], []
+    for key in sorted(set(prev) | set(cur)):
+        p, c = prev.get(key), cur.get(key)
+        if p is None or c is None:
+            notes.append(f"{key}: only in "
+                         f"{'current' if p is None else 'previous'} round "
+                         f"({p if c is None else c})")
+            continue
+        if p <= 0:
+            notes.append(f"{key}: previous value {p} not comparable")
+            continue
+        if direction(key) > 0:
+            change = (c - p) / p          # negative = worse
+            if change < -threshold:
+                regressions.append(
+                    f"{key}: {p} -> {c} ({change:+.1%}, "
+                    f"higher-is-better)")
+        else:
+            change = (c - p) / p          # positive = worse
+            if change > threshold:
+                regressions.append(
+                    f"{key}: {p} -> {c} ({change:+.1%}, "
+                    f"lower-is-better)")
+        if key not in [r.split(":")[0] for r in regressions]:
+            notes.append(f"{key}: {p} -> {c} ({change:+.1%})")
+    return regressions, notes
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_gate")
+    parser.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parser.add_argument("--threshold", type=float, default=0.2)
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    rounds = find_rounds(args.repo)
+    if len(rounds) < 2:
+        print(f"bench_gate: need >=2 rounds, found {len(rounds)} "
+              f"in {args.repo}; nothing to gate")
+        return 0
+    prev_path, cur_path = rounds[-2], rounds[-1]
+    prev, cur = load_metrics(prev_path), load_metrics(cur_path)
+    regressions, notes = compare(prev, cur, args.threshold)
+
+    print(f"bench_gate: {os.path.basename(prev_path)} -> "
+          f"{os.path.basename(cur_path)} "
+          f"(threshold {args.threshold:.0%})")
+    if not args.quiet:
+        for line in notes:
+            print(f"  note: {line}")
+    for line in regressions:
+        print(f"  REGRESSION: {line}")
+    if regressions:
+        print(f"bench_gate: FAILED ({len(regressions)} regression(s))")
+        return 1
+    print("bench_gate: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
