@@ -475,6 +475,247 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
         clear_host_aliases()
 
 
+def _bench_journal_micro(quick: bool = False) -> dict:
+    """ISSUE 4 micro-costs: raw journal append latency, the cost of the
+    disabled-path gate, and the end-to-end overhead the journal adds to
+    the planner's hot set_message_result path (acceptance: < 5%)."""
+    import shutil
+    import tempfile
+    import timeit
+
+    from faabric_tpu.planner.journal import NULL_JOURNAL, PlannerJournal
+    from faabric_tpu.proto import message_factory
+    from faabric_tpu.util.config import get_system_config
+
+    n = 5_000 if quick else 20_000
+    # Disabled path: one enabled-check (what every call site pays when
+    # FAABRIC_PLANNER_JOURNAL_DIR is unset — no allocation, no call)
+    noop_gate_ns = timeit.timeit(
+        lambda: None if NULL_JOURNAL.enabled else None,
+        number=n * 10) / (n * 10) * 1e9
+
+    # Raw append, two views of a representative result record:
+    # enqueue latency (what set_message_result pays inline — the
+    # write-behind push) and sustained cost (encode + os.write once the
+    # drain keeps up at max rate)
+    d = tempfile.mkdtemp(prefix="bench_journal_")
+    j = PlannerJournal(d, fsync_interval=0.05, compact_records=10**9)
+    msg = message_factory("bench", "fn")
+    msg.output_data = b"x" * 64
+    fields = {"msg": msg.to_dict()}
+    j.DRAIN_BACKPRESSURE = 10**9  # pure enqueue: no early drains
+    enqueue_ns = timeit.timeit(
+        lambda: j.append("result", fields), number=n) / n * 1e9
+    j.flush()
+    j.DRAIN_BACKPRESSURE = PlannerJournal.DRAIN_BACKPRESSURE
+    append_ns = timeit.timeit(
+        lambda: j.append("result", fields), number=n) / n * 1e9
+    j.close()
+    shutil.rmtree(d, ignore_errors=True)
+
+    # End-to-end set_message_result over real loopback RPC, journal off
+    # vs on: a PlannerServer + PlannerClient per run (the acceptance
+    # denominator is the real hot path — wire encode, sockets, handler
+    # decode, planner apply — not a mock-mode in-process call)
+    def _results_seconds(journal_dir: str | None, base: int) -> float:
+        import faabric_tpu.planner.planner as planner_mod
+        from faabric_tpu.planner import PlannerClient, PlannerServer
+        from faabric_tpu.proto import message_factory
+        from faabric_tpu.transport.common import register_host_alias
+
+        saved = os.environ.get("FAABRIC_PLANNER_JOURNAL_DIR")
+        if journal_dir is None:
+            os.environ.pop("FAABRIC_PLANNER_JOURNAL_DIR", None)
+        else:
+            os.environ["FAABRIC_PLANNER_JOURNAL_DIR"] = journal_dir
+        get_system_config().reset()
+        planner_mod._planner = None  # rebuild with this journal config
+        register_host_alias("bjpl", "127.0.0.1", base)
+        server = PlannerServer(port_offset=base)
+        client = PlannerClient("bjcli", planner_host="bjpl")
+        try:
+            server.start()
+            m = 500 if quick else 2_000
+            msgs = []
+            for i in range(m):
+                x = message_factory("bench", "fn")
+                x.output_data = b"x" * 64
+                msgs.append(x)
+            planner = planner_mod.get_planner()
+            t0 = time.perf_counter()
+            for x in msgs:
+                client.set_message_result(x)
+            # The async plane is FIFO per connection: the last result
+            # being applied means the server processed them all
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if planner.get_message_result(
+                        msgs[-1].app_id, msgs[-1].id) is not None:
+                    break
+                time.sleep(0.001)
+            return time.perf_counter() - t0
+        finally:
+            client.close()
+            server.stop()  # closes the planner journal too
+            planner_mod._planner = None
+            if saved is None:
+                os.environ.pop("FAABRIC_PLANNER_JOURNAL_DIR", None)
+            else:
+                os.environ["FAABRIC_PLANNER_JOURNAL_DIR"] = saved
+            get_system_config().reset()
+
+    # Interleaved repeats, min per leg: a single loopback run varies
+    # ±20% with machine state, an order of magnitude more than the
+    # ~1 µs enqueue actually under test — min-of-N is the standard
+    # noise-robust latency estimator
+    b = random.randint(10, 120) * 100
+    offs, ons = [], []
+    for i in range(2 if quick else 3):
+        offs.append(_results_seconds(None, b + 5000 * i))
+        jd = tempfile.mkdtemp(prefix="bench_journal_planner_")
+        ons.append(_results_seconds(jd, b + 5000 * i + 2500))
+        shutil.rmtree(jd, ignore_errors=True)
+    off_s, on_s = min(offs), min(ons)
+    m = 500 if quick else 2_000
+    # Two views: throughput overhead at saturation (includes the drain
+    # thread's amortized encode+fsync competing for the GIL) and the
+    # latency the append itself adds to one result's hot path (the
+    # write-behind enqueue over the measured end-to-end per-op time —
+    # the < 5% acceptance number)
+    throughput_pct = (on_s - off_s) / off_s * 100.0 if off_s > 0 else 0.0
+    per_op_ns = off_s / m * 1e9
+    latency_pct = enqueue_ns / per_op_ns * 100.0 if per_op_ns > 0 else 0.0
+    return {
+        "append_ns": round(append_ns, 1),
+        "append_enqueue_ns": round(enqueue_ns, 1),
+        "noop_gate_ns": round(noop_gate_ns, 2),
+        "set_result_off_s": round(off_s, 4),
+        "set_result_on_s": round(on_s, 4),
+        "result_throughput_overhead_pct": round(throughput_pct, 2),
+        "result_latency_overhead_pct": round(latency_pct, 2),
+    }
+
+
+def _bench_planner_restart(quick: bool = False) -> dict:
+    """ISSUE 4 macro-cost: SIGKILL the planner mid-batch, restart it on
+    the same journal dir, and measure kill → batch-complete — the
+    control-plane outage blip the journal bounds (replay + worker
+    rejoin + buffered-result flush)."""
+    import signal
+    import subprocess
+    import tempfile
+
+    from faabric_tpu.transport.common import clear_host_aliases
+    from faabric_tpu.util.config import get_system_config
+
+    procs_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tests", "dist", "procs.py")
+    b = random.randint(10, 120) * 100
+    aliases = (f"pjpl=127.0.0.1+{b},pjw0=127.0.0.1+{b + 2500},"
+               f"pjcli=127.0.0.1+{b + 5000}")
+    journal_dir = tempfile.mkdtemp(prefix="bench_pjournal_")
+    knobs = {"PLANNER_HOST_TIMEOUT": "3",
+             "FAABRIC_PLANNER_JOURNAL_DIR": journal_dir,
+             "FAABRIC_PLANNER_RECONCILE_GRACE": "5"}
+    env = {**os.environ, "FAABRIC_HOST_ALIASES": aliases,
+           "JAX_PLATFORMS": "cpu", **knobs}
+    saved = {k: os.environ.get(k)
+             for k in ["FAABRIC_HOST_ALIASES", "PLANNER_HOST_TIMEOUT"]}
+    os.environ.update({"FAABRIC_HOST_ALIASES": aliases,
+                       "PLANNER_HOST_TIMEOUT": "3"})
+    clear_host_aliases()
+    get_system_config().reset()
+
+    children = []
+
+    def spawn(*args):
+        p = subprocess.Popen([sys.executable, procs_py, *args],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, text=True, env=env)
+        children.append(p)
+        while True:
+            line = p.stdout.readline()
+            assert line, f"bench child {args} died before READY"
+            if line.strip() == "READY":
+                return p
+
+    me = None
+    try:
+        planner = spawn("planner", str(b))
+        spawn("worker", "pjw0", "pjpl", "8")
+
+        from faabric_tpu.executor import ExecutorFactory
+        from faabric_tpu.proto import ReturnValue, batch_exec_factory
+        from faabric_tpu.runner import WorkerRuntime
+
+        class NullFactory(ExecutorFactory):
+            def create_executor(self, msg):
+                raise RuntimeError("client runs nothing")
+
+        me = WorkerRuntime(host="pjcli", slots=0, factory=NullFactory(),
+                           planner_host="pjpl")
+        me.start()
+
+        task_s = 1.0 if quick else 2.5
+        req = batch_exec_factory("dist", "sleep", 8)
+        for i, m in enumerate(req.messages):
+            m.input_data = (b"0.3" if i < 4 else str(task_s).encode())
+        me.planner_client.call_functions(req)
+
+        # Pre-crash results must be on disk before the kill
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            status = me.planner_client.get_batch_results(req.app_id)
+            if len(status.message_results) >= 2:
+                break
+            time.sleep(0.1)
+
+        planner.send_signal(signal.SIGKILL)
+        planner.wait(timeout=5)
+        t_kill = time.perf_counter()
+        spawn("planner", str(b))  # restart on the same journal dir
+
+        deadline = time.time() + 90
+        status = None
+        while time.time() < deadline:
+            try:
+                status = me.planner_client.get_batch_results(req.app_id)
+                if status.finished:
+                    break
+            except Exception:  # noqa: BLE001 — planner down mid-poll
+                pass
+            time.sleep(0.1)
+        recover_s = time.perf_counter() - t_kill
+        ok = (status is not None and status.finished
+              and all(m.return_value == int(ReturnValue.SUCCESS)
+                      for m in status.message_results))
+        return {
+            "planner_kill_to_recover_s": round(recover_s, 3),
+            "n_messages": 8, "task_s": task_s,
+            "all_success": ok,
+        }
+    finally:
+        if me is not None:
+            me.shutdown()
+        for p in children:
+            p.terminate()
+        for p in children:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_host_aliases()
+        get_system_config().reset()
+        import shutil
+
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+
 def bench_robustness(quick: bool = False) -> dict:
     """ISSUE 2 robustness section: recovery latency under worker loss.
 
@@ -582,7 +823,7 @@ def bench_robustness(quick: bool = False) -> dict:
             "events": len(merged),
             "kinds": sorted({e.get("kind", "?") for e in merged}),
         }
-        return {
+        out = {
             "kill_to_complete_s": round(kill_to_complete, 3),
             "recovered_messages": n_on_victim,
             "n_messages": 12, "task_s": task_s,
@@ -611,6 +852,19 @@ def bench_robustness(quick: bool = False) -> dict:
         import shutil
 
         shutil.rmtree(flight_dir, ignore_errors=True)
+
+    # ISSUE 4: journal micro-costs + the planner-crash recovery blip
+    # (each phase manages its own processes/env; a failure records the
+    # error rather than voiding the section)
+    try:
+        out["journal"] = _bench_journal_micro(quick)
+    except Exception as e:  # noqa: BLE001
+        out["journal_error"] = str(e)[:200]
+    try:
+        out.update(_bench_planner_restart(quick))
+    except Exception as e:  # noqa: BLE001
+        out["planner_restart_error"] = str(e)[:200]
+    return out
 
 
 def _sendrecv_sizes() -> list[int]:
@@ -1702,6 +1956,12 @@ def main() -> None:
     dc = extras.get("delta_codec") or {}
     if dc.get("apply_reuse_ms") is not None:
         summary["delta_apply_reuse_ms"] = round(dc["apply_reuse_ms"], 1)
+    rb = extras.get("robustness") or {}
+    if rb.get("planner_kill_to_recover_s") is not None:
+        summary["planner_kill_to_recover_s"] = rb[
+            "planner_kill_to_recover_s"]
+    if (rb.get("journal") or {}).get("append_ns") is not None:
+        summary["journal_append_ns"] = rb["journal"]["append_ns"]
     result = {
         "metric": "ptp_dispatch_p50_ms",
         "value": round(p50, 4) if p50 else None,

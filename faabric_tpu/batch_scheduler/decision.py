@@ -100,7 +100,19 @@ class SchedulingDecision:
 
     # ------------------------------------------------------------------
     def to_dict(self) -> dict[str, Any]:
-        return dataclasses.asdict(self)
+        # Hand-rolled (parallel-vector copies): dataclasses.asdict
+        # deep-copies recursively and this rides every CALL_BATCH
+        # response and planner journal app_update
+        return {
+            "app_id": self.app_id,
+            "group_id": self.group_id,
+            "hosts": list(self.hosts),
+            "message_ids": list(self.message_ids),
+            "app_idxs": list(self.app_idxs),
+            "group_idxs": list(self.group_idxs),
+            "mpi_ports": list(self.mpi_ports),
+            "device_ids": list(self.device_ids),
+        }
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "SchedulingDecision":

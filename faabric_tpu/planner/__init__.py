@@ -8,15 +8,25 @@ from faabric_tpu.planner.client import (
     get_mock_batch_calls,
     get_mock_set_results,
 )
+from faabric_tpu.planner.journal import (
+    NULL_JOURNAL,
+    JournalCorrupt,
+    PlannerJournal,
+    open_planner_journal,
+)
 
 __all__ = [
+    "JournalCorrupt",
+    "NULL_JOURNAL",
     "Planner",
     "PlannerCalls",
     "PlannerClient",
     "PlannerHost",
+    "PlannerJournal",
     "PlannerServer",
     "clear_mock_planner_calls",
     "get_mock_batch_calls",
     "get_mock_set_results",
     "get_planner",
+    "open_planner_journal",
 ]

@@ -13,6 +13,7 @@ Mock mode records batch calls / results instead of sending.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
@@ -28,7 +29,8 @@ from faabric_tpu.proto import (
     messages_from_wire,
     messages_to_wire,
 )
-from faabric_tpu.transport.client import MessageEndpointClient
+from faabric_tpu.telemetry import flight_record, get_metrics
+from faabric_tpu.transport.client import MessageEndpointClient, RpcError
 from faabric_tpu.transport.common import PLANNER_ASYNC_PORT, PLANNER_SYNC_PORT
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.logging import get_logger
@@ -39,6 +41,17 @@ logger = get_logger(__name__)
 
 _FAULTS = faults_enabled()
 _FP_KEEPALIVE = fault_point("keepalive")
+
+_metrics = get_metrics()
+_BUFFERED_RESULTS = _metrics.counter(
+    "faabric_planner_client_buffered_results_total",
+    "Results queued locally because the planner was unreachable")
+_FLUSHED_RESULTS = _metrics.counter(
+    "faabric_planner_client_flushed_results_total",
+    "Buffered results delivered to the planner after reconnect")
+_DROPPED_RESULTS = _metrics.counter(
+    "faabric_planner_client_dropped_results_total",
+    "Buffered results dropped because the outage queue overflowed")
 
 # ---------------------------------------------------------------------------
 # Mock recording
@@ -78,7 +91,42 @@ class KeepAliveThread(PeriodicBackgroundThread):
             # host — the chaos recipe for exercising expiry recovery and
             # the rejoin path without killing a process
             return
-        self.client.register_host(self.slots, self.n_devices, rejoin=True)
+        try:
+            self.client.register_host(self.slots, self.n_devices,
+                                      rejoin=True)
+        except RpcError as e:
+            # Planner down/restarting (ISSUE 4 satellite): never raise
+            # out of the keep-alive thread and never spin — the periodic
+            # interval paces the retries, the client's circuit breaker
+            # makes each failed tick instant while open, and the
+            # breaker's half-open probe adds the jitter. Log once per
+            # outage, not per tick.
+            if not self.client.planner_down:
+                logger.warning(
+                    "Planner unreachable from %s (%s); keep-alive will "
+                    "keep retrying and results will buffer locally",
+                    self.client.this_host, e)
+                flight_record("planner_unreachable",
+                              host=self.client.this_host)
+            self.client.planner_down = True
+            return
+        if self.client.planner_down:
+            self.client.planner_down = False
+            logger.warning("Planner reachable again from %s; draining "
+                           "buffered results", self.client.this_host)
+            flight_record("planner_reconnected",
+                          host=self.client.this_host)
+            # A blip means any recently async-pushed result is suspect:
+            # the FIRST write on a connection whose peer just died
+            # "succeeds" into the kernel buffer and is silently lost
+            # (only the next write errors). Re-deliver the window; the
+            # planner's first-write-wins dedups the ones that landed.
+            self.client.requeue_recent_results()
+        # Reconnect housekeeping (no-ops while nothing is pending):
+        # deliver results queued during the outage, then re-register
+        # result interest a restarted planner lost with its waiter map
+        self.client.flush_pending_results()
+        self.client.resync_result_interest()
 
 
 class PlannerClient(MessageEndpointClient):
@@ -105,8 +153,45 @@ class PlannerClient(MessageEndpointClient):
         self._local_results: dict[int, Message] = {}
         self._local_results_order: list[int] = []
         self._result_events: dict[int, threading.Event] = {}
+        # msg_id → app_id for every outstanding wait: a restarted
+        # planner lost its waiter map, so after rejoin the keep-alive
+        # re-registers this host's interest (resync_result_interest)
+        self._result_interest: dict[int, int] = {}
+
+        # Degraded mode (ISSUE 4): results the planner could not be
+        # told about (down/restarting) queue here and drain through the
+        # sync FLUSH_RESULTS call after reconnect — a planner outage
+        # must not raise into executors or lose completed work
+        self._pending_lock = threading.Lock()
+        self._pending_results: list[Message] = []
+        self._pending_bytes = 0
+        self._recent_bytes = 0
+        # Recently async-pushed results (bounded by count AND age): a
+        # result written into the kernel buffer of a connection whose
+        # planner just died is silently lost — the send "succeeds", the
+        # restarted planner never sees it, and nothing ever re-sends it
+        # (the host is alive, so reconcile won't requeue). On rejoin
+        # (known:false — the planner restarted or expired us) the
+        # recent window re-delivers through the confirmed sync flush;
+        # the planner's first-write-wins dedups the common case where
+        # the push did land.
+        self._recent_results: list[tuple[float, Message]] = []
+        self.planner_down = False
 
     MAX_CACHED_RESULTS = 10_000
+    # Both outage buffers are bounded by count AND payload bytes — a
+    # worker returning multi-MB outputs through a long outage must not
+    # OOM before the count cap bites
+    MAX_PENDING_RESULTS = 10_000
+    MAX_PENDING_BYTES = 256 << 20
+    MAX_RECENT_RESULTS = 512
+    MAX_RECENT_BYTES = 64 << 20
+    RECENT_RESULT_WINDOW = 60.0
+
+    @staticmethod
+    def _result_cost(msg: Message) -> int:
+        """Approximate retained bytes of a buffered result."""
+        return len(msg.output_data) + len(msg.input_data) + 512
 
     # ------------------------------------------------------------------
     def ping(self) -> bool:
@@ -136,6 +221,11 @@ class PlannerClient(MessageEndpointClient):
                 "host": self.this_host, "slots": slots,
                 "n_devices": n_devices, "overwrite": True,
             }, idempotent=True)
+            # The planner forgot us: it restarted (journal replay keeps
+            # results it RECEIVED, not ones that died in a socket
+            # buffer) or expired us. Re-deliver the recent result
+            # window via the confirmed flush; first-write-wins dedups.
+            self.requeue_recent_results()
         if start_keep_alive and self._keep_alive is None:
             self._keep_alive = KeepAliveThread(self, slots, n_devices)
             self._keep_alive.start(max(0.5, timeout / 2))
@@ -145,8 +235,19 @@ class PlannerClient(MessageEndpointClient):
         if self._keep_alive is not None:
             self._keep_alive.stop()
             self._keep_alive = None
-        self.sync_send(int(PlannerCalls.REMOVE_HOST), {"host": self.this_host},
-                       idempotent=True)
+        try:
+            # Last chance to deliver results completed during an outage
+            # before this host deregisters
+            self.flush_pending_results()
+            self.sync_send(int(PlannerCalls.REMOVE_HOST),
+                           {"host": self.this_host}, idempotent=True)
+        except RpcError as e:
+            # Best-effort by contract (ISSUE 4 satellite): a worker
+            # shutting down while the planner is down must not raise or
+            # retry-spin — the planner's keep-alive expiry reaps the
+            # registration anyway
+            logger.debug("Best-effort deregister of %s skipped: %s",
+                         self.this_host, e)
 
     def get_available_hosts(self) -> list[dict]:
         resp = self.sync_send(int(PlannerCalls.GET_AVAILABLE_HOSTS),
@@ -194,9 +295,149 @@ class PlannerClient(MessageEndpointClient):
             with _mock_lock:
                 _mock_results.append(msg)
             return
-        dicts, tail = messages_to_wire([msg])
-        self.async_send(int(PlannerCalls.SET_MESSAGE_RESULT),
-                        {"msg": dicts[0]}, tail)
+        # Earlier buffered results go first so the planner sees results
+        # in completion order (first-write-wins makes reordering safe,
+        # but ordered delivery keeps forensics sane)
+        if self._pending_results:
+            self.flush_pending_results()
+        try:
+            dicts, tail = messages_to_wire([msg])
+            retried = self.async_send(int(PlannerCalls.SET_MESSAGE_RESULT),
+                                      {"msg": dicts[0]}, tail)
+        except RpcError:
+            self._buffer_result(msg)
+        else:
+            with self._pending_lock:
+                self._remember_result_locked(msg)
+            if retried:
+                # The frame only went out after a reconnect: an EARLIER
+                # result pushed on the old connection may have died in
+                # the old peer's kernel buffer (that write "succeeded";
+                # only this one saw the error). Re-deliver the recent
+                # window through the confirmed flush — the planner's
+                # first-write-wins dedups everything that did land.
+                logger.warning(
+                    "Result push from %s needed a reconnect; "
+                    "re-delivering the recent result window",
+                    self.this_host)
+                self.requeue_recent_results()
+                self.flush_pending_results()
+
+    def _remember_result_locked(self, msg: Message) -> None:
+        now = time.monotonic()
+        recent = self._recent_results
+        recent.append((now, msg))
+        self._recent_bytes += self._result_cost(msg)
+        cutoff = now - self.RECENT_RESULT_WINDOW
+        while recent and (recent[0][0] < cutoff
+                          or len(recent) > self.MAX_RECENT_RESULTS
+                          or self._recent_bytes > self.MAX_RECENT_BYTES):
+            self._recent_bytes -= self._result_cost(recent.pop(0)[1])
+
+    def requeue_recent_results(self) -> None:
+        """Move the recent-results window onto the pending queue (next
+        flush re-delivers it). Called after a rejoin: the planner we
+        pushed those results to may have died with them in a kernel
+        buffer."""
+        with self._pending_lock:
+            if not self._recent_results:
+                return
+            have = {m.id for m in self._pending_results}
+            resend = [m for _, m in self._recent_results
+                      if m.id not in have]
+            self._pending_results[:0] = resend
+            self._pending_bytes += sum(self._result_cost(m)
+                                       for m in resend)
+            self._recent_results.clear()
+            self._recent_bytes = 0
+            n = len(resend)
+        if n:
+            logger.info(
+                "Re-delivering %d recently pushed result(s) from %s "
+                "after rejoin (planner restart may have dropped them)",
+                n, self.this_host)
+
+    def _buffer_result(self, msg: Message) -> None:
+        """Queue a result the planner could not be reached for; the
+        queue drains on reconnect (keep-alive) or the next successful
+        result push. Bounded drop-oldest: a long outage must not OOM a
+        busy worker."""
+        with self._pending_lock:
+            pending = self._pending_results
+            pending.append(msg)
+            self._pending_bytes += self._result_cost(msg)
+            dropped = 0
+            while pending and (len(pending) > self.MAX_PENDING_RESULTS
+                               or self._pending_bytes
+                               > self.MAX_PENDING_BYTES):
+                self._pending_bytes -= self._result_cost(pending.pop(0))
+                dropped += 1
+            if dropped:
+                _DROPPED_RESULTS.inc(dropped)
+                logger.warning(
+                    "Outage result queue overflowed on %s; dropped %d "
+                    "oldest result(s)", self.this_host, dropped)
+            n = len(pending)
+        _BUFFERED_RESULTS.inc()
+        if not self.planner_down:
+            self.planner_down = True
+            logger.warning(
+                "Planner unreachable from %s; buffering results "
+                "locally (%d queued)", self.this_host, n)
+            flight_record("planner_unreachable", host=self.this_host)
+
+    def flush_pending_results(self) -> None:
+        """Deliver queued results through the sync FLUSH_RESULTS call
+        (delivery-confirmed, unlike the async push) and clear the queue.
+        Failure re-queues everything untouched — called again on the
+        next keep-alive tick."""
+        with self._pending_lock:
+            if not self._pending_results:
+                return
+            batch = self._pending_results
+            self._pending_results = []
+            self._pending_bytes = 0
+        try:
+            dicts, tail = messages_to_wire(batch)
+            resp = self.sync_send(int(PlannerCalls.FLUSH_RESULTS),
+                                  {"msgs": dicts, "host": self.this_host},
+                                  tail, idempotent=True)
+            accepted = int(resp.header.get("accepted", len(batch)))
+            _FLUSHED_RESULTS.inc(accepted)
+            logger.info("Flushed %d buffered result(s) from %s to the "
+                        "planner", accepted, self.this_host)
+            flight_record("results_flushed", host=self.this_host,
+                          n=accepted)
+        except RpcError:
+            with self._pending_lock:
+                # Prepend: results queued while we were flushing stay
+                # behind the ones that were already waiting
+                self._pending_results[:0] = batch
+                self._pending_bytes += sum(self._result_cost(m)
+                                           for m in batch)
+
+    def resync_result_interest(self) -> None:
+        """Re-register this host's interest in every result still being
+        waited on. A restarted planner replays results but not its
+        waiter map — without this, a worker blocked in
+        get_message_result would hang to its timeout even though the
+        result lands normally."""
+        with self._results_lock:
+            pending = [(mid, app) for mid, app in
+                       self._result_interest.items()
+                       if mid in self._result_events]
+        for msg_id, app_id in pending:
+            try:
+                resp = self.sync_send(int(PlannerCalls.GET_MESSAGE_RESULT), {
+                    "app_id": app_id, "msg_id": msg_id,
+                    "host": self.this_host,
+                }, idempotent=True)
+            except RpcError:
+                return  # next keep-alive tick retries
+            if resp.header.get("found"):
+                result = messages_from_wire([resp.header["msg"]],
+                                            resp.payload)[0]
+                self.set_message_result_locally(result)
 
     def set_message_result_locally(self, msg: Message) -> None:
         """Resolve a local waiter (called by our FunctionCallServer when the
@@ -208,6 +449,7 @@ class PlannerClient(MessageEndpointClient):
             while len(self._local_results_order) > self.MAX_CACHED_RESULTS:
                 oldest = self._local_results_order.pop(0)
                 self._local_results.pop(oldest, None)
+            self._result_interest.pop(msg.id, None)
             ev = self._result_events.pop(msg.id, None)
             if ev is not None:
                 ev.set()
@@ -225,6 +467,7 @@ class PlannerClient(MessageEndpointClient):
             if cached is not None:
                 return cached
             ev = self._result_events.setdefault(msg_id, threading.Event())
+            self._result_interest[msg_id] = app_id
 
         resp = self.sync_send(int(PlannerCalls.GET_MESSAGE_RESULT), {
             "app_id": app_id, "msg_id": msg_id, "host": self.this_host,
@@ -237,6 +480,7 @@ class PlannerClient(MessageEndpointClient):
         if not ev.wait(timeout):
             with self._results_lock:
                 self._result_events.pop(msg_id, None)
+                self._result_interest.pop(msg_id, None)
             raise TimeoutError(
                 f"Timed out waiting for result of msg {msg_id} (app {app_id})")
         with self._results_lock:
@@ -308,6 +552,12 @@ class PlannerClient(MessageEndpointClient):
             self._local_results.clear()
             self._local_results_order.clear()
             self._result_events.clear()
+            self._result_interest.clear()
+        with self._pending_lock:
+            self._pending_results.clear()
+            self._recent_results.clear()
+            self._pending_bytes = 0
+            self._recent_bytes = 0
 
     def close(self) -> None:
         if self._keep_alive is not None:

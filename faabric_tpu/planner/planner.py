@@ -92,6 +92,14 @@ _RECOVERY_SECONDS = _metrics.histogram(
     "faabric_planner_recovery_seconds",
     "Failure detection to requeued messages re-dispatched (includes the "
     "backoff delay)")
+_JOURNAL_REPLAY_SECONDS = _metrics.histogram(
+    "faabric_planner_journal_replay_seconds",
+    "Wall time to rebuild planner state from the write-ahead journal "
+    "at restart (snapshot load + record application)")
+_RECONCILED_MESSAGES = _metrics.counter(
+    "faabric_planner_journal_reconciled_messages_total",
+    "Replayed in-flight messages handed to requeue recovery because "
+    "their host never re-registered within the reconcile grace window")
 
 
 class PlannerHost:
@@ -186,6 +194,25 @@ class Planner:
         # claiming a pod slice.
         self._device_plane: dict = {"roster": [], "size": 0, "port": 0}
 
+        # Crash safety (ISSUE 4): every durable mutation below appends
+        # to the write-ahead journal (planner/journal.py; the shared
+        # no-op when FAABRIC_PLANNER_JOURNAL_DIR is unset), and a
+        # restarted planner replays itself back before serving.
+        from faabric_tpu.planner.journal import open_planner_journal
+
+        self._journal = open_planner_journal()
+        # Replay-only view of the host registry at crash time: hosts
+        # are NEVER resurrected as live (their keep-alive clock died
+        # with the old process) — they re-register via the existing
+        # known:false rejoin path, and _reconcile_after_restart
+        # requeues what belonged to hosts that never come back.
+        self._journal_last_hosts: set[str] = set()
+        self._journal_replay_stats: Optional[dict] = None
+        self._reconcile_stats: Optional[dict] = None
+        self._reconcile_timer: Optional[threading.Timer] = None
+        if self._journal.enabled:
+            self._recover_from_journal()
+
     # ------------------------------------------------------------------
     # Host membership (reference Planner.cpp:267-392)
     # ------------------------------------------------------------------
@@ -201,6 +228,15 @@ class Planner:
                 # the previous entry already expired off the registry,
                 # a pooled connection to the dead incarnation may remain
                 fresh = overwrite
+                # A brand-new PlannerHost starts with zero used slots,
+                # but in-flight decisions may still pin rows to this ip
+                # (planner restart replay; rejoin racing a recovery
+                # pass) — re-apply those claims or the host would
+                # oversubscribe until the app drains
+                self._reclaim_host_rows_locked(ip)
+                if self._journal.enabled:
+                    self._journal_append("host_register", ip=ip,
+                                         slots=slots, n_devices=n_devices)
                 logger.debug("Planner registered host %s (slots=%d chips=%d)",
                              ip, slots, n_devices)
             else:
@@ -228,7 +264,26 @@ class Planner:
 
     def remove_host(self, ip: str) -> None:
         with self._lock:
-            self._hosts.pop(ip, None)
+            existed = self._hosts.pop(ip, None) is not None
+            # A deregistered host cannot serve state reads: drop its
+            # masterships so the next claim re-elects a live host
+            # (satellite fix — previously the key resolved to a corpse
+            # forever)
+            self._drop_state_masters_for_locked({ip})
+            if existed and self._journal.enabled:
+                self._journal_append("host_remove", ip=ip)
+
+    def _drop_state_masters_for_locked(self, ips: set[str]) -> None:
+        """Drop every state-master entry owned by ``ips`` (called under
+        the planner lock on host death/removal)."""
+        dead = [k for k, v in self._state_masters.items() if v in ips]
+        for key in dead:
+            del self._state_masters[key]
+            if self._journal.enabled:
+                self._journal_append("state_drop", key=key)
+        if dead:
+            logger.warning("Dropped %d state masterships of dead host(s) "
+                           "%s", len(dead), sorted(ips))
 
     def expire_hosts(self) -> None:
         conf = get_system_config()
@@ -241,7 +296,10 @@ class Planner:
                 logger.warning("Expiring host %s (no keep-alive)", ip)
                 flight_record("host_expired", host=ip)
                 del self._hosts[ip]
+                if self._journal.enabled:
+                    self._journal_append("host_expired", ip=ip)
             if stale:
+                self._drop_state_masters_for_locked(set(stale))
                 # A dead worker cannot report results: recover its
                 # in-flight messages so batch waiters unblock instead of
                 # hanging forever (dispatch is async fire-and-forget — a
@@ -454,6 +512,8 @@ class Planner:
             self._group_hosts[req.app_id] = (
                 gids | {mappings.group_id}, hosts | set(mappings.hosts))
             _IN_FLIGHT_APPS.set(len(self._in_flight))
+            if self._journal.enabled:
+                self._journal_app_update_locked(req.app_id)
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         return result
@@ -597,6 +657,10 @@ class Planner:
         else:
             self._evicted[req.app_id] = req
         _IN_FLIGHT_APPS.set(len(self._in_flight))
+        if self._journal.enabled:
+            self._journal_append(
+                "app_freeze", app_id=req.app_id,
+                req=self._evicted[req.app_id].to_dict())
 
     # -- resource accounting ---------------------------------------------
     def _policy_host_map(self) -> dict[str, HostState]:
@@ -843,6 +907,16 @@ class Planner:
                         hosts | set(mappings.hosts))
                     _REQUEUES_TOTAL.inc()
                     _REQUEUED_MESSAGES.inc(len(todo))
+                    if self._journal.enabled:
+                        # Requeue outcome is durable: the moved rows are
+                        # in the live decision now — journal the merged
+                        # record (plus a forensic marker journaldump
+                        # renders on its own line)
+                        self._journal_append(
+                            "requeued", app_id=app_id,
+                            n_messages=len(todo),
+                            hosts=sorted(set(new_decision.hosts)))
+                        self._journal_app_update_locked(app_id)
         if retry_later:
             used = self._requeue_attempts.get(app_id, 1)
             delay = self._requeue_delay(used)
@@ -1054,40 +1128,14 @@ class Planner:
                 # there as a MIGRATION batch (reference §3.5)
                 redispatch = self._build_migration_redispatch(app_id, msg_id)
             if not migrated and not frozen:
-                if msg_id in self._results.get(app_id, {}):
-                    # First write wins (ADVICE r5): a synthetic FAILED
-                    # result (host expiry) racing a genuine late result —
-                    # or a duplicate report — must never overwrite the
-                    # recorded result. The first write already released
-                    # the slot and notified waiters; late readers get
-                    # the stored result from get_message_result.
-                    logger.debug("Ignoring duplicate result for msg %d "
-                                 "(app %d)", msg_id, app_id)
+                if not self._record_result_locked(msg):
                     return
-                self._release_message(app_id, msg_id)
-                self._results.setdefault(app_id, {})[msg_id] = msg
-                _RESULTS_TOTAL.inc()
-                if msg.timestamp:
-                    _RESULT_ROUNDTRIP.observe(
-                        max(0.0, time.time() - msg.timestamp))
-
-                in_flight = self._in_flight.get(app_id)
-                if in_flight is not None:
-                    req, decision = in_flight
-                    decision.remove_message(msg_id)
-                    for i, m in enumerate(req.messages):
-                        if m.id == msg_id:
-                            del req.messages[i]
-                            break
-                    if decision.n_messages == 0:
-                        del self._in_flight[app_id]
-                        self._next_idx.pop(app_id, None)
-                        self._preloaded.pop(app_id, None)
-                        self._requeue_attempts.pop(app_id, None)
-                        self._completed_order.append(app_id)
-                        self._evict_old_results()
-                        logger.debug("App %d complete", app_id)
-                    _IN_FLIGHT_APPS.set(len(self._in_flight))
+                if self._journal.enabled:
+                    # Lazy fields: the drain thread runs to_dict. Safe —
+                    # a stored result is never mutated afterwards (the
+                    # first-write-wins store is also the read source)
+                    self._journal_append_fields(
+                        "result", lambda m=msg: {"msg": m.to_dict()})
 
             waiters = self._waiters.pop((app_id, msg_id), set())
             clients = [self._get_client(ip) for ip in waiters]
@@ -1110,6 +1158,57 @@ class Planner:
 
         if redispatch is not None:
             self._do_dispatch([redispatch])
+
+    def _record_result_locked(self, msg: Message,
+                              replay: bool = False) -> bool:
+        """The pure state mutation of a (non-migration, non-freeze)
+        result: first-write-wins store, slot release, in-flight row
+        removal and completion bookkeeping. Shared verbatim by the live
+        path and journal replay so a replayed planner lands in exactly
+        the state the crashed one held. Returns False on a duplicate."""
+        app_id, msg_id = msg.app_id, msg.id
+        if msg_id in self._results.get(app_id, {}):
+            # First write wins (ADVICE r5): a synthetic FAILED
+            # result (host expiry) racing a genuine late result —
+            # or a duplicate report — must never overwrite the
+            # recorded result. The first write already released
+            # the slot and notified waiters; late readers get
+            # the stored result from get_message_result.
+            logger.debug("Ignoring duplicate result for msg %d "
+                         "(app %d)", msg_id, app_id)
+            return False
+        self._release_message(app_id, msg_id)
+        self._results.setdefault(app_id, {})[msg_id] = msg
+        if not replay:
+            _RESULTS_TOTAL.inc()
+            if msg.timestamp:
+                _RESULT_ROUNDTRIP.observe(
+                    max(0.0, time.time() - msg.timestamp))
+
+        in_flight = self._in_flight.get(app_id)
+        if in_flight is not None:
+            req, decision = in_flight
+            decision.remove_message(msg_id)
+            for i, m in enumerate(req.messages):
+                if m.id == msg_id:
+                    del req.messages[i]
+                    break
+            if decision.n_messages == 0:
+                del self._in_flight[app_id]
+                self._next_idx.pop(app_id, None)
+                self._preloaded.pop(app_id, None)
+                self._requeue_attempts.pop(app_id, None)
+                if app_id not in self._completed_order:
+                    self._completed_order.append(app_id)
+                self._evict_old_results()
+                logger.debug("App %d complete", app_id)
+            _IN_FLIGHT_APPS.set(len(self._in_flight))
+        if replay and app_id not in self._in_flight:
+            # The live path pops this for the group-cleanup broadcast
+            # (set_message_result); replay must land in the same state
+            # without the network side effect
+            self._group_hosts.pop(app_id, None)
+        return True
 
     def _build_migration_redispatch(self, app_id: int, msg_id: int
                                     ) -> Optional[tuple[str, BatchExecuteRequest]]:
@@ -1189,14 +1288,380 @@ class Planner:
         full = f"{user}/{key}"
         with self._lock:
             master = self._state_masters.get(full)
-            if master is None:
+            # Satellite fix: never resolve to a corpse. A recorded
+            # master that fell out of the host registry (died, was
+            # removed, or predates a planner restart and never
+            # re-registered) is re-elected to the live claimer. The
+            # registry-emptiness guard keeps planner-only unit setups
+            # (no registered hosts at all) on the old first-claimer
+            # semantics.
+            stale = (master is not None and self._hosts
+                     and master not in self._hosts)
+            if master is None or stale:
+                if stale:
+                    logger.warning(
+                        "State master %s for %s is not registered; "
+                        "re-electing %s", master, full, claiming_host)
                 master = claiming_host
                 self._state_masters[full] = master
+                if self._journal.enabled:
+                    self._journal_append("state_claim", key=full,
+                                         host=master)
             return master
 
     def drop_state_master(self, user: str, key: str) -> None:
         with self._lock:
-            self._state_masters.pop(f"{user}/{key}", None)
+            dropped = self._state_masters.pop(f"{user}/{key}", None)
+            if dropped is not None and self._journal.enabled:
+                self._journal_append("state_drop", key=f"{user}/{key}")
+
+    # ------------------------------------------------------------------
+    # Crash safety: write-ahead journal + restart replay + reconcile
+    # (planner/journal.py; ISSUE 4)
+    # ------------------------------------------------------------------
+    def _journal_append(self, kind: str, **fields) -> None:
+        """Append one mutation record (call sites hold the planner
+        lock, so journal order IS state order) and fold the log into a
+        snapshot when it crosses the compaction threshold.
+
+        ``result`` records ride the journal's write-behind buffer (the
+        hot path; a crash-lost tail is re-delivered by the workers'
+        recent-results flush); every scheduling-class record is written
+        through before the planner acts on it."""
+        self._journal_append_fields(kind, fields)
+
+    def _journal_append_fields(self, kind: str, fields) -> None:
+        j = self._journal
+        if kind == "result":
+            j.append(kind, fields)
+        else:
+            j.append_durable(kind, fields)
+        if j.since_compact >= j.compact_records:
+            with span("journal", "compact", records=j.since_compact):
+                j.compact(self._journal_snapshot_locked())
+
+    def _journal_app_update_locked(self, app_id: int) -> None:
+        """Journal the app's live in-flight record (request + decision +
+        index bookkeeping) — the one record kind that captures
+        scheduling mutations of every decision type, including requeue
+        merges. If the app already completed (fast tasks can finish
+        before call_batch re-takes the lock), only the expected count is
+        durable — its results carry the rest."""
+        fields: dict = {
+            "app_id": app_id,
+            "expected": self._expected.get(app_id, 0),
+            "next_idx": self._next_idx.get(app_id, 0),
+        }
+        gids, ghosts = self._group_hosts.get(app_id, (set(), set()))
+        fields["group"] = [sorted(gids), sorted(ghosts)]
+        in_flight = self._in_flight.get(app_id)
+        if in_flight is not None:
+            req, decision = in_flight
+            fields["req"] = req.to_dict()
+            fields["decision"] = decision.to_dict()
+        self._journal_append("app_update", **fields)
+
+    def _journal_snapshot_locked(self) -> dict:
+        """The full durable state, as one JSON-serializable dict — the
+        compaction target and the shape `_apply_journal_snapshot_locked`
+        restores. Dict keys become strings in JSON; apply converts
+        back."""
+        return {
+            "in_flight": {
+                str(a): {"req": req.to_dict(), "decision": d.to_dict()}
+                for a, (req, d) in self._in_flight.items()},
+            "results": {
+                str(a): {str(mid): m.to_dict() for mid, m in res.items()}
+                for a, res in self._results.items()},
+            "expected": {str(a): n for a, n in self._expected.items()},
+            "next_idx": {str(a): n for a, n in self._next_idx.items()},
+            "completed_order": list(self._completed_order),
+            "requeue_attempts": {
+                str(a): n for a, n in self._requeue_attempts.items()},
+            "state_masters": dict(self._state_masters),
+            "evicted": {str(a): req.to_dict()
+                        for a, req in self._evicted.items()},
+            "group_hosts": {str(a): [sorted(g), sorted(h)]
+                            for a, (g, h) in self._group_hosts.items()},
+            "num_migrations": self._num_migrations,
+            "known_hosts": sorted(set(self._hosts)
+                                  or self._journal_last_hosts),
+        }
+
+    def _apply_journal_snapshot_locked(self, state: dict) -> None:
+        self._in_flight = {
+            int(a): (BatchExecuteRequest.from_dict(v["req"]),
+                     SchedulingDecision.from_dict(v["decision"]))
+            for a, v in (state.get("in_flight") or {}).items()}
+        self._results = {
+            int(a): {int(mid): Message.from_dict(m)
+                     for mid, m in res.items()}
+            for a, res in (state.get("results") or {}).items()}
+        self._expected = {int(a): int(n) for a, n in
+                          (state.get("expected") or {}).items()}
+        self._next_idx = {int(a): int(n) for a, n in
+                          (state.get("next_idx") or {}).items()}
+        self._completed_order = [int(a) for a in
+                                 state.get("completed_order") or []]
+        self._requeue_attempts = {
+            int(a): int(n) for a, n in
+            (state.get("requeue_attempts") or {}).items()}
+        self._state_masters = dict(state.get("state_masters") or {})
+        self._evicted = {int(a): BatchExecuteRequest.from_dict(r)
+                         for a, r in (state.get("evicted") or {}).items()}
+        self._group_hosts = {
+            int(a): (set(g[0]), set(g[1]))
+            for a, g in (state.get("group_hosts") or {}).items()}
+        self._num_migrations = int(state.get("num_migrations") or 0)
+        self._journal_last_hosts = set(state.get("known_hosts") or [])
+
+    def _apply_journal_record_locked(self, rec: dict) -> None:
+        """Apply one replayed record. Every branch is idempotent —
+        applying the same record twice (compaction-crash overlap, a
+        double replay in tests) must land in identical state."""
+        kind = rec.get("k")
+        if kind == "host_register":
+            self._journal_last_hosts.add(rec["ip"])
+        elif kind in ("host_remove", "host_expired"):
+            self._journal_last_hosts.discard(rec["ip"])
+        elif kind == "flush_hosts":
+            self._journal_last_hosts.clear()
+        elif kind == "app_update":
+            app_id = int(rec["app_id"])
+            self._expected[app_id] = int(rec.get("expected") or 0)
+            if rec.get("next_idx"):
+                self._next_idx[app_id] = int(rec["next_idx"])
+            group = rec.get("group") or [[], []]
+            gids, ghosts = self._group_hosts.get(app_id, (set(), set()))
+            self._group_hosts[app_id] = (gids | set(group[0]),
+                                         ghosts | set(group[1]))
+            self._evicted.pop(app_id, None)
+            if rec.get("req") is None:
+                return
+            req = BatchExecuteRequest.from_dict(rec["req"])
+            decision = SchedulingDecision.from_dict(rec["decision"])
+            # Prune rows whose results already replayed (idempotence:
+            # a re-applied app_update must not resurrect rows that
+            # earlier result records removed — those results are
+            # duplicates on the second pass and would never re-remove
+            # them)
+            recorded = self._results.get(app_id, {})
+            for mid in [m for m in decision.message_ids if m in recorded]:
+                decision.remove_message(mid)
+                req.messages = [m for m in req.messages if m.id != mid]
+            if decision.n_messages == 0 and recorded:
+                # Every row already has a result: the app is complete
+                self._in_flight.pop(app_id, None)
+                self._next_idx.pop(app_id, None)
+                self._requeue_attempts.pop(app_id, None)
+                if app_id not in self._completed_order:
+                    self._completed_order.append(app_id)
+                self._evict_old_results()
+            else:
+                self._in_flight[app_id] = (req, decision)
+                self._results.setdefault(app_id, {})
+        elif kind == "result":
+            self._record_result_locked(Message.from_dict(rec["msg"]),
+                                       replay=True)
+        elif kind == "app_freeze":
+            app_id = int(rec["app_id"])
+            self._in_flight.pop(app_id, None)
+            self._evicted[app_id] = BatchExecuteRequest.from_dict(
+                rec["req"])
+        elif kind == "state_claim":
+            self._state_masters[rec["key"]] = rec["host"]
+        elif kind == "state_drop":
+            self._state_masters.pop(rec["key"], None)
+        elif kind == "requeued":
+            pass  # forensic marker; state rides in its app_update
+        elif kind == "flush_scheduling":
+            self._in_flight.clear()
+            self._results.clear()
+            self._expected.clear()
+            self._next_idx.clear()
+            self._completed_order.clear()
+            self._waiters.clear()
+            self._requeue_attempts.clear()
+            self._preloaded.clear()
+        elif kind == "reset":
+            self._apply_journal_snapshot_locked({})
+            self._preloaded.clear()
+            self._waiters.clear()
+            self._next_evicted_ips.clear()
+        else:
+            logger.debug("Skipping unknown journal record kind %r", kind)
+
+    def _recover_from_journal(self) -> None:
+        """Restart replay: snapshot + journal → planner state, then arm
+        the reconcile grace timer so decisions stranded on hosts that
+        never re-register flow into the requeue machinery."""
+        t0 = time.monotonic()
+        snapshot, records, meta = self._journal.replay()
+        if snapshot is None and not records:
+            return
+        with span("journal", "replay", records=len(records)):
+            with self._lock:
+                if snapshot is not None:
+                    self._apply_journal_snapshot_locked(snapshot)
+                for rec in records:
+                    try:
+                        self._apply_journal_record_locked(rec)
+                    except Exception:  # noqa: BLE001 — one bad record
+                        # must not void the rest of the recovery
+                        logger.exception(
+                            "Skipping unreplayable journal record %r",
+                            rec.get("k"))
+                in_flight_apps = len(self._in_flight)
+                in_flight_msgs = sum(
+                    d.n_messages for _, d in self._in_flight.values())
+                n_results = sum(len(r) for r in self._results.values())
+                n_masters = len(self._state_masters)
+                _IN_FLIGHT_APPS.set(in_flight_apps)
+                if not meta.get("snapshot_error"):
+                    # Fold the replayed log immediately: a crash-restart
+                    # loop must not re-apply an ever-growing journal.
+                    # Skipped when the snapshot was unreadable —
+                    # compacting would overwrite the corrupt file with
+                    # this (partial) state and destroy any chance of
+                    # manual recovery from it.
+                    self._journal.compact(self._journal_snapshot_locked())
+        elapsed = time.monotonic() - t0
+        _JOURNAL_REPLAY_SECONDS.observe(elapsed)
+        self._journal_replay_stats = {
+            "records": meta.get("records", len(records)),
+            "snapshot": bool(meta.get("snapshot")),
+            # An unreadable snapshot means the tail records were applied
+            # against EMPTY base state — a partial recovery. Loud in
+            # /healthz so an operator never reads it as clean.
+            "snapshotError": meta.get("snapshot_error"),
+            "partial": bool(meta.get("snapshot_error")),
+            "torn": bool(meta.get("torn")),
+            "tornBytes": meta.get("torn_bytes", 0),
+            "inFlightApps": in_flight_apps,
+            "inFlightMessages": in_flight_msgs,
+            "results": n_results,
+            "stateMasters": n_masters,
+            "lastKnownHosts": sorted(self._journal_last_hosts),
+            "seconds": round(elapsed, 4),
+            "ts": time.time(),
+        }
+        logger.warning(
+            "Planner replayed journal: %d record(s)%s -> %d in-flight "
+            "app(s) (%d msgs), %d result(s), %d state master(s) in "
+            "%.3fs", len(records),
+            " + snapshot" if meta.get("snapshot") else "",
+            in_flight_apps, in_flight_msgs, n_results, n_masters, elapsed)
+        if meta.get("snapshot_error"):
+            logger.error(
+                "PARTIAL journal recovery: snapshot unreadable (%s); "
+                "tail records were applied against empty base state — "
+                "apps folded into the snapshot are missing",
+                meta["snapshot_error"])
+        flight_record("journal_replayed", records=len(records),
+                      apps=in_flight_apps, messages=in_flight_msgs,
+                      results=n_results, torn=bool(meta.get("torn")),
+                      partial=bool(meta.get("snapshot_error")))
+        flight_dump("planner_restart_replay")
+        if in_flight_apps or n_masters:
+            conf = get_system_config()
+            grace = (conf.planner_reconcile_grace
+                     or conf.planner_host_timeout)
+            self._reconcile_timer = threading.Timer(
+                grace, self._reconcile_after_restart)
+            self._reconcile_timer.daemon = True
+            self._reconcile_timer.start()
+            logger.warning(
+                "Reconcile armed: hosts have %.1fs to re-register "
+                "before stranded decisions requeue", grace)
+
+    def _reconcile_after_restart(self) -> None:
+        """The grace window closed: in-flight rows whose host never
+        re-registered go to requeue recovery; state masterships owned
+        by ghosts are dropped so the next claim re-elects."""
+        conf = get_system_config()
+        doomed: dict[int, list[Message]] = {}
+        with span("journal", "reconcile"):
+            with self._lock:
+                self._reconcile_timer = None
+                registered = set(self._hosts)
+                missing: set[str] = set()
+                for app_id, (req, decision) in self._in_flight.items():
+                    for i, h in enumerate(decision.hosts):
+                        if h in registered:
+                            continue
+                        missing.add(h)
+                        mid = decision.message_ids[i]
+                        doomed.setdefault(app_id, []).extend(
+                            m for m in req.messages if m.id == mid)
+                ghosts = {v for v in self._state_masters.values()
+                          if v not in registered}
+                if ghosts:
+                    self._drop_state_masters_for_locked(ghosts)
+        n_msgs = sum(len(v) for v in doomed.values())
+        self._reconcile_stats = {
+            "ts": time.time(),
+            "graceSeconds": (conf.planner_reconcile_grace
+                             or conf.planner_host_timeout),
+            "missingHosts": sorted(missing),
+            "requeuedApps": len(doomed),
+            "requeuedMessages": n_msgs,
+            "droppedStateMasters": len(ghosts),
+        }
+        flight_record("planner_reconcile", apps=len(doomed),
+                      messages=n_msgs, missing_hosts=sorted(missing))
+        if not doomed:
+            logger.info("Reconcile after restart: every replayed host "
+                        "re-registered; nothing to requeue")
+            return
+        _RECONCILED_MESSAGES.inc(n_msgs)
+        logger.warning(
+            "Reconcile after restart: host(s) %s never re-registered; "
+            "requeueing %d message(s) across %d app(s)",
+            sorted(missing), n_msgs, len(doomed))
+        for app_id, msgs in doomed.items():
+            threading.Thread(
+                target=self._recover_messages,
+                args=(app_id, msgs,
+                      b"Host never re-registered after planner restart"),
+                name=f"recover-{app_id}", daemon=True).start()
+
+    def _reclaim_host_rows_locked(self, ip: str) -> None:
+        """Re-apply slot/port/device claims for in-flight rows pinned to
+        a freshly (re)created host record — a new PlannerHost starts at
+        zero used slots, which would otherwise double-book capacity
+        under replayed (or rejoin-racing-recovery) decisions."""
+        host = self._hosts.get(ip)
+        if host is None or not self._in_flight:
+            return
+        n = 0
+        for _, (_, decision) in self._in_flight.items():
+            for i, h in enumerate(decision.hosts):
+                if h != ip:
+                    continue
+                host.state.claim(1)
+                if decision.mpi_ports[i]:
+                    host.used_mpi_ports.add(decision.mpi_ports[i])
+                dev = decision.device_ids[i]
+                if 0 <= dev < len(host.device_load):
+                    host.device_load[dev] += 1
+                n += 1
+        if n:
+            logger.info("Re-claimed %d in-flight slot(s) on "
+                        "(re)registered host %s", n, ip)
+
+    def flush_journal(self) -> None:
+        """fsync any batched journal writes (server stop path)."""
+        self._journal.flush()
+
+    def close_journal(self) -> None:
+        """Drain + fsync + close the journal (fd and drain thread).
+        The lifecycle hook for clean shutdown and in-process
+        start/stop cycles; reopening requires a new Planner."""
+        with self._lock:
+            if self._reconcile_timer is not None:
+                self._reconcile_timer.cancel()
+                self._reconcile_timer = None
+        self._journal.close()
 
     # ------------------------------------------------------------------
     # Observability / reset
@@ -1265,11 +1730,21 @@ class Planner:
                 }
         for row in hosts:
             row["breaker"] = breakers.get(row["host"])
+        # Journal lag: size, last-fsync age and the latest replay/
+        # reconcile stats — the probe a supervisor watches to know the
+        # restarted planner actually recovered (acceptance: recovery
+        # visible in /healthz)
+        journal = self._journal.stats()
+        if self._journal_replay_stats is not None:
+            journal["lastReplay"] = self._journal_replay_stats
+        if self._reconcile_stats is not None:
+            journal["lastReconcile"] = self._reconcile_stats
         return {
             "status": "ok",
             "hosts": hosts,
             "inFlightApps": in_flight_apps,
             "inFlightMessages": in_flight_messages,
+            "journal": journal,
         }
 
     def collect_telemetry(self, include_trace: bool = False,
@@ -1336,6 +1811,8 @@ class Planner:
 
     def flush_hosts(self) -> None:
         with self._lock:
+            if self._journal.enabled:
+                self._journal_append("flush_hosts")
             self._hosts.clear()
 
     def flush_all_executors(self) -> list[str]:
@@ -1359,6 +1836,13 @@ class Planner:
 
     def reset(self) -> None:
         with self._lock:
+            if self._reconcile_timer is not None:
+                self._reconcile_timer.cancel()
+                self._reconcile_timer = None
+            if self._journal.enabled:
+                # A reset is itself a durable mutation: without the
+                # record, a replay would resurrect pre-reset state
+                self._journal_append("reset")
             self._hosts.clear()
             self._in_flight.clear()
             self._results.clear()
@@ -1385,6 +1869,8 @@ class Planner:
 
     def flush_scheduling_state(self) -> None:
         with self._lock:
+            if self._journal.enabled:
+                self._journal_append("flush_scheduling")
             self._in_flight.clear()
             _IN_FLIGHT_APPS.set(0)
             self._results.clear()

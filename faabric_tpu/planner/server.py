@@ -56,6 +56,11 @@ class PlannerCalls(enum.IntEnum):
     DROP_STATE_MASTER = 13
     CHECK_MIGRATION = 14
     JOIN_DEVICE_PLANE = 15
+    # Degraded-mode drain (ISSUE 4): a worker that buffered results
+    # while the planner was down flushes them in one SYNC call after
+    # rejoin — unlike the fire-and-forget async result push, the
+    # response confirms delivery so the worker can clear its queue
+    FLUSH_RESULTS = 16
 
 
 class PlannerServer(MessageEndpointServer):
@@ -93,6 +98,10 @@ class PlannerServer(MessageEndpointServer):
         self.expiry_reaper.stop()
         self.snapshot_server.stop()
         super().stop()
+        # Clean stop: drain the write-behind buffer, fsync, and release
+        # the journal fd + drain thread (in-process start/stop cycles
+        # must not accumulate either)
+        self.planner.close_journal()
 
     # ------------------------------------------------------------------
     def do_async_recv(self, msg: TransportMessage) -> None:
@@ -189,6 +198,16 @@ class PlannerServer(MessageEndpointServer):
         if code == int(PlannerCalls.DROP_STATE_MASTER):
             self.planner.drop_state_master(h["user"], h["key"])
             return handler_response()
+
+        if code == int(PlannerCalls.FLUSH_RESULTS):
+            msgs = messages_from_wire(h.get("msgs", []), msg.payload)
+            for result in msgs:
+                # set_message_result is first-write-wins, so a flush
+                # retried after a half-delivered attempt is harmless
+                self.planner.set_message_result(result)
+            logger.info("Flushed %d buffered result(s) from %s",
+                        len(msgs), h.get("host", "?"))
+            return handler_response(header={"accepted": len(msgs)})
 
         if code == int(PlannerCalls.PRELOAD_SCHEDULING_DECISION):
             decision = SchedulingDecision.from_dict(h["decision"])

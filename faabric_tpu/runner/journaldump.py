@@ -1,0 +1,116 @@
+"""Pretty-print / verify a planner write-ahead journal.
+
+    python -m faabric_tpu.runner.journaldump <dir> [--json] [--last N]
+                                             [--kind K] [--verify]
+
+The companion of ``flightdump`` for the control plane: reads the
+journal directory a planner wrote (``FAABRIC_PLANNER_JOURNAL_DIR`` —
+``planner.journal`` + the compaction snapshot ``planner.snapshot.json``,
+see planner/journal.py) and renders the snapshot summary plus every
+valid record on one timeline. ``--verify`` exits non-zero when the
+journal has a torn tail or an unreadable snapshot — the CI hook for
+"the black box itself is intact".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from faabric_tpu.planner.journal import load_journal_dir
+
+
+def _fmt_fields(rec: dict) -> str:
+    skip = ("k", "ts")
+    out = []
+    for key in rec:
+        if key in skip:
+            continue
+        val = rec[key]
+        if isinstance(val, dict):
+            # Nested payloads (req/decision/msg) render as summaries:
+            # the point of the dump is the timeline, not a JSON wall
+            n = val.get("messages")
+            ident = val.get("app_id", val.get("id", ""))
+            size = len(n) if isinstance(n, list) else len(val)
+            out.append(f"{key}=<{ident}:{size}>")
+        else:
+            out.append(f"{key}={val}")
+    return " ".join(out)
+
+
+def render(records: list[dict], last: int | None = None) -> str:
+    if last is not None:
+        records = records[-last:]
+    if not records:
+        return "(no journal records)"
+    t0 = records[0].get("ts", 0.0)
+    lines = []
+    for rec in records:
+        lines.append(f"{rec.get('ts', 0.0) - t0:+10.3f}s "
+                     f"{rec.get('k', '?'):<18} {_fmt_fields(rec)}")
+    return "\n".join(lines)
+
+
+def snapshot_summary(state: dict | None) -> str:
+    if state is None:
+        return "no snapshot"
+    in_flight = state.get("in_flight") or {}
+    results = state.get("results") or {}
+    return (f"snapshot: {len(in_flight)} in-flight app(s), "
+            f"{sum(len(r) for r in results.values())} result(s), "
+            f"{len(state.get('state_masters') or {})} state master(s), "
+            f"{len(state.get('evicted') or {})} frozen, "
+            f"last known hosts {state.get('known_hosts') or []}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="faabric_tpu.runner.journaldump",
+        description="Pretty-print / verify a planner write-ahead journal")
+    parser.add_argument(
+        "directory", nargs="?",
+        default=os.environ.get("FAABRIC_PLANNER_JOURNAL_DIR", "."))
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable records + snapshot + meta")
+    parser.add_argument("--last", type=int, default=None,
+                        help="only the final N records")
+    parser.add_argument("--kind", default=None,
+                        help="filter by record kind (e.g. result)")
+    parser.add_argument("--verify", action="store_true",
+                        help="exit non-zero on a torn tail or a "
+                             "corrupt/unreadable snapshot")
+    args = parser.parse_args(argv)
+
+    snapshot, records, meta = load_journal_dir(args.directory)
+    if args.kind:
+        records = [r for r in records if r.get("k") == args.kind]
+
+    if args.json:
+        body = {"meta": meta, "snapshot": snapshot, "records":
+                records[-args.last:] if args.last is not None else records}
+        print(json.dumps(body, indent=1, default=str))
+    else:
+        print(f"{len(records)} record(s) from {args.directory} "
+              f"(generation {meta.get('generation', '?')})")
+        print(snapshot_summary(snapshot))
+        if meta.get("skipped_bytes"):
+            print(f"skipped {meta['skipped_bytes']} journal byte(s) "
+                  "already folded into the snapshot")
+        if meta.get("torn"):
+            print(f"TORN TAIL: {meta.get('torn_bytes', 0)} trailing "
+                  "byte(s) failed length/CRC checks", file=sys.stderr)
+        if meta.get("snapshot_error"):
+            print(f"SNAPSHOT UNREADABLE: {meta['snapshot_error']}",
+                  file=sys.stderr)
+        print(render(records, last=args.last))
+
+    if args.verify and (meta.get("torn") or meta.get("snapshot_error")):
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,10 +140,19 @@ class WorkerRuntime:
             return
         self._started = False
         if remove_host:
+            # Best-effort by design: remove_host flushes any results
+            # buffered during a planner outage, then deregisters; both
+            # swallow RpcError internally (the planner's keep-alive
+            # expiry reaps us anyway) so a dead planner cannot wedge or
+            # crash worker shutdown
             try:
                 self.planner_client.remove_host()
             except Exception:  # noqa: BLE001 — planner may already be gone
                 logger.debug("Could not deregister %s", self.host)
+        else:
+            # Keeping the registration (tests, rolling restarts) must
+            # still not strand results completed during an outage
+            self.planner_client.flush_pending_results()
         if self.device_plane_size > 1:
             from faabric_tpu.parallel.distributed import leave_device_plane
 

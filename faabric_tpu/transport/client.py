@@ -124,7 +124,15 @@ class MessageEndpointClient:
         return header or {}
 
     def async_send(self, code: int, header: dict[str, Any] | None = None,
-                   payload: bytes = b"", seqnum: int = -1) -> None:
+                   payload: bytes = b"", seqnum: int = -1) -> int:
+        """Fire-and-forget send. Returns the number of FAILED attempts
+        before the frame went out (0 = clean first-try send). A
+        non-zero return means the frame was re-sent on a fresh
+        connection — and, crucially, that any PREVIOUS async frame on
+        the old connection may have been silently lost into a dead
+        peer's kernel buffer (the first write after a peer dies
+        "succeeds"; only the next one errors). Callers with redelivery
+        machinery (PlannerClient's recent-results window) key off it."""
         msg = TransportMessage(code=code,
                                header=self._with_trace_context(header),
                                payload=payload, seqnum=seqnum)
@@ -141,12 +149,12 @@ class MessageEndpointClient:
                         # a half-open trial must never exit without an
                         # outcome (it would strand allow() at False)
                         self.breaker.record_success()
-                        return
+                        return attempt
                     send_frame(self._get_sock("async"), msg)
                     _TX_FRAMES["async"].inc()
                     _TX_BYTES["async"].inc(len(payload))
                     self.breaker.record_success()
-                    return
+                    return attempt
                 except (OSError, TransportError) as e:
                     self._reset_sock("async")
                     self.breaker.record_failure()

@@ -114,6 +114,20 @@ class ChaosCluster:
         p.wait(timeout=5)
         return time.monotonic()
 
+    def restart_planner(self):
+        """Spawn a fresh planner process on the same port offset (and,
+        via the environment, the same journal dir) after a kill()."""
+        from tests.dist.test_multiprocess import drain_stdout
+
+        p = self._spawn("planner", "planner", str(self.base))
+        while True:
+            line = p.stdout.readline()
+            assert line, "restarted planner exited before READY"
+            if line.strip() == "READY":
+                break
+        drain_stdout(p)
+        return p
+
     def stop(self):
         if self.me is not None:
             self.me.shutdown()
@@ -372,5 +386,125 @@ def test_chaos_suppressed_keepalives_expire_then_rejoin():
         status = wait_finished(me, req.app_id, timeout=30)
         assert all(m.return_value == int(ReturnValue.SUCCESS)
                    for m in status.message_results)
+    finally:
+        cluster.stop()
+
+
+def wait_finished_tolerant(me, app_id, timeout):
+    """wait_finished for scenarios where the planner itself goes away
+    mid-poll: RpcError (connection refused, open breaker) is part of
+    the scenario, not a failure."""
+    deadline = time.time() + timeout
+    status = None
+    while time.time() < deadline:
+        try:
+            status = me.planner_client.get_batch_results(app_id)
+            if status.finished:
+                return status
+        except Exception:  # noqa: BLE001 — planner down is expected
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"batch {app_id} never finished: {status}")
+
+
+@pytest.mark.slow
+def test_chaos_planner_sigkill_restart_recovers(tmp_path):
+    """ISSUE 4 acceptance: SIGKILL the PLANNER mid-batch. The restarted
+    planner replays its write-ahead journal (pre-crash results intact,
+    in-flight decision restored), workers rejoin via the known:false
+    keep-alive path and flush results they buffered during the outage,
+    and the batch completes with every message SUCCESS. Recovery is
+    visible in /healthz (journal lastReplay) and the flight dumps."""
+    import json
+    import urllib.request
+
+    journal_dir = str(tmp_path / "journal")
+    flight_dir = str(tmp_path / "flight")
+    cluster = ChaosCluster(
+        "ckP", n_workers=2, slots=(8, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "3",
+                   "PLANNER_REQUEUE_BACKOFF": "0.3",
+                   "PLANNER_MAX_REQUEUES": "5",
+                   "FAABRIC_PLANNER_JOURNAL_DIR": journal_dir,
+                   "FAABRIC_PLANNER_RECONCILE_GRACE": "5",
+                   "FAABRIC_FLIGHT_DIR": flight_dir}).start()
+    http_port = cluster.base + 3100
+    cluster.env["DIST_HTTP_PORT"] = str(http_port)
+    try:
+        me = cluster.me
+        # 12 tasks over 8+4 slots: four quick ones finish (and journal
+        # their results) BEFORE the kill; the 4s stragglers finish
+        # during the outage and buffer worker-side
+        req = batch_exec_factory("dist", "sleep", 12)
+        for i, m in enumerate(req.messages):
+            m.input_data = b"0.5" if i < 4 else b"4"
+        me.planner_client.call_functions(req)
+
+        # Wait until pre-crash results are recorded at the planner
+        deadline = time.time() + 20
+        pre_crash = set()
+        while time.time() < deadline:
+            status = me.planner_client.get_batch_results(req.app_id)
+            pre_crash = {m.id for m in status.message_results}
+            if len(pre_crash) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(pre_crash) >= 2, "no results recorded before the kill"
+
+        t_kill = cluster.kill("planner")
+        time.sleep(1.0)  # outage window: stragglers complete + buffer
+
+        # Restart on the same journal dir; now also serve /healthz
+        cluster.restart_planner()
+
+        status = wait_finished_tolerant(me, req.app_id, timeout=60)
+        recovery_s = time.monotonic() - t_kill
+        assert status.expected_num_messages == 12
+        assert len(status.message_results) == 12
+        bad = [(m.id, m.return_value, m.output_data)
+               for m in status.message_results
+               if m.return_value != int(ReturnValue.SUCCESS)]
+        assert not bad, f"batch had failures after planner restart: {bad}"
+        # Pre-crash results rode the journal through the restart
+        post = {m.id for m in status.message_results}
+        assert pre_crash <= post
+        # No terminal failures → no message re-ran: recovery means the
+        # control plane caught up, not that work was redone
+        assert recovery_s < 45, f"recovery took {recovery_s:.1f}s"
+
+        # /healthz on the restarted planner shows the replay
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        journal = health["journal"]
+        assert journal["enabled"]
+        replay = journal["lastReplay"]
+        assert replay["records"] + (
+            1 if replay["snapshot"] else 0) >= 1
+        assert replay["inFlightApps"] >= 1
+        # Both workers (and the client host) re-registered
+        assert len(health["hosts"]) >= 3
+
+        # The flight recorder kept the black box: the restarted planner
+        # dumped on replay
+        from faabric_tpu.runner import flightdump
+
+        deadline = time.time() + 10
+        merged = []
+        while time.time() < deadline:
+            merged = flightdump.merge(flight_dir)
+            if any(e["kind"] == "journal_replayed" for e in merged):
+                break
+            time.sleep(0.5)
+        kinds = {e["kind"] for e in merged}
+        assert "journal_replayed" in kinds, kinds
+
+        # And journaldump can verify + render the journal dir
+        from faabric_tpu.runner import journaldump
+
+        snapshot, records, meta = journaldump.load_journal_dir(
+            journal_dir)
+        assert not meta.get("torn")
+        assert snapshot is not None or records
     finally:
         cluster.stop()

@@ -1,0 +1,396 @@
+"""Planner write-ahead journal tests (ISSUE 4).
+
+Record codec, torn-tail tolerance, replay idempotence, snapshot
+compaction, the state-master corpse fixes, client-side degraded mode
+and the journaldump CLI. All fast and chaos-marked — tier-1 runs them;
+the real SIGKILL-the-planner scenario lives in tests/dist/test_chaos.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from faabric_tpu.planner.journal import (
+    HEADER_LEN,
+    JOURNAL_FILE,
+    NULL_JOURNAL,
+    SNAPSHOT_FILE,
+    PlannerJournal,
+    decode_records,
+    encode_record,
+    load_journal_dir,
+)
+from faabric_tpu.proto import ReturnValue, batch_exec_factory
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.testing import set_mock_mode
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# Record codec
+# ---------------------------------------------------------------------------
+def test_record_encode_decode_roundtrip():
+    recs = [("host_register", {"ip": "w0", "slots": 8, "n_devices": 4}),
+            ("result", {"msg": {"id": 7, "output_data": "ff00"}}),
+            ("state_claim", {"key": "u/k", "host": "w1"})]
+    blob = b"".join(encode_record(k, f) for k, f in recs)
+    decoded, end, torn = decode_records(blob)
+    assert not torn and end == len(blob)
+    assert [(r["k"],) for r in decoded] == [(k,) for k, _ in recs]
+    for (_, fields), rec in zip(recs, decoded):
+        for key, val in fields.items():
+            assert rec[key] == val
+        assert rec["ts"] > 0
+
+
+def test_crc_rejection_stops_replay_at_corruption():
+    good = encode_record("a", {"n": 1}) + encode_record("b", {"n": 2})
+    tail = encode_record("c", {"n": 3})
+    # Flip one payload byte of the final record: CRC must reject it and
+    # replay must keep the valid prefix
+    corrupt = bytearray(good + tail)
+    corrupt[-3] ^= 0xFF
+    decoded, end, torn = decode_records(bytes(corrupt))
+    assert torn and end == len(good)
+    assert [r["k"] for r in decoded] == ["a", "b"]
+
+
+def test_torn_tail_is_truncated_on_reopen(tmp_path):
+    d = str(tmp_path)
+    j = PlannerJournal(d, fsync_interval=0.0)
+    j.append("one", {"n": 1})
+    j.append("two", {"n": 2})
+    j.close()
+    # A crash mid-append leaves half a record at EOF
+    path = os.path.join(d, JOURNAL_FILE)
+    with open(path, "ab") as f:
+        f.write(encode_record("torn", {"n": 3})[:-4])
+    _, records, meta = load_journal_dir(d)
+    assert [r["k"] for r in records] == ["one", "two"]
+    assert meta["torn"] and meta["torn_bytes"] > 0
+    # Reopening for append truncates the torn bytes and appends cleanly
+    j2 = PlannerJournal(d)
+    assert j2.records == 2
+    j2.append("three", {"n": 3})
+    j2.close()
+    _, records, meta = load_journal_dir(d)
+    assert [r["k"] for r in records] == ["one", "two", "three"]
+    assert not meta["torn"]
+
+
+def test_null_journal_is_inert():
+    assert not NULL_JOURNAL.enabled
+    NULL_JOURNAL.append("x", {"y": 1})
+    NULL_JOURNAL.flush()
+    assert NULL_JOURNAL.replay() == (None, [], {"enabled": False})
+    assert NULL_JOURNAL.stats() == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# Planner replay
+# ---------------------------------------------------------------------------
+def _journaled_planner(monkeypatch, tmp_path, **env):
+    from faabric_tpu.planner.planner import Planner
+
+    monkeypatch.setenv("FAABRIC_PLANNER_JOURNAL_DIR", str(tmp_path))
+    # Reconcile must not fire mid-test unless the test waits for it
+    monkeypatch.setenv("FAABRIC_PLANNER_RECONCILE_GRACE",
+                       env.pop("grace", "30"))
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    get_system_config().reset()
+    return Planner()
+
+
+def _state_fingerprint(planner):
+    with planner._lock:
+        return json.dumps(planner._journal_snapshot_locked(),
+                          sort_keys=True, default=str)
+
+
+def test_replay_restores_state_and_is_idempotent(monkeypatch, tmp_path):
+    set_mock_mode(True)  # dispatch/mappings record instead of dialing
+    p = _journaled_planner(monkeypatch, tmp_path)
+    p.register_host("h1", 8, 4)
+    p.register_host("h2", 4, 2)
+    req = batch_exec_factory("u", "fn", 6)
+    p.call_batch(req)
+    p.claim_state_master("u", "k1", "h1")
+    p.claim_state_master("u", "k2", "h2")
+    p.drop_state_master("u", "k2")
+    for m in list(req.messages)[:4]:
+        m.return_value = int(ReturnValue.SUCCESS)
+        m.output_data = b"done"
+        p.set_message_result(m)
+    p.flush_journal()
+
+    # "Crash": fresh planner instances replay the same journal dir.
+    # Replaying ONCE and replaying TWICE (the second instance replays a
+    # journal the first already compacted, then we re-apply the log by
+    # hand) must fingerprint identically.
+    p2 = _journaled_planner(monkeypatch, tmp_path)
+    assert p2._expected[req.app_id] == 6
+    assert len(p2._results[req.app_id]) == 4
+    assert p2.get_in_flight_apps()[req.app_id].n_messages == 2
+    assert p2._state_masters == {"u/k1": "h1"}
+    assert p2._journal_replay_stats["inFlightApps"] == 1
+    fp2 = _state_fingerprint(p2)
+
+    p3 = _journaled_planner(monkeypatch, tmp_path)
+    snapshot, records, _ = p3._journal.replay()
+    with p3._lock:
+        for rec in records:  # second application of the same log
+            p3._apply_journal_record_locked(rec)
+    assert _state_fingerprint(p3) == fp2
+
+    # The remaining messages complete after the restart (snapshot the
+    # id list: the live decision shrinks as results land)
+    for m in list(p2.get_in_flight_apps()[req.app_id].message_ids):
+        orig = next(x for x in req.messages if x.id == m)
+        orig.return_value = int(ReturnValue.SUCCESS)
+        p2.set_message_result(orig)
+    status = p2.get_batch_results(req.app_id)
+    assert status.finished and len(status.message_results) == 6
+
+
+def test_replayed_host_rows_reclaim_slots_on_reregister(monkeypatch,
+                                                        tmp_path):
+    set_mock_mode(True)
+    p = _journaled_planner(monkeypatch, tmp_path)
+    p.register_host("h1", 8, 0)
+    req = batch_exec_factory("u", "fn", 5)
+    p.call_batch(req)
+    p.flush_journal()
+
+    p2 = _journaled_planner(monkeypatch, tmp_path)
+    # Host rejoins the restarted planner: its replayed in-flight rows
+    # must re-claim slots or the policy would double-book the host
+    p2.register_host("h1", 8, 0, overwrite=True)
+    h = next(x for x in p2.get_available_hosts() if x.ip == "h1")
+    assert h.used_slots == 5
+
+
+def test_snapshot_compaction_folds_and_replays(monkeypatch, tmp_path):
+    set_mock_mode(True)
+    p = _journaled_planner(
+        monkeypatch, tmp_path,
+        FAABRIC_PLANNER_JOURNAL_COMPACT_RECORDS="10")
+    p.register_host("h1", 16, 0)
+    done = []
+    for _ in range(4):
+        req = batch_exec_factory("u", "fn", 3)
+        p.call_batch(req)
+        for m in list(req.messages):
+            m.return_value = int(ReturnValue.SUCCESS)
+            p.set_message_result(m)
+        done.append(req.app_id)
+    assert p._journal.compactions >= 1
+    assert os.path.exists(os.path.join(str(tmp_path), SNAPSHOT_FILE))
+    assert p._journal.since_compact < 10 + 3  # log folded, not grown
+    p.flush_journal()
+
+    p2 = _journaled_planner(monkeypatch, tmp_path)
+    for app_id in done:
+        status = p2.get_batch_results(app_id)
+        assert status.finished and len(status.message_results) == 3
+    assert _state_fingerprint(p2) == _state_fingerprint(p)
+
+
+def test_reconcile_requeues_only_unregistered_hosts(monkeypatch,
+                                                    tmp_path):
+    import time
+
+    set_mock_mode(True)
+    p = _journaled_planner(monkeypatch, tmp_path)
+    p.register_host("h1", 4, 0)
+    p.register_host("h2", 4, 0)
+    req = batch_exec_factory("u", "fn", 8)
+    dec = p.call_batch(req)
+    assert set(dec.hosts) == {"h1", "h2"}
+    p.claim_state_master("u", "k", "h2")
+    p.flush_journal()
+
+    p2 = _journaled_planner(monkeypatch, tmp_path, grace="0.4")
+    # h1 rejoins (grown to 8 slots so the requeue fits: 4 reclaimed by
+    # its own replayed rows + 4 for h2's strands); h2 never comes back
+    p2.register_host("h1", 8, 0, overwrite=True)
+    deadline = time.time() + 10
+    while p2._reconcile_stats is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert p2._reconcile_stats is not None, "reconcile never ran"
+    assert p2._reconcile_stats["missingHosts"] == ["h2"]
+    assert p2._reconcile_stats["requeuedMessages"] == 4
+    assert p2._reconcile_stats["droppedStateMasters"] == 1
+    # h2's messages flow into the requeue machinery onto h1 (the
+    # requeue thread backs off first — poll the live decision)
+    deadline = time.time() + 10
+    live = None
+    while time.time() < deadline:
+        live = p2.get_in_flight_apps().get(req.app_id)
+        if live is not None and set(live.hosts) == {"h1"}:
+            break
+        time.sleep(0.05)
+    assert live is not None and set(live.hosts) == {"h1"}, live
+    assert live.n_messages == 8  # nothing failed, everything re-placed
+
+
+def test_healthz_reports_journal_and_replay(monkeypatch, tmp_path):
+    set_mock_mode(True)
+    p = _journaled_planner(monkeypatch, tmp_path)
+    p.register_host("h1", 2, 0)
+    health = p.health_summary()
+    j = health["journal"]
+    assert j["enabled"] and j["records"] >= 1
+    assert j["sizeBytes"] > HEADER_LEN
+    assert "lastFsyncAgeSeconds" in j
+    p.flush_journal()
+
+    p2 = _journaled_planner(monkeypatch, tmp_path)
+    j2 = p2.health_summary()["journal"]
+    assert j2["lastReplay"]["records"] >= 1
+    assert j2["lastReplay"]["lastKnownHosts"] == ["h1"]
+
+
+def test_journal_disabled_healthz_and_noop():
+    from faabric_tpu.planner.planner import Planner
+
+    set_mock_mode(True)
+    p = Planner()
+    assert not p._journal.enabled
+    p.register_host("h1", 2, 0)
+    assert p.health_summary()["journal"] == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# State-master corpse fixes (satellite)
+# ---------------------------------------------------------------------------
+def test_expire_hosts_drops_dead_state_masters(monkeypatch):
+    from faabric_tpu.planner.planner import Planner
+
+    set_mock_mode(True)
+    monkeypatch.setenv("PLANNER_HOST_TIMEOUT", "0.2")
+    get_system_config().reset()
+    import time
+
+    p = Planner()
+    p.register_host("alive", 2, 0)
+    p.register_host("doomed", 2, 0)
+    assert p.claim_state_master("u", "k", "doomed") == "doomed"
+    assert p.claim_state_master("u", "k2", "alive") == "alive"
+    time.sleep(0.3)
+    p.register_host("alive", 2, 0)  # keep-alive refresh
+    p.expire_hosts()
+    assert p.num_registered_hosts() == 1
+    # The dead master's key re-elects the next claimer; the live one
+    # stays put
+    assert p.claim_state_master("u", "k", "alive") == "alive"
+    assert p.claim_state_master("u", "k2", "alive") == "alive"
+
+
+def test_remove_host_drops_masters_and_claim_reelects():
+    from faabric_tpu.planner.planner import Planner
+
+    set_mock_mode(True)
+    p = Planner()
+    p.register_host("h1", 2, 0)
+    p.register_host("h2", 2, 0)
+    assert p.claim_state_master("u", "k", "h1") == "h1"
+    p.remove_host("h1")
+    # Re-claim from a live host wins; the corpse is gone
+    assert p.claim_state_master("u", "k", "h2") == "h2"
+    # A stale master lingering in the map (no registered hosts at all →
+    # planner-only unit setups) keeps first-claimer semantics
+    p2 = Planner()
+    assert p2.claim_state_master("u", "k", "x") == "x"
+    assert p2.claim_state_master("u", "k", "y") == "x"
+
+
+# ---------------------------------------------------------------------------
+# Client degraded mode (satellite)
+# ---------------------------------------------------------------------------
+def test_client_buffers_results_while_planner_down():
+    from faabric_tpu.planner.client import PlannerClient
+    from faabric_tpu.proto import message_factory
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("deadplanner", "127.0.0.1", base)
+    client = PlannerClient("w0", planner_host="deadplanner")
+    client.retry.max_attempts = 1  # fail fast: nothing listens there
+    try:
+        msg = message_factory("u", "fn")
+        msg.return_value = int(ReturnValue.SUCCESS)
+        # Must buffer, not raise into the (executor) caller
+        client.set_message_result(msg)
+        assert client.planner_down
+        assert len(client._pending_results) == 1
+        # Flush against the still-dead planner re-queues untouched
+        client.flush_pending_results()
+        assert len(client._pending_results) == 1
+
+        # Planner comes back: the flush drains the queue
+        from faabric_tpu.planner import PlannerServer, get_planner
+
+        get_planner().reset()
+        server = PlannerServer(port_offset=base)
+        server.start()
+        try:
+            client.flush_pending_results()
+            assert client._pending_results == []
+            assert get_planner().get_message_result(
+                msg.app_id, msg.id) is not None
+        finally:
+            server.stop()
+            get_planner().reset()
+    finally:
+        client.close()
+
+
+def test_keepalive_survives_dead_planner():
+    from faabric_tpu.planner.client import KeepAliveThread, PlannerClient
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("noplanner", "127.0.0.1", base)
+    client = PlannerClient("w1", planner_host="noplanner")
+    client.retry.max_attempts = 1
+    try:
+        ka = KeepAliveThread(client, slots=2, n_devices=0)
+        # A tick against a dead planner must neither raise nor spin
+        ka.do_work()
+        ka.do_work()
+        assert client.planner_down
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# journaldump CLI (satellite)
+# ---------------------------------------------------------------------------
+def test_journaldump_renders_and_verifies(tmp_path, capsys):
+    from faabric_tpu.runner import journaldump
+
+    d = str(tmp_path)
+    j = PlannerJournal(d, fsync_interval=0.0)
+    j.append("host_register", {"ip": "w0", "slots": 4})
+    j.append("result", {"msg": {"id": 9, "app_id": 3}})
+    j.close()
+
+    assert journaldump.main([d]) == 0
+    out = capsys.readouterr().out
+    assert "host_register" in out and "result" in out
+    assert journaldump.main([d, "--verify"]) == 0
+    capsys.readouterr()
+    assert journaldump.main([d, "--kind", "result", "--json"]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert [r["k"] for r in body["records"]] == ["result"]
+
+    # Torn journal: --verify flags it, plain dump still renders prefix
+    with open(os.path.join(d, JOURNAL_FILE), "ab") as f:
+        f.write(b"\x99\x00\x00\x00garbage")
+    assert journaldump.main([d, "--verify"]) == 2
+    assert journaldump.main([d]) == 0
