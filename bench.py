@@ -891,10 +891,23 @@ def _sendrecv_sizes() -> list[int]:
     return sizes
 
 
+def _sendrecv_warmup_sizes() -> list[int]:
+    """Element counts that establish every data-plane path before the
+    clock starts: one over-threshold frame per data stripe (each dials
+    its connection and creates/announces its shm ring) plus one small
+    frame for the control stripe. Connection + 32 MiB-ring setup is a
+    one-time ~100 ms cost that would otherwise be billed to a ~100 ms
+    steady-state measurement."""
+    from faabric_tpu.transport.bulk import BULK_STRIPES, BULK_THRESHOLD
+
+    return [BULK_THRESHOLD // 4 + 1] * max(1, BULK_STRIPES) + [8]
+
+
 def _sendrecv_worker_main() -> None:
     """Child process body for the cross-process send/recv bench: rank 2
-    on xbenchB receives the full size distribution from rank 0, then
-    acks with one byte so the parent's clock includes wire drain."""
+    on xbenchB receives the warmup frames then the full size
+    distribution from rank 0, and acks with one byte so the parent's
+    clock includes wire drain."""
     import numpy as np
 
     broker, server, world = _bench_world("xbenchB", app_id=4)
@@ -903,6 +916,9 @@ def _sendrecv_worker_main() -> None:
         sizes = _sendrecv_sizes()
         # Handshake instead of a barrier: only ranks 0 and 2 are driven
         world.send(2, 0, np.array([7], np.int32))
+        for n in _sendrecv_warmup_sizes():
+            world.recv(0, 2)
+        world.send(2, 0, np.array([7], np.int32))  # warm-up drained
         ok = True
         for n in sizes:
             got, _ = world.recv(0, 2)
@@ -947,6 +963,12 @@ def bench_host_sendrecv_procs() -> dict:
         bufs = [np.zeros(n, np.int32) for n in sizes]
         hello, _ = world.recv(2, 0)  # receiver up (no barrier: 2 ranks)
         assert int(hello[0]) == 7
+        # Establish every stripe + ring outside the clock (steady-state
+        # data-plane rate, not connection setup)
+        for n in _sendrecv_warmup_sizes():
+            world.send(0, 2, np.zeros(n, np.int32))
+        warm, _ = world.recv(2, 0)
+        assert int(warm[0]) == 7
         t0 = time.perf_counter()
         for buf in bufs:
             world.send(0, 2, buf)
@@ -1414,8 +1436,15 @@ _CPU_SECTIONS = ["probe", "step_tiny", "step_tiny_reference",
 # absorbs backend init through the remote tunnel; step budgets absorb
 # first-time XLA compiles (the on-disk compilation cache makes reruns
 # cheap). The parent also enforces the overall stage budget.
+#
+# The probe budget fast-fails by default: when no TPU tunnel exists,
+# jax.devices() hangs until its own discovery timeout, and a 180 s
+# budget meant every CPU-fallback bench run burned 3 minutes proving the
+# absence of a device. Environments with a slow-to-init real tunnel
+# raise FAABRIC_BENCH_PROBE_TIMEOUT instead.
+_PROBE_BUDGET = int(os.environ.get("FAABRIC_BENCH_PROBE_TIMEOUT", "45"))
 _SECTION_BUDGETS = {
-    "probe": 180, "pallas_compile": 150, "step_tiny": 180,
+    "probe": _PROBE_BUDGET, "pallas_compile": 150, "step_tiny": 180,
     "allreduce_small": 120, "attention_tiny": 150, "attention_full": 240,
     "step": 300, "step_reference": 240, "step_large": 300,
     "allreduce_big": 240, "hbm": 120, "device_snapshot": 120,

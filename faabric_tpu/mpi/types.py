@@ -72,12 +72,23 @@ def np_dtype_for(dtype: MpiDataType) -> np.dtype:
     return _NP_DTYPES[dtype]
 
 
+# Reverse lookup: first writer wins, so aliased entries (INT32/INT, …)
+# resolve to the canonical MPI code — the same answer the original
+# linear scan produced, minus the per-message scan cost
+_MPI_FOR_NP: dict[np.dtype, MpiDataType] = {}
+for _mpi_t, _np_t in _NP_DTYPES.items():
+    _MPI_FOR_NP.setdefault(_np_t, MpiDataType(_mpi_t))
+
+
 def mpi_dtype_for(np_dtype: np.dtype) -> MpiDataType:
-    np_dtype = np.dtype(np_dtype)
-    for mpi_t, np_t in _NP_DTYPES.items():
-        if np_t == np_dtype:
-            return MpiDataType(mpi_t)
-    raise ValueError(f"No MPI datatype for numpy {np_dtype}")
+    try:
+        return _MPI_FOR_NP[np_dtype]
+    except (KeyError, TypeError):
+        pass
+    mpi_t = _MPI_FOR_NP.get(np.dtype(np_dtype))
+    if mpi_t is None:
+        raise ValueError(f"No MPI datatype for numpy {np_dtype}")
+    return mpi_t
 
 
 class MpiOp(enum.IntEnum):

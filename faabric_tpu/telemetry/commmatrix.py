@@ -102,6 +102,12 @@ class CommMatrix:
         # (src_label, dst_label, plane) → _Cell; cell creation takes the
         # registry lock, updates take only the cell's own
         self._cells: dict[tuple, _Cell] = {}
+        # Raw (src, dst, plane) → _Cell fast path: chunk-pipelined
+        # collectives record one row per 4 MiB frame, so the per-record
+        # cost must be one dict hit + one cell add, not two label
+        # conversions. Only in-range ranks are cached (the `other`
+        # bucket's raw key space is unbounded).
+        self._fast: dict[tuple, _Cell] = {}
 
     def _rank_label(self, rank) -> str:
         try:
@@ -112,11 +118,14 @@ class CommMatrix:
 
     def record(self, src, dst, plane: str, nbytes: int,
                seconds: float | None = None) -> None:
-        key = (self._rank_label(src), self._rank_label(dst), plane)
-        cell = self._cells.get(key)
+        raw = (src, dst, plane)
+        cell = self._fast.get(raw)
         if cell is None:
+            labels = (self._rank_label(src), self._rank_label(dst), plane)
             with self._lock:
-                cell = self._cells.setdefault(key, _Cell())
+                cell = self._cells.setdefault(labels, _Cell())
+                if labels[0] is not OTHER and labels[1] is not OTHER:
+                    self._fast[raw] = cell
         cell.add(int(nbytes), seconds)
 
     # -- export ---------------------------------------------------------
@@ -147,6 +156,7 @@ class CommMatrix:
     def reset(self) -> None:
         with self._lock:
             self._cells.clear()
+            self._fast.clear()
 
 
 def families_from_cells(cells: list[dict]) -> dict:

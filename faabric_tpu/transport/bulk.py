@@ -4,22 +4,44 @@ Reference analog: the raw-TCP MPI data plane with OpenMPI-tuned sockets —
 16 MiB send/recv buffers, TCP_NODELAY
 (include/faabric/transport/tcp/Socket.h:75-78,
 src/transport/tcp/SocketOptions.cpp). There every remote rank pair gets a
-socket; here one tuned connection per (sender-host → receiver-host) pair
-carries all groups' large payloads, framed with the PTP routing header, and
-delivers straight into the receiving broker's queues. Small messages keep
-riding the shared RPC plane (connection setup + framing dominates them);
-payloads ≥ ``BULK_THRESHOLD`` switch to this plane.
+socket; here each (sender-host → receiver-host) pair carries all groups'
+large payloads over a small set of STRIPED tuned connections, framed with
+the PTP routing header, and delivers straight into the receiving broker's
+queues.
+
+Striping (ISSUE 5): one connection per peer serialized every sender
+behind a single lock — with two rank threads streaming 4 MiB pipeline
+chunks, half of every collective's wall time was spent queued behind the
+peer's in-flight frame (the bench attribution's ``enqueue_wait``). A
+client now holds one CONTROL stripe (frames under ``BULK_THRESHOLD`` and
+unsequenced frames, whose per-stream FIFO must survive without sequence
+numbers) plus ``BULK_STRIPES`` DATA stripes that large sequenced frames
+round-robin across. Each stripe is its own socket + its own lock + its
+own shm ring, so concurrent senders proceed in parallel and a large
+segment never parks a small control frame behind it. Cross-stripe
+reordering of one stream's frames is healed by the receiver's
+sequence-numbered out-of-order buffer — the same machinery that already
+merges the bulk and RPC planes.
 
 Throughput notes (why this beats the RPC plane at 100 MiB scale):
-- ``socket.sendall``/``recv_into`` release the GIL for the whole transfer;
+- frames go out as ONE vectored ``sendmsg`` (header + payload views
+  gathered by the kernel — no join, no extra syscall per buffer);
 - the receive path reads the payload directly into one preallocated
-  ``bytearray`` (no per-chunk bytes objects, no join);
-- a sender passes ``memoryview`` slices end-to-end — no reframing copy;
+  buffer (``recv_into``, no per-chunk bytes objects);
+- a sender passes ``memoryview``s end-to-end — no reframing copy;
 - 16 MiB kernel buffers keep the pipe full on high-BDP links.
+
+Same-machine peers skip TCP entirely: each stripe announces a /dev/shm
+ring (transport/shm.py) over its connection and pushes frames as one
+memcpy in, one out. With a live ring, even sub-threshold DATA-channel
+frames ride it (the broker routes them here — see
+PointToPointBroker._send_remote), which removes the RPC plane's
+per-message framing cost from same-host cross-process streams.
 
 Ordering: bulk messages carry the same per-(group, send, recv, channel)
 sequence numbers the RPC plane stamps, and land in the same broker queues
-— the ordered receive path's out-of-order buffer merges the two planes.
+— the ordered receive path's out-of-order buffer merges planes and
+stripes alike.
 """
 
 from __future__ import annotations
@@ -95,12 +117,27 @@ _FAULTS = faults_enabled()
 _FP_BULK = fault_point("transport.bulk")
 
 BULK_PORT = 8014
-# Below this the RPC plane wins (no extra connection, lower latency)
+# Below this the RPC plane wins (no extra connection, lower latency) —
+# unless the peer is same-machine with a live shm ring, where the broker
+# routes ALL data-channel sizes here (a ring push beats RPC framing even
+# for a 32-byte frame).
 BULK_THRESHOLD = 256 * 1024
 # Sanity ceiling per frame: legit traffic is chunk-pipelined well below
 # this, so anything bigger is a desynced/garbage stream — and the bound
 # must be small enough that np.empty(nbytes) can never OOM the host
 MAX_FRAME_BYTES = 1 << 30
+
+# Data stripes per peer (the control stripe is extra). 0 = legacy single
+# connection carrying everything. The default scales with the machine:
+# each stripe adds a sender lock + a server drain thread, and on a
+# 2-core host the extra threads cost more in scheduler thrash than the
+# parallel sockets return (measured: 1 data stripe beats 2 by ~35% on
+# the cross-process allreduce there, while 8+-core hosts want several).
+BULK_STRIPES = max(0, int(os.environ.get(
+    "BULK_STRIPES", str(max(1, min(4, (os.cpu_count() or 2) // 2))))))
+# The control stripe's ring only carries sub-threshold frames: a small
+# ring keeps /dev/shm use bounded while still holding ~16 frames
+CTRL_RING_BYTES = 4 * (1 << 20)
 
 # group_hi, group_lo (group ids are 128-bit GIDs), send_idx, recv_idx,
 # channel, seq, nbytes
@@ -128,9 +165,32 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
         view = view[n:]
 
 
+def _sendmsg_all(sock: socket.socket, bufs: list) -> None:
+    """Vectored gather-send: the whole frame (header + payload views) in
+    one syscall in the common case, looping only on partial writes."""
+    views = [b if isinstance(b, memoryview) else memoryview(b)
+             for b in bufs]
+    remaining = sum(len(v) for v in views)
+    while True:
+        sent = sock.sendmsg(views)
+        remaining -= sent
+        if remaining <= 0:
+            return
+        # Drop fully-written buffers, slice the partially-written one
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
 class BulkServer:
     """Accepts bulk connections for one broker (one logical host) and
-    delivers frames into its queues."""
+    delivers frames into its queues. Every striped client connection gets
+    its own handler thread; every announced shm ring its own drain thread
+    — the receive side scales with the stripes by construction."""
 
     def __init__(self, broker, port_offset: int = 0) -> None:
         self.broker = broker
@@ -195,9 +255,12 @@ class BulkServer:
         except OSError:
             peer_ip = ""
         try:
+            # One preallocated header buffer per connection: every frame's
+            # fixed part lands here via recv_into, no per-frame bytes
             head = bytearray(_FRAME.size)
+            head_view = memoryview(head)
             while True:
-                _recv_exact_into(conn, memoryview(head))
+                _recv_exact_into(conn, head_view[:])
                 (group_hi, group_lo, send_idx, recv_idx, channel, seq,
                  nbytes) = _FRAME.unpack(head)
                 group_id = (group_hi << 64) | group_lo
@@ -249,7 +312,12 @@ class BulkServer:
                 _BULK_RX_FRAMES["tcp"].inc()
                 _BULK_RX_BYTES["tcp"].inc(nbytes)
                 # Deliver the array itself: it is exclusively owned by
-                # this frame, so the MPI unpack can wrap it without a copy
+                # this frame, so the MPI unpack can wrap it without a
+                # copy. Sub-threshold frames (the shm fast path for
+                # small same-machine messages) deliver as bytes — the
+                # type every small-message consumer saw on the RPC plane
+                if nbytes < BULK_THRESHOLD:
+                    payload = payload.tobytes()
                 self.broker.deliver(group_id, send_idx, recv_idx,
                                     payload, seq, channel)
         except (ConnectionError, OSError):
@@ -291,35 +359,100 @@ class BulkServer:
         t.start()
         return t
 
+    # Drain batch scratch: sized so every sub-threshold frame fits but a
+    # large zero-copy frame never lands in it (those take the exact-size
+    # owned-array path below)
+    BATCH_BUF_BYTES = BULK_THRESHOLD + _FRAME.size + 64
+    BATCH_MAX_FRAMES = 64
+
     def _ring_drain_loop(self, ring, stop: threading.Event) -> None:
         """Pop frames (inner bulk header + payload as one ring frame)
         and deliver; blocks in the kernel (shared futex, woken by the
-        producer's pushes) when idle."""
+        producer's pushes) when idle. Bursts of small frames drain
+        BATCHED: one native pop + one queue wakeup per batch instead of
+        per frame (the reusable scratch is safe because sub-threshold
+        payloads are copied out as bytes anyway)."""
+        import ctypes as _ct
+
+        scratch = np.empty(self.BATCH_BUF_BYTES, np.uint8)
+        lens = (_ct.c_uint64 * self.BATCH_MAX_FRAMES)()
         try:
             while True:
-                frame = ring.try_pop()
-                if frame is None:
-                    if stop.is_set():
-                        return  # producer gone AND ring drained
-                    ring.wait_data(20_000)
+                n = ring.pop_batch(scratch, lens, self.BATCH_MAX_FRAMES)
+                if n == 0:
+                    # Empty, or the next frame is a large one that
+                    # cannot ride the scratch: take it exact-size (the
+                    # receiver owns that array zero-copy)
+                    frame = ring.try_pop()
+                    if frame is None:
+                        if stop.is_set():
+                            return  # producer gone AND ring drained
+                        ring.wait_data(20_000)
+                        continue
+                    if not self._deliver_ring_frame(ring, frame):
+                        return
                     continue
-                (group_hi, group_lo, send_idx, recv_idx, channel, seq,
-                 nbytes) = _FRAME.unpack_from(frame)
-                payload = frame[_FRAME.size:]
-                if nbytes != len(payload):
-                    logger.warning("Desynced shm ring %s; abandoning",
-                                   ring.name)
-                    return
-                _BULK_RX_FRAMES["shm"].inc()
-                _BULK_RX_BYTES["shm"].inc(nbytes)
-                self.broker.deliver((group_hi << 64) | group_lo, send_idx,
-                                    recv_idx, payload, seq, channel)
+                off = 0
+                key = None
+                pending: list = []
+                for i in range(n):
+                    ln = int(lens[i])
+                    frame = scratch[off:off + ln]
+                    off += ln
+                    (group_hi, group_lo, send_idx, recv_idx, channel,
+                     seq, nbytes) = _FRAME.unpack_from(frame)
+                    payload = frame[_FRAME.size:ln]
+                    if nbytes != len(payload):
+                        # Already-popped valid frames precede this one:
+                        # deliver them before abandoning, or their seqs
+                        # vanish and the ordered path gets an unhealable
+                        # gap for streams that arrived intact
+                        if pending:
+                            self.broker.deliver_many(
+                                key[0], key[1], key[2], pending, key[3])
+                        logger.warning("Desynced shm ring %s; abandoning",
+                                       ring.name)
+                        return
+                    _BULK_RX_FRAMES["shm"].inc()
+                    _BULK_RX_BYTES["shm"].inc(nbytes)
+                    data = (payload.tobytes() if nbytes < BULK_THRESHOLD
+                            else payload.copy())
+                    fkey = ((group_hi << 64) | group_lo, send_idx,
+                            recv_idx, channel)
+                    if fkey != key:
+                        if pending:
+                            self.broker.deliver_many(
+                                key[0], key[1], key[2], pending, key[3])
+                        key, pending = fkey, []
+                    pending.append((seq, data))
+                if pending:
+                    self.broker.deliver_many(key[0], key[1], key[2],
+                                             pending, key[3])
         except Exception:  # noqa: BLE001 — one bad ring, not the server
             logger.exception("Shm ring drain failed")
         finally:
             ring.close(unlink=True)  # single-use name; clean /dev/shm
             with self._lock:
                 self._attached_rings.discard(ring.name)
+
+    def _deliver_ring_frame(self, ring, frame) -> bool:
+        """Deliver one exact-size popped frame; False on a desynced
+        stream (the drain abandons the ring)."""
+        (group_hi, group_lo, send_idx, recv_idx, channel, seq,
+         nbytes) = _FRAME.unpack_from(frame)
+        payload = frame[_FRAME.size:]
+        if nbytes != len(payload):
+            logger.warning("Desynced shm ring %s; abandoning", ring.name)
+            return False
+        _BULK_RX_FRAMES["shm"].inc()
+        _BULK_RX_BYTES["shm"].inc(nbytes)
+        # Same small-frame contract as the TCP path: bytes below the
+        # threshold, zero-copy owned arrays above it
+        if nbytes < BULK_THRESHOLD:
+            payload = payload.tobytes()
+        self.broker.deliver((group_hi << 64) | group_lo, send_idx,
+                            recv_idx, payload, seq, channel)
+        return True
 
     def stop(self) -> None:
         self._stopping = True
@@ -358,54 +491,73 @@ def _is_local_ip(ip: str) -> bool:
     return is_local_ip(ip)
 
 
-class BulkClient:
-    """One tuned connection to a destination host's BulkServer; sends are
-    serialized per client (frames must not interleave).
+class _Stripe:
+    """One striped connection to the destination host's BulkServer: its
+    own tuned socket, its own lock, its own optional shm ring. Sends on
+    ONE stripe are serialized (frames must not interleave on a stream);
+    sends on different stripes proceed concurrently."""
 
-    When the destination resolves to THIS machine, payloads switch to a
-    shared-memory ring (transport/shm.py — one memcpy in, one out, no
-    TCP stack): the client creates the ring, announces it over the TCP
-    connection, and keeps TCP for frames too large for the ring and as
-    the liveness signal. Ring capacity: SHM_RING_BYTES (default 32 MiB,
-    power of two); SHM_BULK=0 disables."""
+    __slots__ = ("host", "tag", "ring_bytes", "sock", "ring",
+                 "ring_refused", "lock", "shm_frames")
 
-    def __init__(self, host: str) -> None:
+    def __init__(self, host: str, idx: int, ring_bytes: int) -> None:
         self.host = host
-        self._sock: socket.socket | None = None
-        self._lock = threading.Lock()
-        self._ring = None
-        self._ring_refused = False
+        self.tag = f"{host}-s{idx}"
+        self.ring_bytes = ring_bytes
+        self.sock: socket.socket | None = None
+        self.ring = None
+        # ring_bytes <= 0 means rings are disabled by configuration:
+        # pre-refusing lets small_frames_ok()'s lock-free fast path
+        # cache the verdict instead of re-probing per message
+        self.ring_refused = ring_bytes <= 0
+        self.lock = threading.Lock()
         self.shm_frames = 0  # observability: frames that rode the ring
 
+    # -- connection management (caller holds self.lock) -----------------
     def _dial(self) -> socket.socket:
         from faabric_tpu.util.network import safe_create_connection
 
         ip, port = resolve_host(self.host, BULK_PORT)
         s = safe_create_connection((ip, port),
                                    timeout=DEFAULT_SOCKET_TIMEOUT)
-        _tune(s)
-        s.settimeout(None)
-        self._maybe_announce_ring(s, ip)
+        try:
+            _tune(s)
+            s.settimeout(None)
+            self._maybe_announce_ring(s, ip)
+        except BaseException:
+            # A failed announce (peer died mid-handshake) must not leak
+            # the just-dialed socket; the caller sees the dial fail
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
         return s
 
     def _maybe_announce_ring(self, sock: socket.socket, ip: str) -> None:
         from faabric_tpu.transport import shm
 
-        if self._ring_refused or not _is_local_ip(ip) \
-                or not shm.shm_available():
+        if self.ring_refused or self.ring_bytes <= 0 \
+                or not _is_local_ip(ip) or not shm.shm_available():
             return
         try:
-            cap = int(os.environ.get("SHM_RING_BYTES",
-                                     shm.DEFAULT_RING_BYTES))
-            ring = shm.ShmRing.create(self.host, cap)
+            ring = shm.ShmRing.create(self.tag, self.ring_bytes)
         except (OSError, ValueError, RuntimeError) as e:
             logger.warning("Shm ring setup for %s failed (%s); "
-                           "staying on TCP", self.host, e)
-            self._ring_refused = True
+                           "staying on TCP", self.tag, e)
+            self.ring_refused = True
             return
         name = ring.name.encode()
-        sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(name), SHM_ANNOUNCE)
-                     + name)
+        try:
+            sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(name),
+                                     SHM_ANNOUNCE) + name)
+        except OSError:
+            # Peer gone before the announce landed: unlink the fresh
+            # /dev/shm segment NOW — our pid stays alive, so the
+            # stale-ring GC (creator-pid based) would never sweep it,
+            # and each 30 s bulk retry would leak another ring
+            ring.close(unlink=True)
+            raise
         # Wait for the server's attach ACK: only an acked ring carries
         # frames (an unattached ring would swallow them silently)
         try:
@@ -416,10 +568,10 @@ class BulkClient:
         finally:
             sock.settimeout(None)
         if ack == b"\x01":
-            self._ring = ring
+            self.ring = ring
         else:
             logger.warning("Bulk server did not ack shm ring for %s; "
-                           "staying on TCP", self.host)
+                           "staying on TCP", self.tag)
             # If the ACK was merely lost/late, a drain may exist: retire
             # it so it never idles forever on an abandoned ring
             try:
@@ -427,28 +579,40 @@ class BulkClient:
             except OSError:
                 pass
             ring.close(unlink=True)
-            self._ring_refused = True
+            self.ring_refused = True
 
-    def send(self, group_id: int, send_idx: int, recv_idx: int,
-             bufs, seq: int, channel: int) -> None:
-        """``bufs``: list of bytes-like buffers forming one frame payload —
-        sent scatter-gather style straight from the caller's memory."""
-        views = [memoryview(b).cast("B") if not isinstance(b, memoryview)
-                 else b.cast("B") for b in bufs]
-        nbytes = sum(len(v) for v in views)
-        head = _FRAME.pack((group_id >> 64) & _U64, group_id & _U64,
-                           send_idx, recv_idx, channel, seq, nbytes)
-        with self._lock:
-            if self._sock is None:
-                self._sock = self._dial()
-            ring = self._ring
+    def ensure_connected(self) -> None:
+        """Dial (and announce the ring) without sending a frame — used by
+        the broker to decide whether sub-threshold frames should route
+        here at all."""
+        with self.lock:
+            if self.sock is None:
+                self.sock = self._dial()
+
+    # -- the per-frame send path ---------------------------------------
+    def send_frame(self, head: bytes, views: list, nbytes: int,
+                   group_id: int, send_idx: int, recv_idx: int) -> None:
+        """``head`` may be b"" when the caller pre-joined the frame
+        header into views[0] (tiny-frame fast path)."""
+        bufs = [head, *views] if head else views
+        fired = False
+        with self.lock:
+            if self.sock is None:
+                self.sock = self._dial()
+            ring = self.ring
             if ring is not None and nbytes + _FRAME.size + 8 <= ring.capacity:
+                if _FAULTS:
+                    # Chaos choke point, shm flavor: kill_conn raised
+                    # here propagates out as a bulk outage and the
+                    # broker reroutes onto the RPC plane
+                    fired = True
+                    _FP_BULK.fire(dest=self.host, bytes=nbytes)
                 # Inner header + payload as ONE ring frame. A push
                 # timeout means the server-side drain never started or
                 # died (the announce is fire-and-forget): treat it as
                 # ring DEATH and stay on TCP — retrying every send would
                 # stall each one the full timeout while holding the
-                # client lock (ADVICE r3). The first push gets a short
+                # stripe lock (ADVICE r3). The first push gets a short
                 # leash because an unattached ring can never drain.
                 t0 = time.monotonic()
                 # Gate attr construction too: with tracing off, the
@@ -457,8 +621,9 @@ class BulkClient:
                           dest=self.host) if tracing_enabled() \
                         else NULL_SPAN:
                     pushed = ring.push(
-                        [head, *views],
-                        timeout=2.0 if self.shm_frames == 0 else 5.0)
+                        bufs,
+                        timeout=2.0 if self.shm_frames == 0 else 5.0,
+                        nbytes=nbytes + _FRAME.size)
                 if pushed:
                     self.shm_frames += 1
                     _BULK_TX_FRAMES["shm"].inc()
@@ -473,31 +638,30 @@ class BulkClient:
                                        plane="shm", bytes=nbytes)
                     return
                 logger.warning("Shm ring for %s stalled; abandoning ring, "
-                               "staying on TCP", self.host)
+                               "staying on TCP", self.tag)
                 # Tell the server to stop the drain (if it is merely
                 # slow, it finishes the buffered frames first — their
                 # seqs precede this frame's, so ordering holds)
                 try:
-                    self._sock.sendall(
+                    self.sock.sendall(
                         _FRAME.pack(0, 0, 0, 0, 0, 0, SHM_RETIRE))
                 except OSError:
                     pass
                 ring.close(unlink=True)
-                self._ring = None
-                self._ring_refused = True
+                self.ring = None
+                self.ring_refused = True
             t0 = time.monotonic()
             try:
-                if _FAULTS:
-                    # kill_conn rules land in the except below and drive
-                    # the reconnect-and-resend path, exactly like a peer
-                    # that closed the keep-alive connection
+                if _FAULTS and not fired:
+                    # Chaos choke point, TCP flavor: kill_conn rules
+                    # land in the except below and drive the
+                    # reconnect-and-resend path, exactly like a peer
+                    # that closed the keep-alive bulk connection
                     _FP_BULK.fire(dest=self.host, bytes=nbytes)
                 with span("transport.bulk", "tcp_send", bytes=nbytes,
                           dest=self.host) if tracing_enabled() \
                         else NULL_SPAN:
-                    self._sock.sendall(head)
-                    for v in views:
-                        self._sock.sendall(v)
+                    _sendmsg_all(self.sock, bufs)
                 _BULK_TX_FRAMES["tcp"].inc()
                 _BULK_TX_BYTES["tcp"].inc(nbytes)
                 elapsed = time.monotonic() - t0
@@ -528,12 +692,10 @@ class BulkClient:
                 # its "unacked message buffers", MpiWorld.cpp:1963-2030,
                 # are the receiver-side irecv-pending queues, which this
                 # framework implements in mpi/world.py's async requests.)
-                self._reset_sock_locked()
+                self._reset_locked()
                 try:
-                    self._sock = self._dial()
-                    self._sock.sendall(head)
-                    for v in views:
-                        self._sock.sendall(v)
+                    self.sock = self._dial()
+                    _sendmsg_all(self.sock, bufs)
                     _BULK_RECONNECTS.inc()
                     _BULK_TX_FRAMES["tcp"].inc()
                     _BULK_TX_BYTES["tcp"].inc(nbytes)
@@ -549,22 +711,145 @@ class BulkClient:
                     # A half-written frame must never linger on a kept
                     # socket — the receiver would splice the NEXT frame
                     # into this one's missing tail
-                    self._reset_sock_locked()
+                    self._reset_locked()
                     raise
 
-    def _reset_sock_locked(self) -> None:
-        if self._sock is not None:
+    def _reset_locked(self) -> None:
+        if self.sock is not None:
             try:
-                self._sock.close()
+                self.sock.close()
             except OSError:
                 pass
-            self._sock = None
-        if self._ring is not None:
+            self.sock = None
+        if self.ring is not None:
             # The ring rides the connection: the server's drain stops
             # with the old conn, so a redial re-announces a fresh ring
-            self._ring.close(unlink=True)
-            self._ring = None
+            self.ring.close(unlink=True)
+            self.ring = None
+
+    def close(self) -> None:
+        with self.lock:
+            self._reset_locked()
+
+
+class BulkClient:
+    """Striped connections to a destination host's BulkServer.
+
+    Stripe 0 (CONTROL) carries frames under ``BULK_THRESHOLD`` and every
+    unsequenced frame — per-stream FIFO is preserved because one stream's
+    small frames never change stripe. Large sequenced frames round-robin
+    across the DATA stripes (``BULK_STRIPES``, default cpu_count//2
+    clamped to [1, 4]); the receiver's seq-ordered out-of-order buffer
+    restores stream order, exactly as it already does when a stream
+    straddles the RPC and bulk planes.
+
+    When the destination resolves to THIS machine, each stripe switches
+    its payloads to a shared-memory ring (transport/shm.py — one memcpy
+    in, one out, no TCP stack): the stripe creates the ring, announces it
+    over its TCP connection, and keeps TCP for frames too large for the
+    ring and as the liveness signal. ``SHM_RING_BYTES`` (default 32 MiB)
+    is the PER-PEER budget, split evenly across the data stripes
+    (power-of-two each, 1 MiB floor); the control stripe's ring is at
+    most 4 MiB on top. SHM_BULK=0 disables the rings."""
+
+    def __init__(self, host: str) -> None:
+        self.host = host
+        self._lock = threading.Lock()
+        self._stripes: dict[int, _Stripe] = {}
+        self._rr = 0
+
+    def _stripe(self, idx: int) -> _Stripe:
+        with self._lock:
+            s = self._stripes.get(idx)
+            if s is None:
+                from faabric_tpu.transport import shm
+
+                # SHM_RING_BYTES is the PER-PEER budget for the data
+                # stripes: split it across them (rounded down to a
+                # power of two, floor 1 MiB — smaller is useless for
+                # large frames, which then ride TCP via the capacity
+                # check) so striping does not multiply the /dev/shm
+                # footprint — an 8-process same-host world maps O(k²)
+                # of these ring sets. The control ring is small and
+                # never exceeds the budget either.
+                total = int(os.environ.get(
+                    "SHM_RING_BYTES", shm.DEFAULT_RING_BYTES))
+                if total <= 0:
+                    # Ring budget zeroed out: disable the rings but keep
+                    # the tuned bulk TCP path (a broken ring size must
+                    # never read as a whole-plane outage)
+                    ring_bytes = 0
+                else:
+                    if idx == 0 and BULK_STRIPES > 0:
+                        per = min(CTRL_RING_BYTES, total)
+                    else:
+                        per = max(1 << 20,
+                                  total // max(1, BULK_STRIPES))
+                    ring_bytes = 1 << (per.bit_length() - 1)
+                s = _Stripe(self.host, idx, ring_bytes)
+                self._stripes[idx] = s
+            return s
+
+    def _pick(self, nbytes: int, seq: int) -> _Stripe:
+        if BULK_STRIPES == 0 or nbytes < BULK_THRESHOLD or seq < 0:
+            s = self._stripes.get(0)  # lock-free per-message path
+            return s if s is not None else self._stripe(0)
+        # Benign data race on the counter: it only spreads load
+        self._rr = rr = (self._rr + 1) % BULK_STRIPES
+        s = self._stripes.get(1 + rr)
+        return s if s is not None else self._stripe(1 + rr)
+
+    def small_frames_ok(self) -> bool:
+        """True when sub-threshold frames should route here: the control
+        stripe has (or can establish) a live shm ring. Dials on first
+        use; OSErrors propagate so the broker can mark the plane down."""
+        # Lock-free fast path — this runs per small message once the
+        # ring is up, and must cost a dict read + an attribute read
+        s = self._stripes.get(0)
+        if s is not None:
+            if s.ring is not None:
+                return True
+            if s.ring_refused:
+                return False
+        s = self._stripe(0)
+        s.ensure_connected()
+        return s.ring is not None
+
+    # -- observability / test handles -----------------------------------
+    @property
+    def shm_frames(self) -> int:
+        with self._lock:
+            return sum(s.shm_frames for s in self._stripes.values())
+
+    def rings(self) -> list:
+        with self._lock:
+            return [s.ring for s in self._stripes.values()
+                    if s.ring is not None]
+
+    def stripes(self) -> list:
+        with self._lock:
+            return list(self._stripes.values())
+
+    def send(self, group_id: int, send_idx: int, recv_idx: int,
+             bufs, seq: int, channel: int) -> None:
+        """``bufs``: list of bytes-like buffers forming one frame payload —
+        sent scatter-gather style straight from the caller's memory."""
+        views = [memoryview(b).cast("B") if not isinstance(b, memoryview)
+                 else b.cast("B") for b in bufs]
+        nbytes = sum(len(v) for v in views)
+        head = _FRAME.pack((group_id >> 64) & _U64, group_id & _U64,
+                           send_idx, recv_idx, channel, seq, nbytes)
+        if nbytes < 4096:
+            # Pre-join tiny frames: one buffer through the gather paths
+            # (ring pushv / sendmsg) costs less than three pointer
+            # conversions, and the join itself is ~100 ns at this size
+            views = [memoryview(b"".join((head, *views)))]
+            head = b""
+        self._pick(nbytes, seq).send_frame(head, views, nbytes,
+                                           group_id, send_idx, recv_idx)
 
     def close(self) -> None:
         with self._lock:
-            self._reset_sock_locked()
+            stripes, self._stripes = list(self._stripes.values()), {}
+        for s in stripes:
+            s.close()

@@ -114,6 +114,10 @@ class PointToPointBroker:
         self._clients: dict[str, object] = {}
         self._bulk_clients: dict[str, object] = {}
         self._bulk_down_until: dict[str, float] = {}
+        # host → is it THIS machine with shm rings available (the rank→
+        # host map decides the plane: same-machine peers get the shm
+        # fast path even for sub-threshold frames)
+        self._shm_peers: dict[str, bool] = {}
 
         # Fault propagation: groups whose blocked recvs probe the
         # expected sender's liveness (MPI worlds register themselves),
@@ -145,17 +149,35 @@ class PointToPointBroker:
         self.set_up_local_mappings_from_decision(decision)
 
     def _get_flag(self, group_id: int) -> FlagWaiter:
-        # caller holds self._lock or accepts benign double-create
         with self._lock:
-            return self._flags.setdefault(group_id, FlagWaiter())
+            flag = self._flags.get(group_id)
+            if flag is None:
+                # Only construct when absent: this runs per message on
+                # the send/recv hot paths, and a throwaway FlagWaiter
+                # (condvar + event) per call was ~10 µs of garbage
+                flag = self._flags[group_id] = FlagWaiter()
+            return flag
 
     def wait_for_mappings(self, group_id: int,
                           timeout: float | None = None) -> None:
+        # Lock-free fast path: once a group's mappings are installed the
+        # per-message check is one dict read + one attribute read
+        flag = self._flags.get(group_id)
+        if flag is not None and flag.is_set():
+            return
         conf = get_system_config()
         timeout = timeout if timeout is not None else conf.global_message_timeout
         self._get_flag(group_id).wait_on_flag(timeout)
 
     def get_host_for_receiver(self, group_id: int, recv_idx: int) -> str:
+        # Lock-free fast path (GIL-atomic dict reads): this runs twice
+        # per message on the send hot path, and mapping dicts are only
+        # ever replaced/extended under the lock
+        group = self._mappings.get(group_id)
+        if group is not None:
+            m = group.get(recv_idx)
+            if m is not None:
+                return m.host
         with self._lock:
             return self._mappings[group_id][recv_idx].host
 
@@ -194,8 +216,8 @@ class PointToPointBroker:
             self._watched.add(group_id)
 
     def _is_watched(self, group_id: int) -> bool:
-        with self._lock:
-            return group_id in self._watched
+        # GIL-atomic set membership; per-message hot path
+        return group_id in self._watched
 
     def group_aborted(self, group_id: int) -> Optional[str]:
         with self._lock:
@@ -331,18 +353,25 @@ class PointToPointBroker:
 
     def _send_remote(self, group_id: int, send_idx: int, recv_idx: int,
                      data, seq: int, channel: int, dst_host: str) -> None:
-        # Large payloads ride the dedicated bulk plane (tuned sockets,
-        # scatter-gather send straight from the source buffers,
-        # recv_into preallocated buffers — transport/bulk.py); peers
-        # without a bulk server fall back to the RPC plane
+        # Large payloads ride the dedicated bulk plane (striped tuned
+        # sockets, vectored gather-send straight from the source buffers,
+        # recv_into preallocated buffers — transport/bulk.py). Peers that
+        # the rank→host map places on THIS machine get the shm fast path
+        # for data-channel frames of ANY size (a ring push beats RPC
+        # framing even for tiny frames). Peers without a bulk server fall
+        # back to the RPC plane.
         from faabric_tpu.transport.bulk import (
             BULK_THRESHOLD,
             MAX_FRAME_BYTES,
         )
         from faabric_tpu.util.testing import is_mock_mode
 
-        if (BULK_THRESHOLD <= len(data) <= MAX_FRAME_BYTES
-                and not is_mock_mode()
+        nbytes = len(data)
+        use_bulk = BULK_THRESHOLD <= nbytes <= MAX_FRAME_BYTES
+        small_shm = (not use_bulk and nbytes < BULK_THRESHOLD
+                     and channel == DATA_CHANNEL
+                     and self._shm_peer(dst_host))
+        if ((use_bulk or small_shm) and not is_mock_mode()
                 and not self._bulk_down(dst_host)):
             bufs = (data.buffers() if hasattr(data, "buffers")
                     else [data])
@@ -350,9 +379,14 @@ class PointToPointBroker:
                 # The bulk client attributes the send to the comm matrix
                 # itself — it alone knows whether the frame rode the shm
                 # ring or the tuned TCP connection
-                self._get_bulk_client(dst_host).send(
-                    group_id, send_idx, recv_idx, bufs, seq, channel)
-                return
+                client = self._get_bulk_client(dst_host)
+                # Sub-threshold frames only switch plane when the
+                # control stripe's ring is live — over TCP the RPC
+                # plane's latency is as good and it has retry/breaker
+                if use_bulk or client.small_frames_ok():
+                    client.send(group_id, send_idx, recv_idx, bufs, seq,
+                                channel)
+                    return
             except (OSError, ValueError, struct.error) as e:
                 # Remember the outage so chunk streams don't pay a
                 # connect attempt (or timeout) per chunk
@@ -394,6 +428,14 @@ class PointToPointBroker:
         """Enqueue an inbound message (local send or arriving RPC)."""
         self._get_queue((group_id, send_idx, recv_idx, channel)).enqueue(
             (seq, data))
+
+    def deliver_many(self, group_id: int, send_idx: int, recv_idx: int,
+                     items: list, channel: int = DATA_CHANNEL) -> None:
+        """Batched inbound delivery for ONE key: ``items`` is an ordered
+        list of (seq, data). One queue lock + one wakeup round per burst
+        — the bulk drain's fast path for small-frame storms."""
+        self._get_queue(
+            (group_id, send_idx, recv_idx, channel)).enqueue_many(items)
 
     def recv_message(self, group_id: int, send_idx: int, recv_idx: int,
                      must_order: bool = False,
@@ -469,28 +511,29 @@ class PointToPointBroker:
                 return data, seq
 
         # Ordered path: consume in seq order, buffering whatever arrives
-        # early (reference PointToPointBroker.cpp:778-862).
-        nxt = self._scan_next(key, q, timeout)
+        # early (reference PointToPointBroker.cpp:778-862). consume=True
+        # takes the deliverable message in ONE pass — the common
+        # already-in-order case costs two lock acquisitions per message,
+        # not five (this path runs per message of every chunk stream).
+        nxt = self._scan_next(key, q, timeout, consume=True)
         if nxt is None:  # only the non-blocking variant returns None
             raise TimeoutError(f"PTP ordered recv timed out on {key}")
-        kind, payload = nxt
-        with self._lock:
-            if kind == "unseq":
-                return self._unseq[key].popleft(), NO_SEQUENCE_NUM
-            expected = self._recv_seq.get(key, -1) + 1
-            self._recv_seq[key] = expected
-            return self._ooo[key].pop(expected), expected
+        _kind, payload, seq = nxt
+        return payload, seq
 
     def _scan_next(self, key, q, timeout: float | None,
-                   blocking: bool = True):
+                   blocking: bool = True, consume: bool = False):
         """Drain the raw queue until the next DELIVERABLE message for
-        ``key`` is staged, without consuming it: ("seq", data) when the
-        expected sequence number is buffered, ("unseq", data) when an
-        unsequenced message is first in line (kept in a side backlog so
-        probe never corrupts the sequence state), or None when
-        non-blocking and nothing is pending. Duplicates of
-        already-delivered seqs (bulk-plane reconnect resends) are
-        dropped. Shared by ordered recv, probe and iprobe."""
+        ``key`` is staged: ("seq", data) when the expected sequence
+        number is buffered, ("unseq", data) when an unsequenced message
+        is first in line (kept in a side backlog so probe never corrupts
+        the sequence state), or None when non-blocking and nothing is
+        pending. With ``consume=True`` (the ordered-recv hot path) the
+        deliverable message is TAKEN and returned as ("direct", data,
+        seq) — sequence state already advanced, no re-staging round
+        trip. Duplicates of already-delivered seqs (bulk-plane reconnect
+        resends) are dropped. Shared by ordered recv, probe and
+        iprobe."""
         deadline = None if timeout is None else time.monotonic() + timeout
         watched = self._is_watched(key[0])
         check = get_system_config().mpi_abort_check_seconds if watched \
@@ -501,9 +544,15 @@ class PointToPointBroker:
         while True:
             with self._lock:
                 if backlog:
+                    if consume:
+                        return ("direct", backlog.popleft(),
+                                NO_SEQUENCE_NUM)
                     return ("unseq", backlog[0])
                 expected = self._recv_seq.get(key, -1) + 1
                 if expected in buf:
+                    if consume:
+                        self._recv_seq[key] = expected
+                        return ("direct", buf.pop(expected), expected)
                     return ("seq", buf[expected])
             if watched:
                 self._raise_if_aborted(key[0])
@@ -533,9 +582,17 @@ class PointToPointBroker:
                                         self._aborted.get(key[0], ""))
             with self._lock:
                 if seq == NO_SEQUENCE_NUM:
+                    if consume and not backlog:
+                        return ("direct", data, NO_SEQUENCE_NUM)
                     backlog.append(data)
                 elif seq <= self._recv_seq.get(key, -1):
                     pass  # duplicate already delivered: drop
+                elif (consume and not backlog
+                        and seq == self._recv_seq.get(key, -1) + 1):
+                    # The just-dequeued message IS the next in order:
+                    # hand it over without the buffer round trip
+                    self._recv_seq[key] = seq
+                    return ("direct", data, seq)
                 else:
                     buf[seq] = data
 
@@ -561,6 +618,9 @@ class PointToPointBroker:
         return None if nxt is None else nxt[1]
 
     def _get_queue(self, key: tuple[int, int, int, int]) -> Queue:
+        q = self._queues.get(key)  # lock-free per-message path
+        if q is not None:
+            return q
         with self._lock:
             q = self._queues.get(key)
             if q is None:
@@ -624,8 +684,12 @@ class PointToPointBroker:
                     pass
             self._clients.clear()
             self._bulk_clients.clear()
+            self._shm_peers.clear()
 
     def _get_client(self, host: str):
+        client = self._clients.get(host)  # lock-free per-message path
+        if client is not None:
+            return client
         from faabric_tpu.transport.ptp_remote import PointToPointClient
 
         with self._lock:
@@ -634,6 +698,9 @@ class PointToPointBroker:
             return self._clients[host]
 
     def _get_bulk_client(self, host: str):
+        client = self._bulk_clients.get(host)  # lock-free per-message path
+        if client is not None:
+            return client
         from faabric_tpu.transport.bulk import BulkClient
 
         with self._lock:
@@ -645,10 +712,32 @@ class PointToPointBroker:
     # for this long rather than re-dialing per payload/chunk
     BULK_RETRY_SECONDS = 30.0
 
-    def _bulk_down(self, host: str) -> bool:
+    def _shm_peer(self, host: str) -> bool:
+        """True when the rank→host map's ``host`` is this same machine
+        and shm rings are usable — the selection rule for the shm fast
+        path. Cached per host (alias resolution + /dev/shm probe); the
+        cached read is lock-free (GIL-atomic dict get, per-message)."""
+        cached = self._shm_peers.get(host)
+        if cached is not None:
+            return cached
+        from faabric_tpu.transport import shm
+        from faabric_tpu.transport.common import resolve_host
+        from faabric_tpu.util.network import is_local_ip
+
+        try:
+            result = (shm.shm_available()
+                      and is_local_ip(resolve_host(host, 0)[0]))
+        except Exception:  # noqa: BLE001 — unresolvable host: not local
+            result = False
         with self._lock:
-            until = self._bulk_down_until.get(host, 0.0)
-        return time.monotonic() < until
+            self._shm_peers[host] = result
+        return result
+
+    def _bulk_down(self, host: str) -> bool:
+        # GIL-atomic dict read — this runs per message on the send hot
+        # path now that small frames route through the bulk plane
+        until = self._bulk_down_until.get(host, 0.0)
+        return until > 0.0 and time.monotonic() < until
 
     def _mark_bulk_down(self, host: str) -> None:
         with self._lock:

@@ -163,7 +163,13 @@ class PointToPointServer(MessageEndpointServer):
             n_threads=conf.point_to_point_server_threads,
         )
         self.broker = broker
-        # Bulk data plane rides next to the RPC plane (transport/bulk.py)
+        # Bulk data plane rides next to the RPC plane (transport/bulk.py):
+        # striped clients open several connections per peer and each may
+        # announce a shm ring, so the bulk server fields one handler
+        # thread per connection + one drain per ring. Same-machine peers
+        # route even sub-threshold data frames there (see
+        # PointToPointBroker._send_remote); this RPC server keeps the
+        # coordination channel and serves as every plane's fallback.
         from faabric_tpu.transport.bulk import BulkServer
 
         self._bulk_server = BulkServer(broker, port_offset=offset)

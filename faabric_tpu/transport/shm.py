@@ -166,48 +166,73 @@ class ShmRing:
         return cls(name, mm, int(cap), created=False)
 
     # ------------------------------------------------------------------
-    def try_push(self, bufs) -> bool:
-        """One frame gathered from bytes-like segments; False when the
-        ring lacks space (caller waits or falls back). Raises ValueError
-        for frames that can NEVER fit."""
+    def _gather_args(self, bufs):
+        """ctypes (segs, lens) for one gathered frame — built ONCE per
+        push even when the blocking path retries (the conversions, not
+        the native call, dominate small-frame push cost)."""
         arrs = [b if isinstance(b, np.ndarray) and b.dtype == np.uint8
                 and b.ndim == 1 else np.frombuffer(b, np.uint8)
                 for b in bufs]
         n = len(arrs)
         segs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
         lens = (ctypes.c_uint64 * n)(*[a.nbytes for a in arrs])
+        return arrs, segs, lens, n
+
+    def _try_pushv(self, segs, lens, n) -> bool:
         rc = self._lib.ring_try_pushv(self._base, segs, lens, n)
         if rc == -2:
             raise ValueError("frame larger than ring capacity")
         return rc == 0
 
-    def push(self, bufs, timeout: float = 10.0) -> bool:
+    def try_push(self, bufs) -> bool:
+        """One frame gathered from bytes-like segments; False when the
+        ring lacks space (caller waits or falls back). Raises ValueError
+        for frames that can NEVER fit."""
+        _arrs, segs, lens, n = self._gather_args(bufs)
+        return self._try_pushv(segs, lens, n)
+
+    def push(self, bufs, timeout: float = 10.0,
+             nbytes: int | None = None) -> bool:
         """Blocking push; False on timeout (consumer stalled — caller
         falls back to TCP). Waits in the kernel on the ring's shared
-        futex, woken by the consumer's pops — no polling."""
-        if self.try_push(bufs):
-            # Size the frame only when a counter will record it — the
-            # disabled-metrics fast path stays allocation-free
+        futex, woken by the consumer's pops — no polling. Callers that
+        already know the gathered size pass ``nbytes`` so the hot path
+        never re-measures the buffers."""
+        arrs, segs, lens, n = self._gather_args(bufs)
+        if self._try_pushv(segs, lens, n):
             if _RING_TX_BYTES is not NULL_METRIC:
                 _RING_TX_FRAMES.inc()
-                _RING_TX_BYTES.inc(
-                    sum(len(memoryview(b).cast("B")) for b in bufs))
+                _RING_TX_BYTES.inc(sum(lens) if nbytes is None else nbytes)
             return True
-        nbytes = sum(len(memoryview(b).cast("B")) for b in bufs)
-        need = nbytes + 8
+        need = (sum(lens) if nbytes is None else nbytes) + 8
         t0 = time.monotonic()
         deadline = t0 + timeout
         while True:
             self._lib.ring_wait_space(self._base, need, 20_000)
-            if self.try_push(bufs):
+            if self._try_pushv(segs, lens, n):
                 _RING_PUSH_WAIT.observe(time.monotonic() - t0)
                 _RING_TX_FRAMES.inc()
-                _RING_TX_BYTES.inc(nbytes)
+                _RING_TX_BYTES.inc(need - 8)
                 return True
             if time.monotonic() >= deadline:
                 _RING_PUSH_WAIT.observe(time.monotonic() - t0)
                 _RING_PUSH_STALLS.inc()
                 return False
+
+    def pop_batch(self, out: np.ndarray, lens, max_frames: int) -> int:
+        """Pop up to ``max_frames`` consecutive frames into ``out`` (a
+        caller-owned uint8 scratch buffer, reused across calls), writing
+        each payload length into ``lens`` (a ctypes uint64 array). One
+        native call + one futex wake per BATCH — the drain-side fast
+        path for bursts of small frames. Returns the frame count; 0
+        means empty OR the next frame alone exceeds ``out`` (caller
+        falls back to try_pop)."""
+        n = int(self._lib.ring_pop_batch(
+            self._base, out.ctypes.data, out.nbytes, lens, max_frames))
+        if n and _RING_RX_FRAMES is not NULL_METRIC:
+            _RING_RX_FRAMES.inc(n)
+            _RING_RX_BYTES.inc(int(sum(lens[i] for i in range(n))))
+        return n
 
     def wait_data(self, timeout_us: int = 20_000) -> bool:
         """Block (kernel futex) until a frame is likely available; True

@@ -515,9 +515,13 @@ def softpte_available() -> bool:
         except OSError:
             _softpte_probe = False
         if not _softpte_probe:
-            logger.info("Soft-dirty PTEs not functional on this kernel; "
-                        "DIRTY_TRACKING_MODE=softpte falls back to segv/"
-                        "native")
+            # Debug, not info: make_dirty_tracker already warns once per
+            # (mode, fallback) when the ladder actually falls back —
+            # surfacing the probe result here too printed the same
+            # fallback twice back-to-back in every bench/worker log
+            logger.debug("Soft-dirty PTEs not functional on this kernel; "
+                         "DIRTY_TRACKING_MODE=softpte falls back to segv/"
+                         "native")
         return _softpte_probe
 
 

@@ -68,6 +68,12 @@ class FlagWaiter:
         if not self._event.wait(timeout if timeout is not None else self.timeout):
             raise LatchTimeoutException("Timeout waiting on flag")
 
+    def is_set(self) -> bool:
+        """Lock-free fast-path check (Event.is_set is a plain attribute
+        read) — lets per-message hot paths skip the condvar dance once
+        the flag has been raised."""
+        return self._event.is_set()
+
     def set_flag(self, value: bool = True) -> None:
         if value:
             self._event.set()

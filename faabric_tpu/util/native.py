@@ -103,6 +103,11 @@ def _declare_shmring(lib: ctypes.CDLL) -> None:
     lib.ring_pop.restype = ctypes.c_int64
     lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                              ctypes.c_uint64]
+    lib.ring_pop_batch.restype = ctypes.c_int64
+    lib.ring_pop_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.c_uint64]
     lib.ring_wait_data.restype = ctypes.c_int
     lib.ring_wait_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.ring_wait_space.restype = ctypes.c_int

@@ -35,6 +35,13 @@ class Queue(Generic[T]):
             self._items.append(item)
             self._cond.notify()
 
+    def enqueue_many(self, items) -> None:
+        """Append a pre-ordered batch under ONE lock acquisition with one
+        wakeup round — the bulk drain's burst-delivery path."""
+        with self._cond:
+            self._items.extend(items)
+            self._cond.notify(len(items))
+
     def dequeue(self, timeout: float | None = None) -> T:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:

@@ -200,6 +200,38 @@ int64_t ring_pop(void* base, void* out, uint64_t maxlen) {
     return static_cast<int64_t>(len);
 }
 
+// Pop up to max_frames consecutive frames into out (out_len bytes),
+// recording each frame's payload length in lens. Stops before a frame
+// that would overflow out (a batch consumer falls back to ring_pop for
+// oversized frames). The tail advances ONCE for the whole batch — one
+// space-futex wake per batch instead of per frame, which is what makes
+// draining a burst of small frames cheap. Returns the frame count
+// (0 when empty or the next frame alone exceeds out_len).
+int64_t ring_pop_batch(void* base, void* out, uint64_t out_len,
+                       uint64_t* lens, uint64_t max_frames) {
+    RingHdr* h = hdr(base);
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint64_t cap = h->capacity;
+    uint64_t produced = 0;
+    uint64_t written = 0;
+    while (produced < max_frames && head != tail) {
+        uint64_t len;
+        get(base, cap, tail, &len, 8);
+        if (written + len > out_len) break;
+        get(base, cap, tail + 8, static_cast<char*>(out) + written, len);
+        written += len;
+        tail += 8 + len;
+        lens[produced++] = len;
+    }
+    if (produced) {
+        h->tail.store(tail, std::memory_order_release);
+        h->space_seq.fetch_add(1, std::memory_order_release);
+        futex_wake(&h->space_seq);
+    }
+    return static_cast<int64_t>(produced);
+}
+
 // Block (in the kernel) until a frame is likely available or timeout_us
 // elapsed. Returns 0 when data is visible, 1 on timeout/spurious wake —
 // callers loop around try_pop either way.

@@ -62,6 +62,65 @@ def test_gate_tolerates_new_and_missing_keys(tmp_path):
     assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 0
 
 
+def test_gate_fails_when_required_data_plane_key_vanishes(tmp_path,
+                                                          capsys):
+    """host_allreduce_procs_gibs / host_sendrecv_gibs are gated as
+    REQUIRED: once recorded, a round where the key vanishes (the bench
+    section crashed) fails instead of degrading to a note — the silent
+    path around the >20% data-plane regression gate."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.6,
+                  "host_sendrecv_gibs": 1.1})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_sendrecv_gibs": 1.1})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+    out = capsys.readouterr().out
+    assert "host_allreduce_procs_gibs" in out and "MISSING" in out
+
+
+def test_gate_required_key_checked_against_full_history(tmp_path,
+                                                        capsys):
+    """Two consecutive rounds missing a required key must NOT retire
+    the requirement — the gate falls back to the newest historical
+    round that recorded it."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.6,
+                  "host_sendrecv_gibs": 1.1})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_sendrecv_gibs": 1.1})   # crashed section
+    _write_round(tmp_path, "BENCH_r03.json", 0.05,
+                 {"host_sendrecv_gibs": 1.1})   # still missing
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+    out = capsys.readouterr().out
+    assert "host_allreduce_procs_gibs" in out and "MISSING" in out
+
+
+def test_gate_required_key_regression_survives_gap_round(tmp_path):
+    """A round that dropped a required key must not launder a later
+    regression: the recovered round is compared against the newest
+    historical value, not the broken round's absence."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.6,
+                  "host_sendrecv_gibs": 1.1})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_sendrecv_gibs": 1.1})   # crashed section
+    _write_round(tmp_path, "BENCH_r03.json", 0.05,
+                 {"host_allreduce_procs_gibs": 0.5,  # -69% vs r01
+                  "host_sendrecv_gibs": 1.1})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+
+
+def test_gate_data_plane_regression_fails(tmp_path):
+    """>20% drop on either data-plane figure fails the gate."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.6,
+                  "host_sendrecv_gibs": 1.2})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.55,
+                  "host_sendrecv_gibs": 0.9})  # -25%
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+
+
 def test_gate_within_threshold_passes(tmp_path):
     _write_round(tmp_path, "BENCH_r01.json", 0.05,
                  {"host_allreduce_gibs": 1.0})

@@ -27,6 +27,12 @@ import sys
 HIGHER_BETTER = re.compile(r"(_gibs|tokens_per_s|mfu|_speedup)")
 LOWER_BETTER = re.compile(r"(_ms|_ns|_s)$")
 
+# Data-plane headline figures (ISSUE 5): once a round has recorded one
+# of these, a later round missing it is a FAILURE, not a note — the
+# silent way a >20% regression escapes the gate is the bench section
+# crashing and the key simply vanishing from the summary.
+REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs")
+
 
 def find_rounds(repo: str) -> list[str]:
     """BENCH_r*.json paths, oldest → newest (lexicographic on the
@@ -69,9 +75,14 @@ def compare(prev: dict[str, float], cur: dict[str, float],
     for key in sorted(set(prev) | set(cur)):
         p, c = prev.get(key), cur.get(key)
         if p is None or c is None:
-            notes.append(f"{key}: only in "
-                         f"{'current' if p is None else 'previous'} round "
-                         f"({p if c is None else c})")
+            if key in REQUIRED_KEYS and c is None:
+                regressions.append(
+                    f"{key}: previously recorded {p}, MISSING in the "
+                    "current round (data-plane bench section failed?)")
+            else:
+                notes.append(f"{key}: only in "
+                             f"{'current' if p is None else 'previous'} "
+                             f"round ({p if c is None else c})")
             continue
         if p <= 0:
             notes.append(f"{key}: previous value {p} not comparable")
@@ -108,6 +119,21 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     prev_path, cur_path = rounds[-2], rounds[-1]
     prev, cur = load_metrics(prev_path), load_metrics(cur_path)
+    # Required keys are checked against the whole history, not just the
+    # previous round — otherwise one broken round would launder both a
+    # missing key (vanishes from both sides of the next comparison) and
+    # a regression (the recovered round has no previous value to beat).
+    # Backfill the newest historical value whenever the previous round
+    # lacks the key; compare() then flags a MISSING current value or a
+    # >threshold drop as usual.
+    for key in REQUIRED_KEYS:
+        if key in prev:
+            continue
+        for past in reversed(rounds[:-1]):
+            val = load_metrics(past).get(key)
+            if val is not None:
+                prev[key] = val
+                break
     regressions, notes = compare(prev, cur, args.threshold)
 
     print(f"bench_gate: {os.path.basename(prev_path)} -> "
