@@ -26,9 +26,11 @@ from faabric_tpu.faults.registry import (
     clear_faults,
     fault_point,
     faults_enabled,
+    get_fault_identity,
     get_fault_registry,
     install_faults,
     parse_fault_spec,
+    set_fault_identity,
     set_faults_enabled,
 )
 from faabric_tpu.util.retry import CircuitBreaker, RetryPolicy
@@ -47,8 +49,10 @@ __all__ = [
     "clear_faults",
     "fault_point",
     "faults_enabled",
+    "get_fault_identity",
     "get_fault_registry",
     "install_faults",
     "parse_fault_spec",
+    "set_fault_identity",
     "set_faults_enabled",
 ]

@@ -26,6 +26,19 @@ Examples::
     FAABRIC_FAULTS="transport.send=delay:50ms@p=0.1"
     FAABRIC_FAULTS="planner.dispatch=kill_conn@times=1;keepalive=suppress@host=w2"
 
+Host-pair rules (network partitions): every fire() is implicitly
+stamped with ``src=<this process's host identity>`` (set by the worker
+runtime / planner at boot via :func:`set_fault_identity`), so one
+cluster-wide spec can partition a specific DIRECTED pair::
+
+    # drop w0 -> w1 only; w1 -> w0 still flows
+    FAABRIC_FAULTS="transport.send=drop@src=w0@host=w1"
+    # both directions: one rule per direction
+    FAABRIC_FAULTS="transport.send=drop@src=w0@host=w1;transport.send=drop@src=w1@host=w0"
+
+Clearing the rules (``clear_faults``, or a ``times=`` budget running
+out) heals the partition — call sites re-dial on their next attempt.
+
 Determinism: every rule owns a ``random.Random`` seeded from
 ``(FAABRIC_FAULTS_SEED, point, rule index)``, so a given spec + seed
 fires identically run to run regardless of thread interleaving at other
@@ -228,6 +241,11 @@ class FaultPoint:
         rules = self._rules
         if not rules:
             return None
+        # Stamp the firing side's host identity so rules can match a
+        # directed host pair (src=..., host=/dest=...) from ONE spec
+        # shared cluster-wide. Only paid when rules are armed.
+        if _local_identity and "src" not in ctx:
+            ctx["src"] = _local_identity
         for rule in rules:
             if rule.should_fire(ctx):
                 _count_fired(self.name, rule.action)
@@ -300,6 +318,42 @@ class FaultRegistry:
 
 _registry: FaultRegistry | None = None
 _registry_lock = threading.Lock()
+
+# This process's host identity, stamped into every fire() ctx as ``src``
+# so host-pair (partition) rules can match direction. Set at boot by
+# WorkerRuntime / PlannerServer; empty = no stamp (standalone tools).
+_local_identity = ""
+_identity_conflict = False
+
+
+def set_fault_identity(host: str, force: bool = False) -> None:
+    """Record this process's host identity for ``src=`` ctx matching.
+
+    The stamp only makes sense when ONE runtime owns the process (the
+    deployment shape for real partitions). In-process multi-host tests
+    construct several runtimes side by side; the second DIFFERENT
+    identity therefore clears the stamp entirely — a directed rule
+    that silently matched the wrong direction would be worse than one
+    that matches nothing. Tests that want a specific identity (or to
+    reset the conflict latch) pass ``force=True``."""
+    global _local_identity, _identity_conflict
+    if force:
+        _local_identity = host
+        _identity_conflict = False
+        return
+    if _identity_conflict:
+        return
+    if _local_identity and host and host != _local_identity:
+        logger.debug("Multiple fault identities in one process (%s, %s): "
+                     "disabling src= stamping", _local_identity, host)
+        _identity_conflict = True
+        _local_identity = ""
+        return
+    _local_identity = host
+
+
+def get_fault_identity() -> str:
+    return _local_identity
 
 # Boot-time switch: instrumented modules capture this (and their fault
 # point handle) at import, so an unset FAABRIC_FAULTS keeps hot paths at

@@ -41,6 +41,28 @@ except ImportError:  # pragma: no cover — older JAX
 
     _SHARD_MAP_NO_CHECK_KW = "check_rep"
 
+# Whether the installed JAX has the varying-mesh-axes (vma) machinery:
+# shard_map(check_vma=), lax.pcast/pvary. Without it (<= 0.4.x) the
+# older check_rep static-replication inference runs instead — it cannot
+# be helped along by _mark_varying (a no-op there) and is known not to
+# see through scan/vjp-heavy bodies like the pipeline schedules.
+SHARD_MAP_HAS_VMA = _SHARD_MAP_NO_CHECK_KW == "check_vma"
+
+
+def shard_map_compat(fn, mesh=None, in_specs=None, out_specs=None,
+                     check_vma=None):
+    """Version-portable ``shard_map``: ``check_vma`` maps onto whichever
+    check kwarg the installed JAX understands (``check_vma`` on current
+    releases, ``check_rep`` on 0.4.x). ``None`` keeps the library
+    default. Every shard_map in this codebase that passes a check kwarg
+    must go through here — JAX 0.4.37 raises TypeError on a literal
+    ``check_vma=`` (the seed test_ops failure)."""
+    kwargs = {}
+    if check_vma is not None:
+        kwargs[_SHARD_MAP_NO_CHECK_KW] = check_vma
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kwargs)
+
 from faabric_tpu.mpi.types import MpiOp
 
 _PRIMITIVE_REDUCERS = {

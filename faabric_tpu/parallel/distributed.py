@@ -118,6 +118,16 @@ def join_device_plane(spec: DevicePlaneSpec,
         addr = spec.coordinator_address()
         logger.info("Joining device plane: %s as process %d/%d",
                     addr, spec.process_id, spec.num_processes)
+        # Cross-process collectives on the CPU backend need the gloo
+        # implementation opted in BEFORE the backend initialises; newer
+        # JAX defaults to it, 0.4.x raises "Multiprocess computations
+        # aren't implemented on the CPU backend" without it (the seed
+        # device-plane dist failure). Real TPU/GPU backends ignore it.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — unknown config on some versions
+            logger.debug("jax_cpu_collectives_implementation not settable",
+                         exc_info=True)
         kwargs = {}
         if local_device_ids is not None:
             kwargs["local_device_ids"] = list(local_device_ids)

@@ -504,6 +504,18 @@ class PlannerClient(MessageEndpointClient):
             return None
         return SchedulingDecision.from_dict(resp.header["decision"])
 
+    def relay_group_abort(self, group_id: int, reason: str,
+                          hosts: list[str]) -> None:
+        """Ask the planner to deliver a group abort to hosts this
+        process could not reach directly (network partition): the
+        planner↔host links are independent of the partitioned
+        worker-pair link. Fire-and-forget — the relay is best-effort on
+        top of keep-alive expiry."""
+        if is_mock_mode():
+            return
+        self.async_send(int(PlannerCalls.RELAY_GROUP_ABORT), {
+            "group_id": group_id, "reason": reason, "hosts": list(hosts)})
+
     def get_num_migrations(self) -> int:
         resp = self.sync_send(int(PlannerCalls.GET_NUM_MIGRATIONS),
                               idempotent=True)

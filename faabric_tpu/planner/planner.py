@@ -497,6 +497,16 @@ class Planner:
                 decision, mappings, dispatches = self._handle_dist_change(
                     req, decision)
 
+            if thawing:
+                # A thawed app may land anywhere — typically NOT where it
+                # froze (that host was being evicted). single_host=True
+                # would make _do_dispatch skip the THREADS snapshot push
+                # and the executor skip restore(), resuming the app on a
+                # blank memory image. Force the multi-host path so the
+                # planner-parked snapshot travels to the thaw host(s).
+                for _, sub in dispatches:
+                    sub.single_host = False
+
         # Network I/O strictly outside the lock: mappings first (guest code
         # blocks on wait_for_mappings before messaging), then dispatch.
         with self._lock:

@@ -7,6 +7,7 @@ include/faabric/planner/PlannerApi.h:207-224.
 from __future__ import annotations
 
 import enum
+import threading
 
 from faabric_tpu.batch_scheduler.decision import SchedulingDecision
 from faabric_tpu.planner.planner import get_planner
@@ -61,6 +62,13 @@ class PlannerCalls(enum.IntEnum):
     # rejoin — unlike the fire-and-forget async result push, the
     # response confirms delivery so the worker can clear its queue
     FLUSH_RESULTS = 16
+    # Out-of-band group-abort relay (ISSUE 6): a host that aborts an
+    # MPI world but cannot reach some of its peers (network partition —
+    # the direct abort broadcast rides the very link that just died)
+    # hands the planner the unreachable hosts; the planner's links are
+    # independent of the worker-pair link, so the far side learns of
+    # the abort in bounded time instead of waiting out a socket timeout
+    RELAY_GROUP_ABORT = 17
 
 
 class PlannerServer(MessageEndpointServer):
@@ -87,6 +95,9 @@ class PlannerServer(MessageEndpointServer):
         from faabric_tpu.telemetry import set_process_label
 
         set_process_label("planner")
+        from faabric_tpu.faults import set_fault_identity
+
+        set_fault_identity("planner")
         super().start()
         self.snapshot_server.start()
         # Check at quarter-timeout: worst-case detection latency stays
@@ -108,8 +119,44 @@ class PlannerServer(MessageEndpointServer):
         if msg.code == int(PlannerCalls.SET_MESSAGE_RESULT):
             result = messages_from_wire([msg.header["msg"]], msg.payload)[0]
             self.planner.set_message_result(result)
+        elif msg.code == int(PlannerCalls.RELAY_GROUP_ABORT):
+            self._relay_group_abort(int(msg.header["group_id"]),
+                                    str(msg.header.get("reason", "")),
+                                    list(msg.header.get("hosts", [])))
         else:
             logger.warning("Unknown async planner call %d", msg.code)
+
+    @staticmethod
+    def _relay_group_abort(group_id: int, reason: str,
+                           hosts: list) -> None:
+        """Fan the abort out to the hosts the originator could not
+        reach, on a thread per relay batch (network I/O must not hold a
+        server worker hostage to a slow peer)."""
+        from faabric_tpu.telemetry import flight_record
+
+        flight_record("abort_relayed", group=group_id, reason=reason,
+                      n_hosts=len(hosts))
+        logger.warning("Relaying abort of group %d to %s: %s", group_id,
+                       hosts, reason)
+
+        def relay():
+            from faabric_tpu.transport.ptp_remote import PointToPointClient
+
+            for host in hosts:
+                try:
+                    client = PointToPointClient(host)
+                    try:
+                        client.abort_group(group_id,
+                                           f"{reason} (planner relay)")
+                    finally:
+                        client.close()
+                except Exception:  # noqa: BLE001 — a host dead to the
+                    # planner too is handled by keep-alive expiry
+                    logger.debug("Abort relay of group %d to %s failed",
+                                 group_id, host, exc_info=True)
+
+        threading.Thread(target=relay, name=f"abort-relay-{group_id}",
+                         daemon=True).start()
 
     # ------------------------------------------------------------------
     def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:

@@ -41,7 +41,8 @@ def main(argv: list[str] | None = None) -> int:
     p_worker = sub.add_parser("worker")
     p_worker.add_argument("--host", default="",
                           help="this worker's identity (default: primary IP)")
-    p_worker.add_argument("--slots", type=int, default=0)
+    p_worker.add_argument("--slots", type=int, default=None,
+                          help="execution slots (default: one per usable core; 0 = observer host)")
     p_worker.add_argument("--devices", type=int, default=0)
     p_worker.add_argument("--planner-host", default=None)
 

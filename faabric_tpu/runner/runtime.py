@@ -23,7 +23,8 @@ logger = get_logger(__name__)
 
 
 class WorkerRuntime:
-    def __init__(self, host: str = "", slots: int = 0, n_devices: int = 0,
+    def __init__(self, host: str = "", slots: int | None = None,
+                 n_devices: int = 0,
                  factory: Optional[ExecutorFactory] = None,
                  planner_host: str | None = None,
                  device_plane_size: int = 0) -> None:
@@ -34,7 +35,18 @@ class WorkerRuntime:
         from faabric_tpu.telemetry import set_process_label
 
         set_process_label(f"worker-{self.host}")
-        self.slots = slots or conf.get_usable_cores()
+        # Host-pair fault rules (partitions) match fire() ctx on
+        # src=<this host>; the stamp is free while no rules are armed
+        from faabric_tpu.faults import set_fault_identity
+
+        set_fault_identity(self.host)
+        # None = size to the machine. An EXPLICIT slots=0 is an
+        # observer host (test clients, result waiters) and must
+        # register as exactly 0 — the old `slots or usable_cores()`
+        # silently advertised real capacity for them, so the planner
+        # gang-scheduled MPI ranks onto executor-less processes (the
+        # seed live-migration dist failure).
+        self.slots = conf.get_usable_cores() if slots is None else slots
         self.n_devices = n_devices
         # >1: join the multi-process device plane at boot — this worker
         # contributes its local chips to ONE global jax mesh spanning
@@ -54,6 +66,9 @@ class WorkerRuntime:
         from faabric_tpu.transport.ptp_remote import PointToPointServer
 
         self.ptp_broker = PointToPointBroker(self.host)
+        # Out-of-band abort path: aborts that cannot cross a partitioned
+        # worker-pair link relay through the planner's independent links
+        self.ptp_broker.planner_client = self.planner_client
         self.scheduler.ptp_broker = self.ptp_broker
 
         # MPI worlds (reference FaabricMain's MpiWorldRegistry singleton;
@@ -131,9 +146,34 @@ class WorkerRuntime:
                      self.slots, self.n_devices)
 
     def _start_extra_servers(self) -> None:
-        """Hook for PTP/snapshot/state servers as those layers land."""
-        for server in self.extra_servers:
-            server.start()
+        """Hook for PTP/snapshot/state servers as those layers land. A
+        bind failure part-way through must not leak the servers already
+        started — a half-up worker nobody tracks poisons its port range
+        for every later boot on the same aliases."""
+        started = []
+        try:
+            for server in self.extra_servers:
+                server.start()
+                started.append(server)
+        except Exception:
+            # Each stop gets its own guard: one raising must not skip
+            # the rest — a surviving listener is the very leak this
+            # unwind exists to prevent
+            for server in reversed(started):
+                try:
+                    server.stop()
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            try:
+                self.scheduler.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self.function_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._started = False
+            raise
 
     def shutdown(self, remove_host: bool = True) -> None:
         if not self._started:

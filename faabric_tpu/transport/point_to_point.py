@@ -126,6 +126,12 @@ class PointToPointBroker:
         self._aborted: dict[int, str] = {}
         self._peer_ok_until: dict[str, float] = {}
 
+        # Out-of-band abort relay (set by the worker runtime): when the
+        # direct abort broadcast cannot reach a peer — typically because
+        # the abort was CAUSED by a partition of that very link — the
+        # planner relays it over its own (independent) connections
+        self.planner_client = None
+
     # ------------------------------------------------------------------
     # Mappings
     # ------------------------------------------------------------------
@@ -253,10 +259,26 @@ class PointToPointBroker:
         for host in sorted(peer_hosts):
             try:
                 self._get_client(host).abort_group(group_id, reason)
-            except Exception:  # noqa: BLE001 — best-effort; an unreachable
-                # peer's own probes (or its death) end its waits anyway
+            except Exception:  # noqa: BLE001 — best-effort; the planner
+                # relay below covers it
                 logger.debug("Could not propagate abort of group %d to %s",
                              group_id, host)
+        if peer_hosts and self.planner_client is not None:
+            # Belt and braces: relay through the planner for EVERY peer,
+            # not just the ones whose direct send raised. On a real
+            # partition the first async write onto the dead link's warm
+            # connection "succeeds" into the kernel buffer and raises
+            # nothing (the transport/client.py async_send hole), so an
+            # exception-gated relay would miss exactly the case it
+            # exists for. Receiving an abort twice is idempotent, and
+            # aborts are rare — one extra async RPC is cheap.
+            try:
+                self.planner_client.relay_group_abort(
+                    group_id, reason, sorted(peer_hosts))
+            except Exception:  # noqa: BLE001 — planner down too: expiry
+                # and per-peer probes remain the backstop
+                logger.debug("Abort relay of group %d via planner failed",
+                             group_id, exc_info=True)
 
     def _raise_if_aborted(self, group_id: int) -> None:
         with self._lock:

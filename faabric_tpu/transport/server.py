@@ -16,6 +16,7 @@ tests with server-side processing (MessageEndpointServer.h:57-59).
 
 from __future__ import annotations
 
+import errno
 import socket
 import threading
 import time
@@ -114,8 +115,23 @@ class MessageEndpointServer:
         if self._running:
             return
         self._running = True
-        self._async_listener = self._listen(self.async_port)
-        self._sync_listener = self._listen(self.sync_port)
+        try:
+            self._async_listener = self._listen(self.async_port)
+            self._sync_listener = self._listen(self.sync_port)
+        except OSError:
+            # A half-started server must not leak its first listener: a
+            # bind failure on the sync port would otherwise leave the
+            # async port held by a server nobody tracks, poisoning the
+            # port range for every later bind (the EADDRINUSE cascade).
+            self._running = False
+            for listener in (self._async_listener, self._sync_listener):
+                if listener is not None:
+                    try:
+                        listener.close()
+                    except OSError:
+                        pass
+            self._async_listener = self._sync_listener = None
+            raise
         for listener, plane in ((self._async_listener, "async"), (self._sync_listener, "sync")):
             t = threading.Thread(
                 target=self._accept_loop, args=(listener, plane),
@@ -201,11 +217,28 @@ class MessageEndpointServer:
     # Internals
     # ------------------------------------------------------------------
     def _listen(self, port: int) -> socket.socket:
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((self.bind_host, port))
-        s.listen(128)
-        return s
+        # Brief retry on EADDRINUSE: this container's ephemeral range
+        # starts at 16000 — inside the listener plan — so an outgoing
+        # connection from code that doesn't route through
+        # safe_create_connection (urllib, jax's gloo dials) can
+        # transiently squat a listener port. Those connections are
+        # short-lived; a few retries ride them out. A port held by a
+        # real listener still fails fast after the last attempt.
+        last_error: OSError | None = None
+        for attempt in range(5):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind((self.bind_host, port))
+                s.listen(128)
+                return s
+            except OSError as e:
+                s.close()
+                if e.errno != errno.EADDRINUSE:
+                    raise
+                last_error = e
+                time.sleep(0.05 * (attempt + 1))
+        raise last_error  # type: ignore[misc]
 
     def _accept_loop(self, listener: socket.socket, plane: str) -> None:
         while self._running:

@@ -43,8 +43,43 @@ _BASES = [1000, 4000, 7000, 10000, 13000, 16000, 19000]
 _port_iter = itertools.count(random.randrange(len(_BASES)))
 
 
+def _slot_looks_free(base: int) -> bool:
+    """Probe every canonical service port a standard (planner, hostA,
+    hostB) fixture will bind. Two ways a slot goes bad: a leaked
+    listener from a fixture that errored mid-setup, and — observed in
+    this container — an unrelated long-lived process whose OUTGOING
+    connection's ephemeral source port (range starts at 16000, inside
+    the listener plan) lands on a fixture port and holds it for hours.
+    Either way the slot would EADDRINUSE every fixture that cycles onto
+    it — one squatted port cascading into a dozen errors — so skip it."""
+    import socket
+
+    from faabric_tpu.transport import common as tc
+
+    service_ports = range(tc.STATE_ASYNC_PORT, tc.PLANNER_SYNC_PORT + 1)
+    for off in (0, 1000, 2000):
+        for port in service_ports:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                # Bind exactly as the servers do (0.0.0.0): the observed
+                # squatter was an HTTPS connection bound to the eth0
+                # address — a 127.0.0.1 probe sails past it while the
+                # wildcard server bind still collides.
+                s.bind(("0.0.0.0", base + off + port))
+            except OSError:
+                return False
+            finally:
+                s.close()
+    return True
+
+
 def next_port_base() -> int:
-    return _BASES[next(_port_iter) % len(_BASES)]
+    for _ in range(len(_BASES)):
+        base = _BASES[next(_port_iter) % len(_BASES)]
+        if _slot_looks_free(base):
+            return base
+    return base  # every slot busy: let the fixture surface the bind error
 
 
 @pytest.fixture(autouse=True)

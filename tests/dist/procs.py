@@ -736,6 +736,174 @@ class DistExecutor(Executor):
         msg.output_data = f"r{rank}:migrate-ok:{my_host}".encode()
         return int(ReturnValue.SUCCESS)
 
+    def fn_mpi_migrate_traffic(self, msg, req):
+        """ISSUE 6 lifecycle chaos: live migration of an MPI world UNDER
+        TRAFFIC. Same migration protocol as fn_mpi_migrate, but the world
+        streams barrier+all-to-all rounds continuously and every STAYING
+        rank measures the migration pause — from entering the migration
+        point to completing its first post-migration round. Reports
+        ``r<rank>:migrate-traffic-ok:<host>:<pause_ms>`` (pause_ms = -1
+        for the moved rank, whose wall time spans two executions)."""
+        from faabric_tpu.executor.executor import FunctionMigratedException
+        from faabric_tpu.mpi import get_mpi_context
+        from faabric_tpu.proto import BatchExecuteType
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7970
+            msg.mpi_world_size = 3
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        my_host = self.scheduler.host
+        pc = self.scheduler.planner_client
+
+        loops, check = 24, 6
+        migrated_entry = req.type == BatchExecuteType.MIGRATION
+        start = check + 1 if migrated_entry else 0
+        pause_ms = -1.0
+        if migrated_entry:
+            self.scheduler.ptp_broker.post_migration_hook(
+                msg.group_id, msg.group_idx)
+            world.refresh_rank_hosts()
+            # Join the stayers' pause-measurement round (they run it
+            # right after their own post_migration_hook)
+            world.barrier(rank)
+            if not self._all_to_all_round(world, rank, 1000 + check):
+                msg.output_data = f"r{rank}:bad-postmig".encode()
+                return int(ReturnValue.FAILED)
+        for i in range(start, loops):
+            world.barrier(rank)
+            if not self._all_to_all_round(world, rank, i):
+                msg.output_data = f"r{rank}:bad-alltoall@{i}".encode()
+                return int(ReturnValue.FAILED)
+
+            if i == check and not migrated_entry:
+                t_pause = time.monotonic()
+                world.barrier(rank)
+                old_gid = world.group_id
+                if rank == 0:
+                    deadline = time.time() + 20
+                    dec = None
+                    while dec is None and time.time() < deadline:
+                        dec = pc.check_migration(msg.app_id)
+                        if dec is None:
+                            time.sleep(0.25)
+                    flag = np.array([1 if dec is not None else 0], np.int64)
+                    world.broadcast(0, 0, flag)
+                else:
+                    flag = world.broadcast(0, rank, np.zeros(1, np.int64))
+                if int(flag[0]) == 0:
+                    msg.output_data = f"r{rank}:no-migration".encode()
+                    return int(ReturnValue.FAILED)
+                dec = pc.get_scheduling_decision(msg.app_id)
+                deadline = time.time() + 10
+                while (dec is None or dec.group_id == old_gid) \
+                        and time.time() < deadline:
+                    time.sleep(0.1)
+                    dec = pc.get_scheduling_decision(msg.app_id)
+                idx = dec.app_idxs.index(msg.app_idx)
+                target = dec.hosts[idx]
+                world.prepare_migration(rank, dec.group_id)
+                if target != my_host:
+                    raise FunctionMigratedException()
+                self.scheduler.ptp_broker.post_migration_hook(
+                    dec.group_id, dec.group_idxs[idx])
+                world.refresh_rank_hosts()
+                # Pause ends when the rewired world completes a round
+                world.barrier(rank)
+                if not self._all_to_all_round(world, rank, 1000 + i):
+                    msg.output_data = f"r{rank}:bad-postmig".encode()
+                    return int(ReturnValue.FAILED)
+                pause_ms = (time.monotonic() - t_pause) * 1000.0
+
+        world.barrier(rank)
+        msg.output_data = (f"r{rank}:migrate-traffic-ok:{my_host}:"
+                           f"{pause_ms:.0f}").encode()
+        return int(ReturnValue.SUCCESS)
+
+    def fn_spot(self, msg, req):
+        """ISSUE 6 lifecycle chaos: spot freeze → thaw with snapshot
+        restore on a different host. First entry stamps a marker into the
+        executor memory and waits to be frozen (the test evicts this
+        host via the spot policy); on the freeze it parks the live
+        memory image on the PLANNER's snapshot registry and vacates with
+        FunctionFrozenException. The thawed re-entry — wherever the
+        planner placed it — sees the restored marker and reports its
+        host."""
+        from faabric_tpu.executor.executor import FunctionFrozenException
+        from faabric_tpu.snapshot import SnapshotData
+        from faabric_tpu.snapshot.remote import SnapshotClient
+
+        pc = self.scheduler.planner_client
+        # Per-task marker slot: every task of the batch shares this
+        # executor's memory, so a single shared marker would make the
+        # second task mistake the first task's stamp for a thaw restore
+        off = 64 * (1 + msg.group_idx)
+        marker = self.memory[off:off + 8].view(np.int64)
+        if marker[0] == 4242:
+            msg.output_data = f"thawed:{self.scheduler.host}".encode()
+            return int(ReturnValue.SUCCESS)
+        marker[0] = 4242
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                dec = pc.get_scheduling_decision(msg.app_id)
+            except Exception:  # noqa: BLE001 — planner blip: keep waiting
+                dec = object()
+            if dec is None:
+                # Frozen (the app left the in-flight set): park the live
+                # image under the batch's snapshot key so the thaw
+                # dispatch can restore it on ANY host, then vacate
+                snap = SnapshotData(self.memory.tobytes())
+                with self._batch_lock:
+                    try:
+                        SnapshotClient(pc.host).push_snapshot(
+                            req.snapshot_key, snap)
+                    except Exception:  # noqa: BLE001 — report, don't wedge
+                        msg.output_data = b"snapshot-park-failed"
+                        return int(ReturnValue.FAILED)
+                raise FunctionFrozenException()
+            time.sleep(0.1)
+        msg.output_data = b"never-frozen"
+        return int(ReturnValue.FAILED)
+
+    def fn_mpi_partition(self, msg, req):
+        """ISSUE 6 lifecycle chaos: network partition between a host
+        pair. Loops small allreduces; when the fault registry partitions
+        this world's hosts (transport.send/bulk kill_conn with src/dest
+        ctx matchers), the abort machinery must surface MpiWorldAborted
+        in bounded time — reported as ``aborted:<secs>`` like
+        fn_mpi_abort, on a dedicated world id."""
+        from faabric_tpu.mpi import MpiOp, MpiWorldAborted, get_mpi_context
+
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 9200
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        data = np.ones(1024, np.float32)
+        for _ in range(600):
+            t_round = time.monotonic()
+            try:
+                world.allreduce(rank, data, MpiOp.SUM)
+            except MpiWorldAborted:
+                elapsed = time.monotonic() - t_round
+                msg.output_data = f"aborted:{elapsed:.2f}".encode()
+                return int(ReturnValue.SUCCESS)
+            time.sleep(0.05)
+        msg.output_data = b"never-partitioned"
+        return int(ReturnValue.FAILED)
+
     def fn_mpi_alltoall_sleep(self, msg, req):
         """Port of the reference example mpi_alltoall_sleep
         (tests/dist/mpi/examples/mpi_alltoall_sleep.cpp): many

@@ -1,0 +1,297 @@
+"""Lifecycle chaos: PLANNED disruptions as first-class operations.
+
+ISSUE 6 acceptance — where tests/dist/test_chaos.py covers crashes
+(SIGKILL, suppressed keep-alives), this file covers the disruptions an
+operator *schedules*: live migration of an MPI world under traffic,
+spot freeze → thaw with snapshot restore on a different host, elastic
+scale-up/down mid-app, and fault-registry-driven network partitions
+between specific host pairs.
+
+Every test stands up its own ChaosCluster (randomized port offsets);
+all are chaos+slow, mirroring test_chaos.py — tier-1 runs the fast
+in-process lifecycle subsets in tests/unit.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from faabric_tpu.proto import (
+    BatchExecuteType,
+    ReturnValue,
+    batch_exec_factory,
+)
+from tests.dist.test_chaos import ChaosCluster, wait_finished
+
+pytestmark = pytest.mark.chaos
+
+
+def _rest(port, http_type, payload=""):
+    body = json.dumps({"http_type": int(http_type),
+                       "payload": payload}).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.mark.slow
+def test_chaos_live_migration_under_traffic():
+    """A 3-rank MPI world spread over both workers streams
+    barrier+all-to-all rounds; when the blockers drain, the planner
+    consolidates it onto one worker MID-STREAM. Every staying rank's
+    measured pause (prepare_migration → first completed post-migration
+    round) is bounded, no round is lost or corrupted, and the comm
+    matrix recorded the pre-migration cross-host links that the
+    migration then removed."""
+    cluster = ChaosCluster("ckM", n_workers=2, slots=(4, 4))
+    http_port = cluster.base + 3100
+    cluster.env["DIST_HTTP_PORT"] = str(http_port)
+    cluster.start()
+    try:
+        me = cluster.me
+        # Blockers force the world to spread over both workers
+        blockers = []
+        for count in (2, 3):
+            b = batch_exec_factory("dist", "sleep", count)
+            for m in b.messages:
+                m.input_data = b"4.0"
+            me.planner_client.call_functions(b)
+            blockers.append(b)
+
+        req = batch_exec_factory("dist", "mpi_migrate_traffic", 1)
+        req.messages[0].mpi_rank = 0
+        t0 = time.monotonic()
+        me.planner_client.call_functions(req)
+
+        r = me.planner_client.get_message_result(
+            req.app_id, req.messages[0].id, timeout=90.0)
+        assert r.return_value == int(ReturnValue.SUCCESS), r.output_data
+
+        status = wait_finished(me, req.app_id, timeout=45)
+        assert status.expected_num_messages == 3
+        final_hosts, pauses = set(), []
+        for m in status.message_results:
+            assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+            parts = m.output_data.decode().split(":")
+            assert parts[1] == "migrate-traffic-ok", m.output_data
+            final_hosts.add(parts[2])
+            if float(parts[3]) >= 0:  # stayers measured the pause
+                pauses.append(float(parts[3]))
+        # Consolidated onto ONE worker, and the world actually migrated
+        assert len(final_hosts) == 1, final_hosts
+        assert me.planner_client.get_num_migrations() >= 1
+        # Bounded pause: well under the blunt instrument (expiry/socket
+        # timeouts) — re-placement + re-dispatch + group re-sync only
+        assert pauses, "no staying rank measured a migration pause"
+        assert max(pauses) < 10_000, f"migration pause {max(pauses)}ms"
+
+        # The comm matrix kept per-plane truth: the pre-migration world
+        # produced cross-host rank-pair traffic (ptp and/or the bulk
+        # planes); after consolidation those links are gone from the
+        # placement — the matrix is the record they existed
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/commmatrix", timeout=10) as f:
+            matrix = json.loads(f.read())
+        planes = {row["plane"] for row in matrix["total"]}
+        assert planes & {"ptp", "bulk-tcp", "shm"}, matrix["total"][:5]
+        assert sum(row["messages"] for row in matrix["total"]) > 0
+
+        for b in blockers:
+            wait_finished(me, b.app_id, timeout=30)
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
+def test_chaos_spot_freeze_thaw_restores_on_other_host():
+    """Spot eviction of the host running a THREADS app: the guests park
+    the live memory image on the planner and vacate (FROZEN); the thaw
+    — with the evicted host still tainted — lands on the OTHER worker,
+    restores the parked snapshot there, and completes. Measures
+    thaw_to_first_result_s."""
+    import numpy as np
+
+    from faabric_tpu.snapshot import SnapshotData
+
+    cluster = ChaosCluster(
+        "ckS", n_workers=2, slots=(4, 4),
+        extra_env={"BATCH_SCHEDULER_MODE": "spot"})
+    http_port = cluster.base + 3100
+    cluster.env["DIST_HTTP_PORT"] = str(http_port)
+    cluster.start()
+    try:
+        from faabric_tpu.endpoint import HttpMessageType
+
+        me = cluster.me
+        req = batch_exec_factory("dist", "spot", 2)
+        req.type = int(BatchExecuteType.THREADS)
+        for i, m in enumerate(req.messages):
+            m.group_idx = i
+        key = f"dist/spot_{req.app_id}"
+        req.snapshot_key = key
+        me.snapshot_registry.register_snapshot(
+            key, SnapshotData(np.zeros(16384, np.uint8).tobytes()))
+
+        decision = me.planner_client.call_functions(req)
+        exec_hosts = set(decision.hosts)
+        assert len(exec_hosts) == 1, decision.hosts  # bin-packed
+        victim = exec_hosts.pop()
+        other = next(w for w in cluster.workers if w != victim)
+        time.sleep(1.0)  # guests are running and marked their memory
+
+        # Fill the OTHER worker so the eviction has nowhere to move the
+        # app — spot with spare capacity migrates; with none it freezes
+        blockers = batch_exec_factory("dist", "sleep", 4)
+        for m in blockers.messages:
+            m.input_data = b"6"
+        db = me.planner_client.call_functions(blockers)
+        assert set(db.hosts) == {other}, db.hosts
+
+        # Spot-evict the executing host; the migration check returns the
+        # MUST_FREEZE sentinel (None through the client) and, as its
+        # side effect, parks the app
+        _rest(http_port, HttpMessageType.SET_NEXT_EVICTED_VM, victim)
+        me.planner_client.check_migration(req.app_id)
+
+        # The guests observe the freeze, park the snapshot, vacate
+        deadline = time.time() + 20
+        frozen = False
+        while time.time() < deadline:
+            if me.planner_client.get_scheduling_decision(req.app_id) is None:
+                frozen = True
+                break
+            time.sleep(0.2)
+        assert frozen, "app never left the in-flight set after eviction"
+        time.sleep(1.0)  # let the FROZEN vacate + snapshot park land
+
+        # The blockers drain, freeing the other worker for the thaw
+        wait_finished(me, blockers.app_id, timeout=30)
+
+        # Thaw: a NEW request for the app resumes the PARKED batch; the
+        # evicted host is still tainted, so placement must pick the
+        # other worker — and the planner pushes the parked image there
+        thaw = batch_exec_factory("dist", "spot", 1)
+        thaw.app_id = req.app_id
+        t_thaw = time.monotonic()
+        d2 = me.planner_client.call_functions(thaw)
+        assert d2.n_messages == 2, d2.n_messages  # parked batch came back whole
+        assert set(d2.hosts) == {other}, d2.hosts
+
+        first = me.planner_client.get_message_result(
+            req.app_id, d2.message_ids[0], timeout=30.0)
+        thaw_s = time.monotonic() - t_thaw
+        assert first.return_value == int(ReturnValue.SUCCESS), \
+            first.output_data
+        assert first.output_data == f"thawed:{other}".encode(), \
+            first.output_data
+
+        status = wait_finished(me, req.app_id, timeout=30)
+        assert len(status.message_results) == 2
+        for m in status.message_results:
+            assert m.return_value == int(ReturnValue.SUCCESS), m.output_data
+            assert m.output_data == f"thawed:{other}".encode()
+        assert thaw_s < 20, f"thaw to first result took {thaw_s:.1f}s"
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
+def test_chaos_elastic_scale_up_down_mid_app():
+    """Elastic scale mid-app without result loss: a long-running parent
+    holds the app in flight; two elastic fork waves grow onto the main
+    host's free slots, drain (scale-down releases the slots), and grow
+    again — every message of every wave reports exactly once."""
+    cluster = ChaosCluster("ckE", n_workers=2, slots=(4, 4))
+    cluster.start()
+    try:
+        me = cluster.me
+        parent = batch_exec_factory("dist", "sleep", 1)
+        parent.messages[0].input_data = b"12"
+        d = me.planner_client.call_functions(parent)
+        main_host = d.hosts[0]
+
+        wave_sizes = []
+        for wave in range(2):
+            scale = batch_exec_factory("dist", "square", 1)
+            scale.app_id = parent.app_id
+            scale.elastic_scale_hint = True
+            scale.messages[0].input_data = b"7"
+            scale.messages[0].main_host = main_host
+            ds = me.planner_client.call_functions(scale)
+            assert ds.n_messages >= 3, (wave, ds.n_messages)  # grew to fill
+            assert set(ds.hosts) == {main_host}, ds.hosts
+            wave_sizes.append(ds.n_messages)
+            # Scale-down: the wave drains and releases its slots
+            for mid in ds.message_ids:
+                r = me.planner_client.get_message_result(
+                    parent.app_id, mid, timeout=20.0)
+                assert r.return_value == int(ReturnValue.SUCCESS), \
+                    r.output_data
+                assert r.output_data == b"49"
+
+        # Both waves filled the same freed capacity — no slot leak
+        assert wave_sizes[0] == wave_sizes[1], wave_sizes
+
+        status = wait_finished(me, parent.app_id, timeout=40)
+        assert status.expected_num_messages == 1 + sum(wave_sizes)
+        assert len(status.message_results) == 1 + sum(wave_sizes)
+        assert all(m.return_value == int(ReturnValue.SUCCESS)
+                   for m in status.message_results)
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
+def test_chaos_host_pair_partition_heals_bounded():
+    """Fault-registry-driven DIRECTED partition of a specific worker
+    pair (w1→w0 dead on the RPC and bulk planes via src/dest ctx
+    matchers in ONE cluster-wide spec; w0→w1 and every planner link
+    alive): the sending side aborts its MPI world in bounded time, and
+    — because its direct abort broadcast rides the very link that died
+    — the far side can ONLY learn through the planner's out-of-band
+    relay. Every rank reports a bounded abort instead of hanging to the
+    60s socket timeout. partition_heal_s = worst per-rank abort
+    latency."""
+    w0, w1 = "ckNw0", "ckNw1"
+    partition = ";".join([
+        # RPC plane armed from boot: no worker↔worker RPC traffic flows
+        # before the first bulk fallback, and the abort broadcast must
+        # find the link already dead (that's the scenario)
+        f"transport.send=kill_conn@src={w1}@host={w0}@times=400",
+        # Bulk/shm data plane: onset after ~formation + some rounds
+        f"transport.bulk=kill_conn@src={w1}@dest={w0}@after=200@times=400",
+    ])
+    cluster = ChaosCluster(
+        "ckN", n_workers=2, slots=(4, 4),
+        extra_env={"MPI_ABORT_CHECK_SECONDS": "1",
+                   "PLANNER_HOST_TIMEOUT": "30"},
+        worker_env={"FAABRIC_FAULTS": partition}).start()
+    try:
+        me = cluster.me
+        req = batch_exec_factory("dist", "mpi_partition", 1)
+        req.messages[0].mpi_rank = 0
+        t_start = time.monotonic()
+        me.planner_client.call_functions(req)
+
+        status = wait_finished(me, req.app_id, timeout=90)
+        total_s = time.monotonic() - t_start
+        assert status.expected_num_messages == 8
+        aborted = []
+        for m in status.message_results:
+            assert m.return_value == int(ReturnValue.SUCCESS), \
+                (m.mpi_rank, m.output_data)
+            assert m.output_data.startswith(b"aborted:"), m.output_data
+            aborted.append(float(m.output_data.split(b":")[1]))
+        # EVERY rank aborted — including the side whose direct abort
+        # broadcast the partition swallowed (planner relay)
+        assert len(aborted) == 8, aborted
+        # Heal bound: under the 60s socket timeout with margin; the
+        # check interval is 1s and the relay is one RPC hop
+        heal_s = max(aborted)
+        assert heal_s < 20.0, f"partition heal took {heal_s:.1f}s"
+        assert total_s < 75.0, f"batch took {total_s:.1f}s end to end"
+    finally:
+        cluster.stop()

@@ -476,3 +476,248 @@ def test_requeue_skips_messages_with_late_results(monkeypatch):
     # 7 in flight on hA (8 minus the completed one), nothing failed
     assert live.n_messages == 7
     assert set(live.hosts) == {"hA"}
+
+
+# ---------------------------------------------------------------------------
+# Host-pair partition specs (ISSUE 6): directed src/dst ctx matching +
+# heal-on-clear + the planner abort relay for the far side
+# ---------------------------------------------------------------------------
+
+def test_host_pair_rules_match_direction():
+    """One cluster-wide spec partitions a DIRECTED pair: each process's
+    fire() is stamped with its own identity as ``src``, so the w0→w1
+    rule fires only where src resolves to w0 — w1→w0 and planner links
+    are untouched."""
+    from faabric_tpu.faults import set_fault_identity
+
+    rules = parse_fault_spec("transport.send=drop@src=w0@host=w1")
+    pt = FaultPoint("transport.send")
+    pt.set_rules(rules)
+    try:
+        set_fault_identity("w0", force=True)
+        assert pt.fire(host="w1") is DROP          # the partitioned leg
+        assert pt.fire(host="w2") is None          # other peer: flows
+        assert pt.fire(host="planner") is None     # control plane: flows
+        set_fault_identity("w1", force=True)
+        assert pt.fire(host="w0") is None          # reverse direction: flows
+        set_fault_identity("planner", force=True)
+        assert pt.fire(host="w1") is None          # planner→w1: flows
+    finally:
+        set_fault_identity("", force=True)
+
+
+def test_host_pair_rules_both_directions_and_delay():
+    """Two rules make the partition bidirectional; delay rules express a
+    degraded (not severed) pair the same way."""
+    from faabric_tpu.faults import set_fault_identity
+
+    pt = FaultPoint("transport.bulk")
+    pt.set_rules(parse_fault_spec(
+        "transport.bulk=drop@src=w0@dest=w1;"
+        "transport.bulk=drop@src=w1@dest=w0"))
+    try:
+        set_fault_identity("w0", force=True)
+        assert pt.fire(dest="w1") is DROP
+        set_fault_identity("w1", force=True)
+        assert pt.fire(dest="w0") is DROP
+        assert pt.fire(dest="w2") is None
+
+        slow = FaultPoint("transport.send")
+        slow.set_rules(parse_fault_spec(
+            "transport.send=delay:30ms@src=w1@host=w0"))
+        t0 = time.monotonic()
+        assert slow.fire(host="w0") is None        # delayed, not dropped
+        assert time.monotonic() - t0 >= 0.025
+        t0 = time.monotonic()
+        assert slow.fire(host="w2") is None        # unmatched: instant
+        assert time.monotonic() - t0 < 0.02
+    finally:
+        set_fault_identity("", force=True)
+
+
+def test_host_pair_partition_heals_on_clear():
+    """clear_faults() removes the partition rules: the same fire()
+    arrivals flow again (call sites re-dial on their next attempt — the
+    registry holds no sticky state beyond the rules)."""
+    from faabric_tpu.faults import get_fault_registry, set_fault_identity
+
+    install_faults("transport.send=kill_conn@src=w0@host=w1")
+    pt = fault_point("transport.send")
+    try:
+        set_fault_identity("w0", force=True)
+        with pytest.raises(FaultConnectionError):
+            pt.fire(host="w1")
+        clear_faults()
+        assert pt.fire(host="w1") is None          # healed
+        # A times= budget heals the same way without an explicit clear
+        install_faults("transport.send=kill_conn@src=w0@host=w1@times=2")
+        pt2 = fault_point("transport.send")
+        for _ in range(2):
+            with pytest.raises(FaultConnectionError):
+                pt2.fire(host="w1")
+        assert pt2.fire(host="w1") is None         # budget spent: healed
+        assert get_fault_registry().snapshot()[
+            "transport.send"][0]["fired"] == 2
+    finally:
+        set_fault_identity("", force=True)
+
+
+def test_abort_group_relays_via_planner_when_peer_unreachable():
+    """A group abort whose direct broadcast cannot cross the (just
+    partitioned) pair link hands the unreachable hosts to the planner
+    relay — the far side must not wait out the socket timeout."""
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.transport.point_to_point import (
+        GroupAbortedError,
+        PointToPointBroker,
+    )
+
+    broker = PointToPointBroker("w0")
+    decision = SchedulingDecision(1, 99)
+    decision.add_message("w0", 11, 0, 0)
+    decision.add_message("w1", 12, 1, 1)
+    broker.set_up_local_mappings_from_decision(decision)
+    broker.watch_group(99)
+
+    class DeadPeerClient:
+        def abort_group(self, group_id, reason):
+            raise ConnectionRefusedError("partitioned")
+
+    relayed = []
+
+    class FakePlanner:
+        def relay_group_abort(self, group_id, reason, hosts):
+            relayed.append((group_id, reason, list(hosts)))
+
+    broker._clients["w1"] = DeadPeerClient()
+    broker.planner_client = FakePlanner()
+
+    broker.abort_group(99, "pair link down")
+    assert relayed == [(99, "pair link down", ["w1"])]
+    # Local consumers are aborted regardless
+    with pytest.raises(GroupAbortedError):
+        broker.recv_message(99, 1, 0)
+    # Idempotent: a second abort neither re-broadcasts nor re-relays
+    broker.abort_group(99, "again")
+    assert len(relayed) == 1
+
+
+def test_abort_relay_rpc_reaches_far_side_broker():
+    """End-to-end over real sockets: the planner's RELAY_GROUP_ABORT
+    handler delivers the abort into the far-side broker, waking its
+    blocked consumers."""
+    import threading
+
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.planner import PlannerServer, get_planner
+    from faabric_tpu.planner.client import PlannerClient
+    from faabric_tpu.transport.common import register_host_alias
+    from faabric_tpu.transport.point_to_point import (
+        GroupAbortedError,
+        PointToPointBroker,
+    )
+    from faabric_tpu.transport.ptp_remote import PointToPointServer
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("relpl", "127.0.0.1", base)
+    register_host_alias("relw0", "127.0.0.1", base + 1000)
+    get_planner().reset()
+    planner_server = PlannerServer(port_offset=base)
+    planner_server.start()
+    far_broker = PointToPointBroker("relw0")
+    far_server = PointToPointServer(far_broker)
+    far_server.start()
+    client = PlannerClient("relw1", "relpl")
+    try:
+        decision = SchedulingDecision(1, 777)
+        decision.add_message("relw0", 21, 0, 0)
+        decision.add_message("relw1", 22, 1, 1)
+        far_broker.set_up_local_mappings_from_decision(decision)
+        far_broker.watch_group(777)
+
+        got = {}
+
+        def blocked_recv():
+            try:
+                far_broker.recv_message(777, 1, 0, timeout=10.0)
+            except GroupAbortedError as e:
+                got["reason"] = e.reason
+
+        t = threading.Thread(target=blocked_recv)
+        t.start()
+        time.sleep(0.2)
+        # The partitioned side asks the planner to relay
+        client.relay_group_abort(777, "pair link down", ["relw0"])
+        t.join(timeout=5)
+        assert not t.is_alive(), "far-side recv never aborted"
+        assert "pair link down" in got["reason"]
+    finally:
+        client.close()
+        far_server.stop()
+        planner_server.stop()
+        get_planner().reset()
+
+
+# ---------------------------------------------------------------------------
+# First-write-wins under the expiry race (ADVICE r5 low; ISSUE 6
+# satellite): a genuine late result arriving between _fail_messages'
+# check and its synthetic write must never be overwritten
+# ---------------------------------------------------------------------------
+
+def test_synthetic_failure_never_overwrites_genuine_late_result(
+        monkeypatch):
+    from faabric_tpu.proto import ReturnValue
+    from faabric_tpu.util.testing import set_mock_mode
+
+    set_mock_mode(True)
+    planner = _fresh_planner(monkeypatch)
+    planner.register_host("hA", 8)
+    req = _make_batch(2)
+    decision = planner.call_batch(req)
+    victim_id = decision.message_ids[0]
+
+    # The genuine result lands AFTER _fail_messages' under-lock check
+    # but BEFORE its synthetic write — exactly the window the advisory
+    # flagged. Emulate it by landing the genuine result first and then
+    # letting the terminal path run its (stale) check-then-write.
+    genuine = next(m for m in req.messages if m.id == victim_id)
+    import copy
+
+    synthetic = copy.deepcopy(genuine)
+    genuine.return_value = int(ReturnValue.SUCCESS)
+    genuine.output_data = b"slow but alive"
+    planner.set_message_result(genuine)
+
+    synthetic.return_value = int(ReturnValue.FAILED)
+    synthetic.output_data = b"Host expired"
+    planner.set_message_result(synthetic)  # first-write-wins: ignored
+    planner._fail_messages([synthetic], b"Host expired")  # also ignored
+
+    stored = planner.get_message_result(req.app_id, victim_id)
+    assert stored.return_value == int(ReturnValue.SUCCESS)
+    assert stored.output_data == b"slow but alive"
+
+
+def test_conflicting_identities_disable_src_stamping():
+    """Two runtimes in one process (in-process multi-host tests) must
+    not mis-stamp src: the second DIFFERENT identity clears the stamp,
+    so directed rules match nothing instead of the wrong direction."""
+    from faabric_tpu.faults import get_fault_identity, set_fault_identity
+
+    try:
+        set_fault_identity("", force=True)
+        set_fault_identity("w0")
+        set_fault_identity("w0")            # idempotent re-set is fine
+        assert get_fault_identity() == "w0"
+        set_fault_identity("w1")            # conflict: a second runtime
+        assert get_fault_identity() == ""
+        set_fault_identity("w2")            # latched: still ambiguous
+        assert get_fault_identity() == ""
+        pt = FaultPoint("transport.send")
+        pt.set_rules(parse_fault_spec("transport.send=drop@src=w0@host=w1"))
+        assert pt.fire(host="w1") is None   # no stamp → no wrong match
+        set_fault_identity("w0", force=True)
+        assert pt.fire(host="w1") is DROP   # explicit force restores
+    finally:
+        set_fault_identity("", force=True)

@@ -1,0 +1,111 @@
+"""Tier-1 failure gate: "no worse than seed", machine-checked.
+
+    python tools/failure_gate.py --log /tmp/_t1.log \
+        [--baseline tools/tier1_baseline.txt]
+
+Parses a pytest run log, collects every FAILED/ERROR test id from the
+short summary, and diffs against the committed baseline of known
+failures. Exit codes:
+
+- 0: every failing id is in the baseline (and ids the baseline lists
+  that now pass are printed as shrink-the-baseline notes);
+- 1: NEW failures — test ids failing that the baseline does not carry.
+
+The baseline is the seed's standing-failure list; as failures are fixed
+their lines are deleted, ratcheting the floor down. Parametrized ids
+match exactly; a bare module path (collection error) matches any id in
+that module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+# Short-summary lines: "FAILED tests/x.py::test_y[param] - reason" and
+# "ERROR tests/x.py::test_y - reason" (or a bare module on collection
+# errors). The reason suffix is informational and stripped.
+_SUMMARY_RE = re.compile(r"^(FAILED|ERROR)\s+(\S+)")
+
+
+def parse_failures(log_text: str) -> set[str]:
+    """Every FAILED/ERROR test id in a pytest log's short summary."""
+    out: set[str] = set()
+    for line in log_text.splitlines():
+        m = _SUMMARY_RE.match(line.strip())
+        if not m:
+            continue
+        test_id = m.group(2)
+        # Guard against prose accidentally starting with FAILED: a test
+        # id always names a file path
+        if "/" in test_id or test_id.endswith(".py") or "::" in test_id:
+            out.add(test_id)
+    return out
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def _covered(test_id: str, baseline: set[str]) -> bool:
+    if test_id in baseline:
+        return True
+    # A baselined module path (collection error era) covers its tests,
+    # and vice versa: a baselined test id covers the module-level ERROR
+    # pytest reports when that file later fails collection outright.
+    module = test_id.split("::", 1)[0]
+    if module in baseline:
+        return True
+    return any(b.split("::", 1)[0] == test_id for b in baseline)
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parser = argparse.ArgumentParser(prog="failure_gate")
+    parser.add_argument("--log", default="/tmp/_t1.log",
+                        help="pytest run log (tier-1 tee output)")
+    parser.add_argument("--baseline",
+                        default=os.path.join(repo, "tools",
+                                             "tier1_baseline.txt"))
+    args = parser.parse_args(argv)
+
+    try:
+        with open(args.log, errors="replace") as f:
+            failures = parse_failures(f.read())
+    except OSError as e:
+        print(f"failure_gate: cannot read log {args.log}: {e}")
+        return 1
+    baseline = load_baseline(args.baseline)
+
+    new = sorted(t for t in failures if not _covered(t, baseline))
+    fixed = sorted(b for b in baseline if not _covered(b, failures))
+
+    print(f"failure_gate: {len(failures)} failing, baseline carries "
+          f"{len(baseline)} ({os.path.basename(args.baseline)})")
+    for t in fixed:
+        print(f"  fixed: {t} — no longer failing; delete it from the "
+              "baseline to ratchet the floor down")
+    for t in sorted(failures - set(new)):
+        print(f"  known: {t}")
+    for t in new:
+        print(f"  NEW FAILURE: {t}")
+    if new:
+        print(f"failure_gate: FAILED ({len(new)} new failure(s) vs "
+              "baseline)")
+        return 1
+    print("failure_gate: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
