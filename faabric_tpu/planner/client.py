@@ -122,11 +122,19 @@ class KeepAliveThread(PeriodicBackgroundThread):
             # (only the next write errors). Re-deliver the window; the
             # planner's first-write-wins dedups the ones that landed.
             self.client.requeue_recent_results()
-        # Reconnect housekeeping (no-ops while nothing is pending):
-        # deliver results queued during the outage, then re-register
-        # result interest a restarted planner lost with its waiter map
+            # The planner behind the blip may be a restarted one whose
+            # waiter map is gone; one resync round after an outage is
+            # cheap and covers it even when journal replay keeps us
+            # "known" and the boot check races the first tick.
+            self.client._resync_all = True
+        # Reconnect housekeeping: the flush is a no-op check while
+        # nothing is pending; the resync (one sync RPC per covered
+        # wait) only runs while a restart/rejoin signal or a blocked
+        # waiter's lost-push nudge is live — it consumes the signals
+        # itself and keeps them on an RpcError-cut round.
         self.client.flush_pending_results()
-        self.client.resync_result_interest()
+        if self.client._resync_all or self.client._resync_nudged:
+            self.client.resync_result_interest()
 
 
 class PlannerClient(MessageEndpointClient):
@@ -153,6 +161,11 @@ class PlannerClient(MessageEndpointClient):
         self._local_results: dict[int, Message] = {}
         self._local_results_order: list[int] = []
         self._result_events: dict[int, threading.Event] = {}
+        # msg_id → number of threads blocked on that Event: the entry
+        # (and the planner-side interest) unwinds only when the LAST
+        # waiter gives up, never when one of several times out or hits
+        # an RpcError
+        self._result_waiters: dict[int, int] = {}
         # msg_id → app_id for every outstanding wait: a restarted
         # planner lost its waiter map, so after rejoin the keep-alive
         # re-registers this host's interest (resync_result_interest)
@@ -177,6 +190,18 @@ class PlannerClient(MessageEndpointClient):
         # the push did land.
         self._recent_results: list[tuple[float, Message]] = []
         self.planner_down = False
+        # Planner incarnation last seen in a register/keep-alive
+        # response, and what the next resync round owes.
+        # resync_result_interest costs one sync RPC per covered wait,
+        # so it only runs when a signal fires — _resync_all for the
+        # three restart signals (boot change, known:false rejoin,
+        # outage recovery; the whole waiter map died), _resync_nudged
+        # for blocked waiters' lost-push nudges (only those ids are
+        # re-polled, so one long-running wait does not put every other
+        # wait back on the per-tick poll this gating removed).
+        self._planner_boot: str | None = None
+        self._resync_all = False
+        self._resync_nudged: set[int] = set()
 
     MAX_CACHED_RESULTS = 10_000
     # Both outage buffers are bounded by count AND payload bytes — a
@@ -206,6 +231,21 @@ class PlannerClient(MessageEndpointClient):
             "n_devices": n_devices, "overwrite": overwrite,
         }, idempotent=True)
         timeout = float(resp.header.get("host_timeout", 30.0))
+        boot = resp.header.get("boot")
+        if boot is not None:
+            if self._planner_boot is not None and boot != self._planner_boot:
+                # The planner restarted between ticks and its journal
+                # replay re-registered us, so known stays True and no
+                # tick ever failed — but the restart still dropped the
+                # in-memory waiter map and any result write that died
+                # in the old incarnation's socket buffer.
+                logger.warning(
+                    "Planner incarnation changed under %s; re-delivering "
+                    "recent results and re-registering waiter interest",
+                    self.this_host)
+                self.requeue_recent_results()
+                self._resync_all = True
+            self._planner_boot = boot
         if rejoin and not overwrite and not resp.header.get("known", True):
             # Keep-alive found us UNKNOWN to the planner: we expired off
             # the registry (paused past the timeout, partitioned, or the
@@ -226,6 +266,7 @@ class PlannerClient(MessageEndpointClient):
             # buffer) or expired us. Re-deliver the recent result
             # window via the confirmed flush; first-write-wins dedups.
             self.requeue_recent_results()
+            self._resync_all = True
         if start_keep_alive and self._keep_alive is None:
             self._keep_alive = KeepAliveThread(self, slots, n_devices)
             self._keep_alive.start(max(0.5, timeout / 2))
@@ -416,16 +457,25 @@ class PlannerClient(MessageEndpointClient):
                 self._pending_bytes += sum(self._result_cost(m)
                                            for m in batch)
 
-    def resync_result_interest(self) -> None:
-        """Re-register this host's interest in every result still being
-        waited on. A restarted planner replays results but not its
-        waiter map — without this, a worker blocked in
-        get_message_result would hang to its timeout even though the
-        result lands normally."""
+    def resync_result_interest(self) -> bool:
+        """Re-register this host's interest in waited-on results: every
+        outstanding wait when a restart signal set _resync_all (a
+        restarted planner replays results but not its waiter map —
+        without this, a worker blocked in get_message_result would hang
+        to its timeout even though the result lands normally), else
+        just the ids blocked waiters nudged (a suspected lost push must
+        not put every other wait back on a per-tick poll). Returns
+        False when an RpcError cut the round short; _resync_all then
+        stays set for the next tick, and dropped nudges re-fire from
+        their waiters' own intervals."""
         with self._results_lock:
+            full = self._resync_all
+            nudged = self._resync_nudged
+            self._resync_nudged = set()
             pending = [(mid, app) for mid, app in
                        self._result_interest.items()
-                       if mid in self._result_events]
+                       if mid in self._result_events
+                       and (full or mid in nudged)]
         for msg_id, app_id in pending:
             try:
                 resp = self.sync_send(int(PlannerCalls.GET_MESSAGE_RESULT), {
@@ -433,11 +483,15 @@ class PlannerClient(MessageEndpointClient):
                     "host": self.this_host,
                 }, idempotent=True)
             except RpcError:
-                return  # next keep-alive tick retries
+                return False  # next keep-alive tick retries
             if resp.header.get("found"):
                 result = messages_from_wire([resp.header["msg"]],
                                             resp.payload)[0]
                 self.set_message_result_locally(result)
+        if full:
+            with self._results_lock:
+                self._resync_all = False
+        return True
 
     def set_message_result_locally(self, msg: Message) -> None:
         """Resolve a local waiter (called by our FunctionCallServer when the
@@ -450,9 +504,27 @@ class PlannerClient(MessageEndpointClient):
                 oldest = self._local_results_order.pop(0)
                 self._local_results.pop(oldest, None)
             self._result_interest.pop(msg.id, None)
+            self._result_waiters.pop(msg.id, None)
+            self._resync_nudged.discard(msg.id)
             ev = self._result_events.pop(msg.id, None)
             if ev is not None:
                 ev.set()
+
+    def _drop_result_waiter_locked(self, msg_id: int) -> None:
+        """One waiter gave up (RPC failure or timeout). The Event in
+        _result_events is SHARED by every thread waiting on the same
+        msg_id, so the registration only unwinds when the LAST waiter
+        leaves — popping it eagerly would orphan a healthy concurrent
+        wait (its result would land in _local_results with nobody
+        calling ev.set(), and resync would skip the id too)."""
+        n = self._result_waiters.get(msg_id, 1) - 1
+        if n <= 0:
+            self._result_waiters.pop(msg_id, None)
+            self._result_events.pop(msg_id, None)
+            self._result_interest.pop(msg_id, None)
+            self._resync_nudged.discard(msg_id)
+        else:
+            self._result_waiters[msg_id] = n
 
     def get_message_result(self, app_id: int, msg_id: int,
                            timeout: float | None = None) -> Message:
@@ -468,23 +540,64 @@ class PlannerClient(MessageEndpointClient):
                 return cached
             ev = self._result_events.setdefault(msg_id, threading.Event())
             self._result_interest[msg_id] = app_id
+            self._result_waiters[msg_id] = \
+                self._result_waiters.get(msg_id, 0) + 1
 
-        resp = self.sync_send(int(PlannerCalls.GET_MESSAGE_RESULT), {
-            "app_id": app_id, "msg_id": msg_id, "host": self.this_host,
-        }, idempotent=True)
-        if resp.header.get("found"):
-            result = messages_from_wire([resp.header["msg"]], resp.payload)[0]
-            self.set_message_result_locally(result)
-            return result
-
-        if not ev.wait(timeout):
+        try:
+            resp = self.sync_send(int(PlannerCalls.GET_MESSAGE_RESULT), {
+                "app_id": app_id, "msg_id": msg_id, "host": self.this_host,
+            }, idempotent=True)
+            if resp.header.get("found"):
+                result = messages_from_wire([resp.header["msg"]],
+                                            resp.payload)[0]
+                self.set_message_result_locally(result)
+                return result
+        except Exception:
+            # RpcError or a decode failure alike: the caller sees it and
+            # owns the retry — a leaked entry here would otherwise sit
+            # in _result_interest and be re-polled on every resync
+            # round forever.
             with self._results_lock:
-                self._result_events.pop(msg_id, None)
-                self._result_interest.pop(msg_id, None)
-            raise TimeoutError(
-                f"Timed out waiting for result of msg {msg_id} (app {app_id})")
-        with self._results_lock:
-            return self._local_results[msg_id]
+                self._drop_result_waiter_locked(msg_id)
+            raise
+
+        # Wait for the push, nudging the keep-alive thread each interval
+        # as a safety net: the planner pops the waiter set BEFORE its
+        # fire-and-forget push, so a push lost on a dead pooled
+        # connection (first write "succeeds" into the kernel buffer) is
+        # never re-sent — and a healthy planner fires none of the
+        # restart signals that trigger the resync. The waiter itself
+        # never issues the RPC (a hung planner would hold the sync lock
+        # past this caller's deadline and starve the keep-alive tick);
+        # it only nudges its OWN msg_id, and the keep-alive thread's
+        # next resync round re-polls the nudged ids with its own error
+        # handling. Deadline stays exact; a prompt push costs nothing;
+        # the nudge interval doubles each round (lost pushes from a
+        # healthy planner are rare — a long-running app's waits must
+        # not re-create the per-tick poll this gating removed).
+        # Clients with no keep-alive thread get no lost-push recovery,
+        # as before.
+        poll = max(0.1, float(conf.planner_host_timeout) / 2)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                with self._results_lock:
+                    # The result may have landed between the wait
+                    # expiring and this lock: honour it over the timeout
+                    late = self._local_results.get(msg_id)
+                    if late is not None:
+                        return late
+                    self._drop_result_waiter_locked(msg_id)
+                raise TimeoutError(
+                    f"Timed out waiting for result of msg {msg_id} "
+                    f"(app {app_id})")
+            if ev.wait(min(remaining, poll)):
+                with self._results_lock:
+                    return self._local_results[msg_id]
+            with self._results_lock:
+                self._resync_nudged.add(msg_id)
+            poll = min(poll * 2, 240.0)
 
     def get_batch_results(self, app_id: int) -> BatchExecuteRequestStatus:
         resp = self.sync_send(int(PlannerCalls.GET_BATCH_RESULTS),

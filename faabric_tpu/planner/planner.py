@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Optional
 
 from faabric_tpu.batch_scheduler import (
@@ -136,6 +137,12 @@ class PlannerHost:
 
 class Planner:
     def __init__(self) -> None:
+        # Fresh per process incarnation, NEVER journaled: keep-alive
+        # responses carry it so a client can tell "the planner
+        # restarted and journal replay re-registered me (known stays
+        # True, but the in-memory waiter map and any kernel-buffered
+        # result writes died)" apart from an ordinary tick.
+        self.boot_id = uuid.uuid4().hex
         self._lock = threading.RLock()
         # host ip → live scrape thread (collect_telemetry); setdefault/pop
         # on the GIL-atomic dict bound in-flight scrapes to one per host

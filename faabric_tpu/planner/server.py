@@ -176,7 +176,8 @@ class PlannerServer(MessageEndpointServer):
                 h["host"], h["slots"], h.get("n_devices", 0),
                 overwrite=h.get("overwrite", False))
             return handler_response(header={"host_timeout": timeout,
-                                            "known": known})
+                                            "known": known,
+                                            "boot": self.planner.boot_id})
 
         if code == int(PlannerCalls.REMOVE_HOST):
             self.planner.remove_host(h["host"])

@@ -8,6 +8,8 @@ the real SIGKILL-the-planner scenario lives in tests/dist/test_chaos.py.
 
 import json
 import os
+import threading
+import time
 
 import pytest
 
@@ -366,6 +368,184 @@ def test_keepalive_survives_dead_planner():
         assert client.planner_down
     finally:
         client.close()
+
+
+def test_get_message_result_cleans_up_waiter_on_rpc_error():
+    """A failed result fetch must not leak its waiter registration:
+    the stale _result_interest entry would be re-polled on every
+    post-restart resync round for the process lifetime (review
+    hardening, ISSUE 6)."""
+    from faabric_tpu.planner.client import PlannerClient
+    from faabric_tpu.transport.client import RpcError
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("goneplanner", "127.0.0.1", base)
+    client = PlannerClient("w2", planner_host="goneplanner")
+    client.retry.max_attempts = 1
+    try:
+        with pytest.raises(RpcError):
+            client.get_message_result(1, 42, timeout=1.0)
+        assert client._result_events == {}
+        assert client._result_interest == {}
+    finally:
+        client.close()
+
+
+def test_concurrent_waiter_survives_peer_rpc_error():
+    """Two threads can block on the SAME msg_id (e.g. two HTTP result
+    polls); they share one Event. One waiter hitting an RpcError must
+    not unregister the other: the registration refcounts down and only
+    unwinds when the last waiter leaves."""
+    from faabric_tpu.planner import PlannerServer, get_planner
+    from faabric_tpu.planner.client import PlannerClient
+    from faabric_tpu.proto import message_factory
+    from faabric_tpu.transport.client import RpcError
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("pairplanner", "127.0.0.1", base)
+    get_planner().reset()
+    server = PlannerServer(port_offset=base)
+    server.start()
+    client = PlannerClient("w4", planner_host="pairplanner")
+    try:
+        got: dict = {}
+        t = threading.Thread(
+            target=lambda: got.update(
+                msg=client.get_message_result(9, 77, timeout=20)),
+            daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while 77 not in client._result_events and time.time() < deadline:
+            time.sleep(0.02)
+        assert 77 in client._result_events
+
+        real_send = client.sync_send
+        client.sync_send = lambda *a, **k: (_ for _ in ()).throw(
+            RpcError("injected"))
+        try:
+            with pytest.raises(RpcError):
+                client.get_message_result(9, 77, timeout=5)
+        finally:
+            client.sync_send = real_send
+        # The first waiter's registration survives the peer's failure
+        assert 77 in client._result_events
+        assert 77 in client._result_interest
+
+        msg = message_factory("u", "fn")
+        msg.app_id, msg.id = 9, 77
+        msg.return_value = int(ReturnValue.SUCCESS)
+        client.set_message_result_locally(msg)
+        t.join(5)
+        assert got["msg"].id == 77
+        assert client._result_events == {}
+        assert client._result_waiters == {}
+    finally:
+        client.close()
+        server.stop()
+        get_planner().reset()
+
+
+def test_waiter_nudges_resync_when_healthy_planner_push_is_lost():
+    """The planner pops the waiter set BEFORE its fire-and-forget
+    result push; a push lost on a dead connection is never re-sent and
+    fires no restart signal. A blocked waiter raises the resync flag
+    each poll interval (never issuing the RPC itself — a hung planner
+    must not let it overshoot its deadline or hold the sync lock), and
+    the keep-alive thread's next round retrieves the stored result."""
+    from faabric_tpu.planner import PlannerServer, get_planner
+    from faabric_tpu.planner.client import KeepAliveThread, PlannerClient
+    from faabric_tpu.proto import message_factory
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("pushplanner", "127.0.0.1", base)
+    get_planner().reset()
+    server = PlannerServer(port_offset=base)
+    server.start()
+    client = PlannerClient("w5", planner_host="pushplanner")
+    conf = get_system_config()
+    old_timeout = conf.planner_host_timeout
+    conf.planner_host_timeout = 0.8  # waiter poll interval = 0.4s
+    try:
+        client.register_host(2, 0)
+        got: dict = {}
+        t = threading.Thread(
+            target=lambda: got.update(
+                msg=client.get_message_result(11, 55, timeout=10)),
+            daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while 55 not in client._result_events and time.time() < deadline:
+            time.sleep(0.02)
+        assert 55 in client._result_events
+
+        # Store the result at the planner directly: its push to host
+        # "w5" (no FunctionCallServer, no alias) is the lost push.
+        msg = message_factory("u", "fn")
+        msg.app_id, msg.id = 11, 55
+        msg.return_value = int(ReturnValue.SUCCESS)
+        get_planner().set_message_result(msg)
+
+        # Keep-alive ticks: idle until the waiter's interval expires
+        # and raises the flag, then one resync round delivers.
+        ka = KeepAliveThread(client, slots=2, n_devices=0)
+        deadline = time.time() + 5
+        while "msg" not in got and time.time() < deadline:
+            ka.do_work()
+            time.sleep(0.1)
+        assert got.get("msg") is not None and got["msg"].id == 55
+        assert client._result_events == {}
+        assert client._result_waiters == {}
+    finally:
+        conf.planner_host_timeout = old_timeout
+        client.close()
+        server.stop()
+        get_planner().reset()
+
+
+def test_resync_gated_on_planner_incarnation_change():
+    """resync_result_interest costs one sync RPC per outstanding wait,
+    so a healthy keep-alive tick must skip it; a tick that observes a
+    NEW planner boot id (restart whose journal replay kept this host
+    "known") must run it and re-deliver the recent result window."""
+    from faabric_tpu.planner import PlannerServer, get_planner
+    from faabric_tpu.planner.client import KeepAliveThread, PlannerClient
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("bootplanner", "127.0.0.1", base)
+    get_planner().reset()
+    server = PlannerServer(port_offset=base)
+    server.start()
+    client = PlannerClient("w3", planner_host="bootplanner")
+    try:
+        resyncs: list[int] = []
+        real_resync = client.resync_result_interest
+        client.resync_result_interest = (  # type: ignore[method-assign]
+            lambda: resyncs.append(1) is None and real_resync())
+
+        client.register_host(2, 0)  # boot id recorded at first contact
+        assert client._planner_boot == get_planner().boot_id
+        ka = KeepAliveThread(client, slots=2, n_devices=0)
+        ka.do_work()  # healthy steady-state tick: no resync round
+        assert not resyncs and not client._resync_all
+
+        # A restarted planner process mints a fresh boot id; fake the
+        # stale side since the singleton survives in-process.
+        client._planner_boot = "previous-incarnation"
+        ka.do_work()
+        assert resyncs and not client._resync_all
+        assert client._planner_boot == get_planner().boot_id
+    finally:
+        client.close()
+        server.stop()
+        get_planner().reset()
 
 
 # ---------------------------------------------------------------------------
