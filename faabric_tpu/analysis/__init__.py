@@ -1,0 +1,25 @@
+"""Concurrency conformance suite (ISSUE 7).
+
+Three machine-checks over the runtime's 70+ lock sites and ~20 daemon
+threads, each catching a bug class that PRs 4-6 kept surfacing by hand:
+
+- ``guards``   — AST guarded-by lint: shared attributes declare their
+  lock (``GUARDS`` class map or a ``# guard: self._lock`` trailing
+  comment) and every access is verified to happen inside the matching
+  ``with`` scope; also flags check-then-act escapes and known-blocking
+  calls made while a lock is held.
+- ``protodrift`` — static protocol-drift pass: every RPC call-enum
+  member has a registered server handler, and every call-site uses a
+  declared member.
+- ``lockcheck`` — opt-in runtime detector (``FAABRIC_LOCKCHECK=1``):
+  instrumented Lock/RLock wrappers build a held-before graph with cycle
+  detection, record per-site hold-time histograms into the telemetry
+  registry, and report locks held across blocking syscalls.
+
+``tools/concheck.py`` runs the static passes against the committed
+baseline (``tools/concheck_baseline.txt``) in the same ratchet style as
+``tools/failure_gate.py``. See docs/static_analysis.md.
+
+This package imports nothing heavy at module scope: ``lockcheck`` must
+be installable before JAX (or anything else that creates locks) loads.
+"""

@@ -112,6 +112,10 @@ class _SendWorker:
     transfer wedged on a dead peer can never hang interpreter exit; FIFO
     so a rank's sends to any one destination stay in order."""
 
+    # _closed orders submits against shutdown's sentinel (see submit);
+    # the SimpleQueue itself is internally synchronized
+    GUARDS = {"_closed": "_state_lock"}
+
     def __init__(self, name: str) -> None:
         import queue as _queue
 
@@ -188,6 +192,25 @@ class _LocalMpiPayload:
 
 
 class MpiWorld:
+    # Concurrency contract (tools/concheck.py): rank bookkeeping and
+    # topology caches mutate under the world RLock — collectives on N
+    # rank threads share them. Deliberately unlisted: record_exec_graph
+    # (configured before traffic starts), _in_send_pool (thread-local),
+    # _send_workers (per-rank entries created under _lock in
+    # _send_worker(); reads are GIL-atomic dict hits on an add-only
+    # dict), _split_seq (only mutated under _lock in _split_draw).
+    GUARDS = {
+        "_requests": "_lock",
+        "_next_request_id": "_lock",
+        "_rank_hosts": "_lock",
+        "_local_leader_cache": "_lock",
+        "_same_machine_cache": "_lock",
+        "_topology_gen": "_lock",
+        "_msg_count_to_rank": "_lock",
+        "_msg_type_count": "_lock",
+        "_device_collectives": "_lock",
+    }
+
     def __init__(self, broker, world_id: int, size: int, group_id: int,
                  user: str = "", function: str = "") -> None:
         self.broker = broker

@@ -140,6 +140,26 @@ class KeepAliveThread(PeriodicBackgroundThread):
 class PlannerClient(MessageEndpointClient):
     """One per worker runtime, carrying the worker's host identity."""
 
+    # Concurrency contract (tools/concheck.py): waiter machinery under
+    # _results_lock, outage buffers under _pending_lock. Deliberately
+    # unlisted: planner_down and _resync_all are set-only signal flags
+    # whose races are benign (consumed under _results_lock /
+    # re-checked by the keep-alive tick); _planner_boot is only touched
+    # from the keep-alive thread; _keep_alive and the snapshot-client
+    # handles are start/stop sequenced by the runtime.
+    GUARDS = {
+        "_local_results": "_results_lock",
+        "_local_results_order": "_results_lock",
+        "_result_events": "_results_lock",
+        "_result_waiters": "_results_lock",
+        "_result_interest": "_results_lock",
+        "_resync_nudged": "_results_lock",
+        "_pending_results": "_pending_lock",
+        "_pending_bytes": "_pending_lock",
+        "_recent_results": "_pending_lock",
+        "_recent_bytes": "_pending_lock",
+    }
+
     def __init__(self, this_host: str = "",
                  planner_host: str | None = None) -> None:
         conf = get_system_config()
@@ -339,6 +359,9 @@ class PlannerClient(MessageEndpointClient):
         # Earlier buffered results go first so the planner sees results
         # in completion order (first-write-wins makes reordering safe,
         # but ordered delivery keeps forensics sane)
+        # concheck: ok(guard-unlocked) — racy emptiness probe by design:
+        # flush_pending_results re-checks under _pending_lock, so a torn
+        # read only costs one early/late flush attempt
         if self._pending_results:
             self.flush_pending_results()
         try:

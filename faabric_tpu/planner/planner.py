@@ -136,6 +136,36 @@ class PlannerHost:
 
 
 class Planner:
+    # Concurrency contract (tools/concheck.py, docs/static_analysis.md):
+    # every listed attribute may only be touched inside `with
+    # self._lock`. One RLock guards the whole control-plane state —
+    # scheduling correctness depends on decisions/claims/results
+    # mutating atomically, and the hot path (one dict hit per RPC) does
+    # not contend enough to shard it. NOT listed: boot_id (immutable
+    # after __init__), _telemetry_scrapes (GIL-atomic setdefault/pop by
+    # design), _clients/_snapshot_clients/_journal/snapshot_registry
+    # (internally synchronized), _journal_replay_stats/_reconcile_stats
+    # (write-once diagnostics), _reconcile_timer (start/stop sequenced
+    # by recovery).
+    GUARDS = {
+        "_hosts": "_lock",
+        "_in_flight": "_lock",
+        "_results": "_lock",
+        "_expected": "_lock",
+        "_next_idx": "_lock",
+        "_completed_order": "_lock",
+        "_waiters": "_lock",
+        "_requeue_attempts": "_lock",
+        "_preloaded": "_lock",
+        "_evicted": "_lock",
+        "_next_evicted_ips": "_lock",
+        "_group_hosts": "_lock",
+        "_num_migrations": "_lock",
+        "_state_masters": "_lock",
+        "_device_plane": "_lock",
+        "_journal_last_hosts": "_lock",
+    }
+
     def __init__(self) -> None:
         # Fresh per process incarnation, NEVER journaled: keep-alive
         # responses carry it so a client can tell "the planner
@@ -321,7 +351,7 @@ class Planner:
                             doomed.setdefault(app_id, []).extend(
                                 m for m in req.messages if m.id == mid)
         if doomed:
-            # expire_hosts runs under callers' locks (_policy_host_map);
+            # expire_hosts runs under callers' locks (_policy_host_map_locked);
             # recovery re-enters the RLock and pushes over the network —
             # defer to a thread so no network I/O ever happens under the
             # planner lock. One thread per affected app: their backoffs
@@ -447,15 +477,15 @@ class Planner:
             # every free slot on its main host (reference Planner.cpp:833-893)
             if (decision_type == DecisionType.SCALE_CHANGE
                     and req.elastic_scale_hint and req.messages):
-                self._apply_elastic_scale(req)
+                self._apply_elastic_scale_locked(req)
 
-            host_map = self._policy_host_map()
+            host_map = self._policy_host_map_locked()
 
             decision = None
             preloaded = self._preloaded.get(req.app_id)
             if preloaded is not None and decision_type in (
                     DecisionType.NEW, DecisionType.SCALE_CHANGE):
-                decision = self._slice_preloaded(preloaded, req)
+                decision = self._slice_preloaded_locked(preloaded, req)
 
             # Repeat fork-join shapes reuse their placement (reference
             # DecisionCache). NEW decisions only: scale-changes extend an
@@ -489,19 +519,19 @@ class Planner:
                 return decision
 
             if decision.app_id == MUST_FREEZE:
-                self._freeze_app(req)
+                self._freeze_app_locked(req)
                 return decision
 
             if is_sentinel_decision(decision):  # DO_NOT_MIGRATE
                 return decision
 
             if decision_type == DecisionType.NEW:
-                decision, mappings, dispatches = self._handle_new(req, decision)
+                decision, mappings, dispatches = self._handle_new_locked(req, decision)
             elif decision_type == DecisionType.SCALE_CHANGE:
-                decision, mappings, dispatches = self._handle_scale_change(
+                decision, mappings, dispatches = self._handle_scale_change_locked(
                     req, decision)
             else:
-                decision, mappings, dispatches = self._handle_dist_change(
+                decision, mappings, dispatches = self._handle_dist_change_locked(
                     req, decision)
 
             if thawing:
@@ -537,7 +567,7 @@ class Planner:
 
     # -- decision handling (all run under self._lock; they return the
     # mapping distribution + dispatches to perform after the lock drops) --
-    def _handle_new(self, req: BatchExecuteRequest,
+    def _handle_new_locked(self, req: BatchExecuteRequest,
                     decision: SchedulingDecision
                     ) -> tuple[SchedulingDecision, SchedulingDecision, list]:
         group_id = req.group_id or generate_gid()
@@ -550,7 +580,7 @@ class Planner:
             if decision.group_idxs[i] == 0 and decision.app_idxs[i] != 0:
                 decision.group_idxs[i] = decision.app_idxs[i]
             msg.group_idx = decision.group_idxs[i]
-        self._claim_for_decision(decision, req)
+        self._claim_for_decision_locked(decision, req)
         self._in_flight[req.app_id] = (req, decision)
         self._expected[req.app_id] = req.n_messages()
         self._next_idx[req.app_id] = 1 + max(
@@ -558,7 +588,7 @@ class Planner:
         self._results.setdefault(req.app_id, {})
         return decision, decision, self._build_dispatches(req, decision)
 
-    def _handle_scale_change(self, req: BatchExecuteRequest,
+    def _handle_scale_change_locked(self, req: BatchExecuteRequest,
                              decision: SchedulingDecision
                              ) -> tuple[SchedulingDecision, SchedulingDecision, list]:
         old_req, old_decision = self._in_flight[req.app_id]
@@ -580,7 +610,7 @@ class Planner:
             decision.group_idxs[i] = msg.group_idx
             decision.message_ids[i] = msg.id
 
-        self._claim_for_decision(decision, req)
+        self._claim_for_decision_locked(decision, req)
 
         # Merge into the in-flight record
         for i in range(decision.n_messages):
@@ -594,7 +624,7 @@ class Planner:
 
         return decision, old_decision, self._build_dispatches(req, decision)
 
-    def _handle_dist_change(self, req: BatchExecuteRequest,
+    def _handle_dist_change_locked(self, req: BatchExecuteRequest,
                             decision: SchedulingDecision
                             ) -> tuple[SchedulingDecision, SchedulingDecision, list]:
         old_req, old_decision = self._in_flight[req.app_id]
@@ -602,8 +632,8 @@ class Planner:
         # Transfer claims: release every old placement, then re-claim.
         # Unmoved messages keep their ports/devices (keep_from); moved ones
         # get fresh allocations.
-        self._release_for_decision(old_decision, old_req)
-        self._claim_for_decision(decision, old_req, keep_from=old_decision)
+        self._release_for_decision_locked(old_decision, old_req)
+        self._claim_for_decision_locked(decision, old_req, keep_from=old_decision)
 
         new_group_id = generate_gid()
         decision.group_id = new_group_id
@@ -615,7 +645,7 @@ class Planner:
         # exception + MIGRATION batch (reference §3.5); no dispatch here.
         return decision, decision, []
 
-    def _apply_elastic_scale(self, req: BatchExecuteRequest) -> None:
+    def _apply_elastic_scale_locked(self, req: BatchExecuteRequest) -> None:
         """Grow the scale-change request so the app fills every free slot
         on its main host (called under the planner lock)."""
         import copy
@@ -663,13 +693,13 @@ class Planner:
         # call_batch already returns a detached clone — safe to hand out
         return decision
 
-    def _freeze_app(self, req: BatchExecuteRequest) -> None:
+    def _freeze_app_locked(self, req: BatchExecuteRequest) -> None:
         """Park a running app: release its resources and remember the
         request for a later thaw (reference Planner.cpp:1005-1019)."""
         in_flight = self._in_flight.pop(req.app_id, None)
         if in_flight is not None:
             old_req, old_decision = in_flight
-            self._release_for_decision(old_decision, old_req)
+            self._release_for_decision_locked(old_decision, old_req)
             self._evicted[req.app_id] = old_req
         else:
             self._evicted[req.app_id] = req
@@ -680,7 +710,7 @@ class Planner:
                 req=self._evicted[req.app_id].to_dict())
 
     # -- resource accounting ---------------------------------------------
-    def _policy_host_map(self) -> dict[str, HostState]:
+    def _policy_host_map_locked(self) -> dict[str, HostState]:
         self.expire_hosts()
         out: dict[str, HostState] = {}
         for ip, h in self._hosts.items():
@@ -690,7 +720,7 @@ class Planner:
                 for_eviction=ip in self._next_evicted_ips)
         return out
 
-    def _claim_for_decision(self, decision: SchedulingDecision,
+    def _claim_for_decision_locked(self, decision: SchedulingDecision,
                             req: BatchExecuteRequest,
                             keep_from: SchedulingDecision | None = None) -> None:
         is_mpi = req.n_messages() > 0 and req.messages[0].is_mpi
@@ -713,7 +743,7 @@ class Planner:
                 decision.mpi_ports[i] = host.claim_mpi_port() if is_mpi else 0
                 decision.device_ids[i] = host.claim_device()
 
-    def _release_for_decision(self, decision: SchedulingDecision,
+    def _release_for_decision_locked(self, decision: SchedulingDecision,
                               req: BatchExecuteRequest) -> None:
         for i, ip in enumerate(decision.hosts):
             host = self._hosts.get(ip)
@@ -724,7 +754,7 @@ class Planner:
                 host.release_mpi_port(decision.mpi_ports[i])
             host.release_device(decision.device_ids[i])
 
-    def _release_message(self, app_id: int, msg_id: int) -> None:
+    def _release_message_locked(self, app_id: int, msg_id: int) -> None:
         in_flight = self._in_flight.get(app_id)
         if in_flight is None:
             return
@@ -872,7 +902,7 @@ class Planner:
                     # no-capacity round of this same recovery; only live
                     # rows release
                     if mid in decision.message_ids:
-                        self._release_message(app_id, mid)  # dead: no-op
+                        self._release_message_locked(app_id, mid)  # dead: no-op
                         decision.remove_message(mid)
                 retry_msgs = [m for m in req.messages if m.id in todo_set]
                 sub = BatchExecuteRequest(
@@ -880,7 +910,7 @@ class Planner:
                     function=req.function, type=req.type,
                     subtype=req.subtype, snapshot_key=req.snapshot_key)
                 sub.messages = retry_msgs
-                host_map = self._policy_host_map()
+                host_map = self._policy_host_map_locked()
                 scheduler = get_batch_scheduler()
                 # Empty in-flight view: the retry slice places like a NEW
                 # batch of just these messages (their app/group idxs ride
@@ -893,14 +923,15 @@ class Planner:
                     # a longer-backoff round rather than failing outright.
                     used = self._requeue_attempts.get(app_id, 0)
                     if used < conf.planner_max_requeues:
-                        self._requeue_attempts[app_id] = used + 1
+                        used += 1
+                        self._requeue_attempts[app_id] = used
                         retry_later = True
                     else:
                         fail = retry_msgs
                         fail_reason = reason + b" (no requeue capacity)"
                 else:
                     new_decision.group_id = decision.group_id
-                    self._claim_for_decision(new_decision, sub)
+                    self._claim_for_decision_locked(new_decision, sub)
                     for i in range(new_decision.n_messages):
                         decision.add_message(
                             new_decision.hosts[i],
@@ -935,7 +966,10 @@ class Planner:
                             hosts=sorted(set(new_decision.hosts)))
                         self._journal_app_update_locked(app_id)
         if retry_later:
-            used = self._requeue_attempts.get(app_id, 1)
+            # ``used`` was captured under the lock when the budget unit
+            # was spent — re-reading _requeue_attempts here would race a
+            # concurrent recovery round's increment (concheck:
+            # guard-unlocked on the old read)
             delay = self._requeue_delay(used)
             logger.warning(
                 "No capacity to requeue %d msgs of app %d yet; retrying "
@@ -1002,7 +1036,7 @@ class Planner:
             logger.debug("Preloaded decision for app %d (%d msgs)",
                          decision.app_id, decision.n_messages)
 
-    def _slice_preloaded(self, preloaded: SchedulingDecision,
+    def _slice_preloaded_locked(self, preloaded: SchedulingDecision,
                          req: BatchExecuteRequest
                          ) -> Optional[SchedulingDecision]:
         """Take the preloaded rows matching this request's app idxs
@@ -1143,7 +1177,7 @@ class Planner:
                 # The rank vacated its old host; its new placement is
                 # already in the post-migration decision — re-dispatch it
                 # there as a MIGRATION batch (reference §3.5)
-                redispatch = self._build_migration_redispatch(app_id, msg_id)
+                redispatch = self._build_migration_redispatch_locked(app_id, msg_id)
             if not migrated and not frozen:
                 if not self._record_result_locked(msg):
                     return
@@ -1194,7 +1228,7 @@ class Planner:
             logger.debug("Ignoring duplicate result for msg %d "
                          "(app %d)", msg_id, app_id)
             return False
-        self._release_message(app_id, msg_id)
+        self._release_message_locked(app_id, msg_id)
         self._results.setdefault(app_id, {})[msg_id] = msg
         if not replay:
             _RESULTS_TOTAL.inc()
@@ -1217,7 +1251,7 @@ class Planner:
                 self._requeue_attempts.pop(app_id, None)
                 if app_id not in self._completed_order:
                     self._completed_order.append(app_id)
-                self._evict_old_results()
+                self._evict_old_results_locked()
                 logger.debug("App %d complete", app_id)
             _IN_FLIGHT_APPS.set(len(self._in_flight))
         if replay and app_id not in self._in_flight:
@@ -1227,7 +1261,7 @@ class Planner:
             self._group_hosts.pop(app_id, None)
         return True
 
-    def _build_migration_redispatch(self, app_id: int, msg_id: int
+    def _build_migration_redispatch_locked(self, app_id: int, msg_id: int
                                     ) -> Optional[tuple[str, BatchExecuteRequest]]:
         """Under the lock: build the MIGRATION sub-batch that moves one
         migrated rank to its post-migration host."""
@@ -1259,7 +1293,7 @@ class Planner:
     # results are retained for late readers but bounded, oldest-first.
     MAX_KEPT_APP_RESULTS = 1000
 
-    def _evict_old_results(self) -> None:
+    def _evict_old_results_locked(self) -> None:
         while len(self._completed_order) > self.MAX_KEPT_APP_RESULTS:
             oldest = self._completed_order.pop(0)
             self._results.pop(oldest, None)
@@ -1473,7 +1507,7 @@ class Planner:
                 self._requeue_attempts.pop(app_id, None)
                 if app_id not in self._completed_order:
                     self._completed_order.append(app_id)
-                self._evict_old_results()
+                self._evict_old_results_locked()
             else:
                 self._in_flight[app_id] = (req, decision)
                 self._results.setdefault(app_id, {})
@@ -1558,6 +1592,8 @@ class Planner:
             "inFlightMessages": in_flight_msgs,
             "results": n_results,
             "stateMasters": n_masters,
+            # concheck: ok(guard-unlocked) — __init__-time replay: the
+            # planner is not yet published to any server thread
             "lastKnownHosts": sorted(self._journal_last_hosts),
             "seconds": round(elapsed, 4),
             "ts": time.time(),

@@ -192,6 +192,16 @@ class BulkServer:
     its own handler thread; every announced shm ring its own drain thread
     — the receive side scales with the stripes by construction."""
 
+    # Concurrency contract (tools/concheck.py): _conns/_threads are
+    # touched by start(), the accept loop and stop() concurrently;
+    # _attached_rings by every conn thread. _listener/_stopping are
+    # write-once-then-read (start/stop sequencing) and stay unlisted.
+    GUARDS = {
+        "_conns": "_lock",
+        "_threads": "_lock",
+        "_attached_rings": "_lock",
+    }
+
     def __init__(self, broker, port_offset: int = 0) -> None:
         self.broker = broker
         self.port = BULK_PORT + port_offset
@@ -221,8 +231,9 @@ class BulkServer:
         self._listener = s
         t = threading.Thread(target=self._accept_loop,
                              name=f"bulk-accept-{self.port}", daemon=True)
+        with self._lock:
+            self._threads.append(t)
         t.start()
-        self._threads.append(t)
         logger.debug("Bulk server on :%d", self.port)
 
     def _accept_loop(self) -> None:
@@ -236,16 +247,20 @@ class BulkServer:
                 if self._stopping or self._listener is None:
                     return  # listener closed
                 continue  # one bad connection must not kill the acceptor
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 name="bulk-conn", daemon=True)
             with self._lock:
                 self._conns.append(conn)
                 # Prune finished conn threads + closed sockets so the
-                # lists stay bounded under connection churn
-                self._threads = [t for t in self._threads if t.is_alive()]
+                # lists stay bounded under connection churn. Append AND
+                # start under the lock: the old post-start append raced
+                # stop()'s iteration, and an append-then-start-outside
+                # would let stop() join() a not-yet-started thread
+                # (RuntimeError mid-shutdown).
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
                 self._conns = [c for c in self._conns if c.fileno() >= 0]
-            t = threading.Thread(target=self._conn_loop, args=(conn,),
-                                 name="bulk-conn", daemon=True)
-            t.start()
-            self._threads.append(t)
+                t.start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
         drain_stop = threading.Event()
@@ -480,9 +495,10 @@ class BulkServer:
                 c.close()
             except OSError:
                 pass
-        for t in self._threads:
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
             t.join(timeout=2.0)
-        self._threads.clear()
 
 
 def _is_local_ip(ip: str) -> bool:
@@ -500,6 +516,18 @@ class _Stripe:
     __slots__ = ("host", "tag", "ring_bytes", "sock", "ring",
                  "ring_refused", "lock", "shm_frames")
 
+    # Concurrency contract: the stripe lock serializes the connection
+    # AND the per-stripe state. Socket ops deliberately happen while it
+    # is held — per-stripe serialization of the byte stream IS the
+    # design (frames must not interleave); the broker's lock-free reads
+    # of ring/ring_refused in small_frames_ok() carry line pragmas.
+    GUARDS = {
+        "sock": "lock",
+        "ring": "lock",
+        "ring_refused": "lock",
+        "shm_frames": "lock",
+    }
+
     def __init__(self, host: str, idx: int, ring_bytes: int) -> None:
         self.host = host
         self.tag = f"{host}-s{idx}"
@@ -514,7 +542,7 @@ class _Stripe:
         self.shm_frames = 0  # observability: frames that rode the ring
 
     # -- connection management (caller holds self.lock) -----------------
-    def _dial(self) -> socket.socket:
+    def _dial_locked(self) -> socket.socket:
         from faabric_tpu.util.network import safe_create_connection
 
         ip, port = resolve_host(self.host, BULK_PORT)
@@ -523,7 +551,7 @@ class _Stripe:
         try:
             _tune(s)
             s.settimeout(None)
-            self._maybe_announce_ring(s, ip)
+            self._maybe_announce_ring_locked(s, ip)
         except BaseException:
             # A failed announce (peer died mid-handshake) must not leak
             # the just-dialed socket; the caller sees the dial fail
@@ -534,7 +562,7 @@ class _Stripe:
             raise
         return s
 
-    def _maybe_announce_ring(self, sock: socket.socket, ip: str) -> None:
+    def _maybe_announce_ring_locked(self, sock: socket.socket, ip: str) -> None:
         from faabric_tpu.transport import shm
 
         if self.ring_refused or self.ring_bytes <= 0 \
@@ -549,6 +577,10 @@ class _Stripe:
             return
         name = ring.name.encode()
         try:
+            # concheck: ok(blocking-under-lock) — the stripe lock IS the
+            # stream serializer: the announce must not interleave with a
+            # concurrent frame on this connection, and dial-time has no
+            # frames queued behind it
             sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(name),
                                      SHM_ANNOUNCE) + name)
         except OSError:
@@ -562,6 +594,9 @@ class _Stripe:
         # frames (an unattached ring would swallow them silently)
         try:
             sock.settimeout(5.0)
+            # concheck: ok(blocking-under-lock) — ACK read is bounded by
+            # the 5 s settimeout above and happens once per dial, before
+            # any sender can be queued on this fresh stripe
             ack = sock.recv(1)
         except OSError:
             ack = b""
@@ -575,6 +610,8 @@ class _Stripe:
             # If the ACK was merely lost/late, a drain may exist: retire
             # it so it never idles forever on an abandoned ring
             try:
+                # concheck: ok(blocking-under-lock) — dial-time stream
+                # serialization, same contract as the announce above
                 sock.sendall(_FRAME.pack(0, 0, 0, 0, 0, 0, SHM_RETIRE))
             except OSError:
                 pass
@@ -587,7 +624,7 @@ class _Stripe:
         here at all."""
         with self.lock:
             if self.sock is None:
-                self.sock = self._dial()
+                self.sock = self._dial_locked()
 
     # -- the per-frame send path ---------------------------------------
     def send_frame(self, head: bytes, views: list, nbytes: int,
@@ -598,7 +635,7 @@ class _Stripe:
         fired = False
         with self.lock:
             if self.sock is None:
-                self.sock = self._dial()
+                self.sock = self._dial_locked()
             ring = self.ring
             if ring is not None and nbytes + _FRAME.size + 8 <= ring.capacity:
                 if _FAULTS:
@@ -643,6 +680,10 @@ class _Stripe:
                 # slow, it finishes the buffered frames first — their
                 # seqs precede this frame's, so ordering holds)
                 try:
+                    # concheck: ok(blocking-under-lock) — by design: the
+                    # stripe lock serializes this connection's byte
+                    # stream, so every write on it happens under the
+                    # lock (see the _Stripe GUARDS contract)
                     self.sock.sendall(
                         _FRAME.pack(0, 0, 0, 0, 0, 0, SHM_RETIRE))
                 except OSError:
@@ -694,7 +735,7 @@ class _Stripe:
                 # framework implements in mpi/world.py's async requests.)
                 self._reset_locked()
                 try:
-                    self.sock = self._dial()
+                    self.sock = self._dial_locked()
                     _sendmsg_all(self.sock, bufs)
                     _BULK_RECONNECTS.inc()
                     _BULK_TX_FRAMES["tcp"].inc()
@@ -752,6 +793,10 @@ class BulkClient:
     (power-of-two each, 1 MiB floor); the control stripe's ring is at
     most 4 MiB on top. SHM_BULK=0 disables the rings."""
 
+    # _rr is deliberately unlisted: the round-robin counter's data race
+    # is benign (it only spreads load) and documented at the use site.
+    GUARDS = {"_stripes": "_lock"}
+
     def __init__(self, host: str) -> None:
         self.host = host
         self._lock = threading.Lock()
@@ -792,11 +837,15 @@ class BulkClient:
 
     def _pick(self, nbytes: int, seq: int) -> _Stripe:
         if BULK_STRIPES == 0 or nbytes < BULK_THRESHOLD or seq < 0:
-            s = self._stripes.get(0)  # lock-free per-message path
+            # concheck: ok(guard-unlocked) — documented lock-free
+            # per-message fast path: dict.get on a GIL-atomic dict whose
+            # values are only ever added, with the locked _stripe() as
+            # the miss path
+            s = self._stripes.get(0)
             return s if s is not None else self._stripe(0)
         # Benign data race on the counter: it only spreads load
         self._rr = rr = (self._rr + 1) % BULK_STRIPES
-        s = self._stripes.get(1 + rr)
+        s = self._stripes.get(1 + rr)  # concheck: ok(guard-unlocked)
         return s if s is not None else self._stripe(1 + rr)
 
     def small_frames_ok(self) -> bool:
@@ -805,6 +854,8 @@ class BulkClient:
         use; OSErrors propagate so the broker can mark the plane down."""
         # Lock-free fast path — this runs per small message once the
         # ring is up, and must cost a dict read + an attribute read
+        # concheck: ok(guard-unlocked) — same GIL-atomic add-only dict
+        # contract as _pick; ring/ring_refused reads are monotonic flags
         s = self._stripes.get(0)
         if s is not None:
             if s.ring is not None:

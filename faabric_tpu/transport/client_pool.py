@@ -14,6 +14,11 @@ T = TypeVar("T")
 
 
 class ClientPool(Generic[T]):
+    # Concurrency contract (tools/concheck.py): the host→client map is
+    # shared by every dispatching thread. Note close() happens OUTSIDE
+    # the lock on purpose — a client close blocks on network teardown.
+    GUARDS = {"_clients": "_lock"}
+
     def __init__(self, factory: Callable[[str], T]) -> None:
         self._factory = factory
         self._clients: dict[str, T] = {}

@@ -93,6 +93,28 @@ COORD_CHANNEL = 1
 
 
 class PointToPointBroker:
+    # Concurrency contract (tools/concheck.py). The broker is the
+    # hottest lock in the tree, so PR 5 carved out documented LOCK-FREE
+    # fast paths over GIL-atomic add-only dicts — those carry line
+    # pragmas at the use sites; everything else goes through self._lock.
+    GUARDS = {
+        "_mappings": "_lock",
+        "_flags": "_lock",
+        "_queues": "_lock",
+        "_sent_seq": "_lock",
+        "_recv_seq": "_lock",
+        "_ooo": "_lock",
+        "_unseq": "_lock",
+        "_groups": "_lock",
+        "_clients": "_lock",
+        "_bulk_clients": "_lock",
+        "_bulk_down_until": "_lock",
+        "_shm_peers": "_lock",
+        "_watched": "_lock",
+        "_aborted": "_lock",
+        "_peer_ok_until": "_lock",
+    }
+
     def __init__(self, host: str) -> None:
         self.host = host
         self._lock = threading.RLock()
@@ -168,6 +190,7 @@ class PointToPointBroker:
                           timeout: float | None = None) -> None:
         # Lock-free fast path: once a group's mappings are installed the
         # per-message check is one dict read + one attribute read
+        # concheck: ok(guard-unlocked) — documented fast path
         flag = self._flags.get(group_id)
         if flag is not None and flag.is_set():
             return
@@ -179,6 +202,7 @@ class PointToPointBroker:
         # Lock-free fast path (GIL-atomic dict reads): this runs twice
         # per message on the send hot path, and mapping dicts are only
         # ever replaced/extended under the lock
+        # concheck: ok(guard-unlocked) — documented fast path
         group = self._mappings.get(group_id)
         if group is not None:
             m = group.get(recv_idx)
@@ -223,7 +247,7 @@ class PointToPointBroker:
 
     def _is_watched(self, group_id: int) -> bool:
         # GIL-atomic set membership; per-message hot path
-        return group_id in self._watched
+        return group_id in self._watched  # concheck: ok(guard-unlocked)
 
     def group_aborted(self, group_id: int) -> Optional[str]:
         with self._lock:
@@ -528,8 +552,10 @@ class PointToPointBroker:
                     raise TimeoutError(
                         f"PTP recv timed out on {key}") from e
                 if data is _ABORT:
-                    raise GroupAbortedError(
-                        group_id, self._aborted.get(group_id, ""))
+                    # Abort reason is a write-once string; racing the
+                    # unlocked map read is benign
+                    reason = self._aborted.get(group_id, "")  # concheck: ok(guard-unlocked)
+                    raise GroupAbortedError(group_id, reason)
                 return data, seq
 
         # Ordered path: consume in seq order, buffering whatever arrives
@@ -600,8 +626,10 @@ class PointToPointBroker:
                     return None
             seq, data = item
             if data is _ABORT:
-                raise GroupAbortedError(key[0],
-                                        self._aborted.get(key[0], ""))
+                # Abort reason is a write-once string; racing the
+                # unlocked map read is benign
+                reason = self._aborted.get(key[0], "")  # concheck: ok(guard-unlocked)
+                raise GroupAbortedError(key[0], reason)
             with self._lock:
                 if seq == NO_SEQUENCE_NUM:
                     if consume and not backlog:
@@ -640,6 +668,7 @@ class PointToPointBroker:
         return None if nxt is None else nxt[1]
 
     def _get_queue(self, key: tuple[int, int, int, int]) -> Queue:
+        # concheck: ok(guard-unlocked) — documented fast path
         q = self._queues.get(key)  # lock-free per-message path
         if q is not None:
             return q
@@ -709,6 +738,7 @@ class PointToPointBroker:
             self._shm_peers.clear()
 
     def _get_client(self, host: str):
+        # concheck: ok(guard-unlocked) — documented fast path
         client = self._clients.get(host)  # lock-free per-message path
         if client is not None:
             return client
@@ -720,6 +750,7 @@ class PointToPointBroker:
             return self._clients[host]
 
     def _get_bulk_client(self, host: str):
+        # concheck: ok(guard-unlocked) — documented fast path
         client = self._bulk_clients.get(host)  # lock-free per-message path
         if client is not None:
             return client
@@ -739,7 +770,7 @@ class PointToPointBroker:
         and shm rings are usable — the selection rule for the shm fast
         path. Cached per host (alias resolution + /dev/shm probe); the
         cached read is lock-free (GIL-atomic dict get, per-message)."""
-        cached = self._shm_peers.get(host)
+        cached = self._shm_peers.get(host)  # concheck: ok(guard-unlocked)
         if cached is not None:
             return cached
         from faabric_tpu.transport import shm
@@ -758,7 +789,7 @@ class PointToPointBroker:
     def _bulk_down(self, host: str) -> bool:
         # GIL-atomic dict read — this runs per message on the send hot
         # path now that small frames route through the bulk plane
-        until = self._bulk_down_until.get(host, 0.0)
+        until = self._bulk_down_until.get(host, 0.0)  # concheck: ok(guard-unlocked)
         return until > 0.0 and time.monotonic() < until
 
     def _mark_bulk_down(self, host: str) -> None:

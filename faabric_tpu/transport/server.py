@@ -74,6 +74,18 @@ _QUEUE_DEPTH = _metrics.gauge(
 
 
 class MessageEndpointServer:
+    # Concurrency contract (tools/concheck.py): the connection set and
+    # per-connection reader threads are shared between the accept loops
+    # and stop(); the test latch is armed/fired across threads.
+    # Deliberately unlisted: _threads (the fixed worker/accept pool) and
+    # _running are start/stop sequenced, the listeners are write-once at
+    # start, and _work is an internally-synchronized queue.
+    GUARDS = {
+        "_conns": "_conn_lock",
+        "_conn_threads": "_conn_lock",
+        "_request_latch": "_latch_lock",
+    }
+
     def __init__(
         self,
         async_port: int,
@@ -181,10 +193,13 @@ class MessageEndpointServer:
                 pass
         for t in self._threads:
             t.join(timeout=2.0)
-        for t in self._conn_threads:
+        # Snapshot under the lock: the accept loop appends conn threads
+        # concurrently until its listener wakeup lands (concheck)
+        with self._conn_lock:
+            conn_threads, self._conn_threads = self._conn_threads, []
+        for t in conn_threads:
             t.join(timeout=2.0)
         self._threads.clear()
-        self._conn_threads.clear()
         with self._conn_lock:
             self._conns.clear()
         logger.debug("%s stopped", self.label)
@@ -202,7 +217,11 @@ class MessageEndpointServer:
         if latch is not None:
             latch.wait()
             with self._latch_lock:
-                self._request_latch = None
+                # Only clear the latch we waited on: a test re-arming
+                # between the wait and this clear must keep ITS latch
+                # (check-then-act — the concheck lint's canonical case)
+                if self._request_latch is latch:
+                    self._request_latch = None
 
     def _fire_request_latch(self) -> None:
         with self._latch_lock:
@@ -254,10 +273,13 @@ class MessageEndpointServer:
             with self._conn_lock:
                 self._conns.add(conn)
                 # Prune finished reader threads so the list stays bounded on
-                # long-lived servers with connection churn.
+                # long-lived servers with connection churn. Start under the
+                # lock too: stop() snapshots this list and join()s every
+                # entry — an appended-but-unstarted thread there raises
+                # RuntimeError mid-shutdown.
                 self._conn_threads = [x for x in self._conn_threads if x.is_alive()]
                 self._conn_threads.append(t)
-            t.start()
+                t.start()
 
     def _conn_loop(self, conn: socket.socket, plane: str) -> None:
         try:

@@ -24,6 +24,75 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _lock = threading.Lock()
 # name → loaded lib, or None after a failed attempt (one try per process)
 _cache: dict[str, Optional[ctypes.CDLL]] = {}
+# name → Event while a build/load is in flight: the compile (up to
+# 120 s of subprocess.run) must not run under the module lock — that
+# would serialize every other native lib's first use behind it and is
+# exactly the blocking-call-under-lock pattern tools/concheck.py flags.
+# Losers of the build race park on the event, then re-read the cache.
+_in_progress: dict[str, threading.Event] = {}
+
+# Sanitizer build mode (ISSUE 7 satellite): FAABRIC_NATIVE_SAN=tsan|asan
+# compiles every native helper with the matching -fsanitize flag into a
+# suffixed .so. Loading one into an unsanitized interpreter requires the
+# runtime preloaded (LD_PRELOAD=$(g++ -print-file-name=libtsan.so)) —
+# tests/unit/test_native_san.py drives that in a subprocess; an
+# in-process load attempt without the preload fails cleanly into the
+# usual pure-Python fallback.
+_SAN_FLAGS = {
+    "tsan": ("-fsanitize=thread", "-O1", "-g", "-fno-omit-frame-pointer"),
+    "asan": ("-fsanitize=address", "-O1", "-g",
+             "-fno-omit-frame-pointer"),
+}
+
+
+def _san_mode() -> str:
+    mode = os.environ.get("FAABRIC_NATIVE_SAN", "").strip().lower()
+    return mode if mode in _SAN_FLAGS else ""
+
+
+def _build_and_load(name: str, src_file: str, so_file: str,
+                    declare: Callable[[ctypes.CDLL], None],
+                    install: Optional[Callable[[ctypes.CDLL], bool]],
+                    extra_args: tuple,
+                    fail_note: str) -> Optional[ctypes.CDLL]:
+    """Compile-if-stale / load / declare / install — no locks held."""
+    src = os.path.join(_REPO_ROOT, "native", src_file)
+    san = _san_mode()
+    if san:
+        so_file = f"{so_file.removesuffix('.so')}.{san}.so"
+    so = os.path.join(_REPO_ROOT, "native", "build", so_file)
+    if not os.path.exists(src):
+        return None
+    if not os.path.exists(so) or (os.path.getmtime(so)
+                                  < os.path.getmtime(src)):
+        os.makedirs(os.path.dirname(so), exist_ok=True)
+        if san:
+            opt_args: tuple = _SAN_FLAGS[san]
+        else:
+            opt_args = ("-O3", "-march=native")
+        cmd = ["g++", *opt_args, "-shared", "-fPIC",
+               src, "-o", so, *extra_args]
+        # Never compile under an inherited sanitizer preload: cc1plus/
+        # as/ld running through libtsan's interceptors turns a 5 s build
+        # into minutes (observed hang when a TSAN-preloaded test process
+        # triggered the first sanitized build)
+        env = {k: v for k, v in os.environ.items() if k != "LD_PRELOAD"}
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120, env=env)
+        except (subprocess.SubprocessError, OSError) as e:
+            logger.warning("Native %s build failed (%s); %s",
+                           name, e, fail_note)
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError as e:
+        logger.warning("Could not load %s: %s", so, e)
+        return None
+    declare(lib)
+    if install is not None and not install(lib):
+        return None
+    return lib
 
 
 def _load_native(name: str, src_file: str, so_file: str,
@@ -31,38 +100,28 @@ def _load_native(name: str, src_file: str, so_file: str,
                  install: Optional[Callable[[ctypes.CDLL], bool]] = None,
                  extra_args: tuple = (),
                  fail_note: str = "") -> Optional[ctypes.CDLL]:
-    """Shared compile-if-stale / load / declare-signatures / install
-    path for every native helper; one attempt per process per lib."""
-    src = os.path.join(_REPO_ROOT, "native", src_file)
-    so = os.path.join(_REPO_ROOT, "native", "build", so_file)
-    with _lock:
-        if name in _cache:
-            return _cache[name]
-        _cache[name] = None
-        if not os.path.exists(src):
-            return None
-        if not os.path.exists(so) or (os.path.getmtime(so)
-                                      < os.path.getmtime(src)):
-            os.makedirs(os.path.dirname(so), exist_ok=True)
-            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                   src, "-o", so, *extra_args]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True,
-                               timeout=120)
-            except (subprocess.SubprocessError, OSError) as e:
-                logger.warning("Native %s build failed (%s); %s",
-                               name, e, fail_note)
-                return None
-        try:
-            lib = ctypes.CDLL(so)
-        except OSError as e:
-            logger.warning("Could not load %s: %s", so, e)
-            return None
-        declare(lib)
-        if install is not None and not install(lib):
-            return None
-        _cache[name] = lib
-        return lib
+    """Shared load path for every native helper; one attempt per process
+    per lib, with the build itself running outside the module lock."""
+    while True:
+        with _lock:
+            if name in _cache:
+                return _cache[name]
+            ev = _in_progress.get(name)
+            if ev is None:
+                _in_progress[name] = threading.Event()
+                break
+        # Another thread owns this lib's build: park until it publishes
+        # its verdict, then re-read the cache
+        ev.wait()
+    lib: Optional[ctypes.CDLL] = None
+    try:
+        lib = _build_and_load(name, src_file, so_file, declare, install,
+                              extra_args, fail_note)
+    finally:
+        with _lock:
+            _cache[name] = lib
+            _in_progress.pop(name).set()
+    return lib
 
 
 def _declare_pagediff(lib: ctypes.CDLL) -> None:

@@ -15,6 +15,16 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Runtime concurrency detector (ISSUE 7): FAABRIC_LOCKCHECK=1 wraps the
+# threading.Lock/RLock factories BEFORE jax (or any faabric module)
+# loads, so every lock created from faabric_tpu/ or tests/ joins the
+# held-before graph. The session gate below fails the run on any
+# potential-deadlock cycle (FAABRIC_LOCKCHECK_GATE=0 demotes to report).
+from faabric_tpu.analysis import lockcheck as _lockcheck  # noqa: E402
+
+if _lockcheck.enabled_by_env():
+    _lockcheck.install()
+
 # This image's sitecustomize registers the remote-TPU ("axon") PJRT plugin
 # and *explicitly* sets jax_platforms="axon,cpu", which overrides the env
 # var above; initialising that backend dials the TPU tunnel — minutes-slow
@@ -110,6 +120,33 @@ def _reset_globals():
     clear_mock_snapshot_requests()
     clear_mock_state_requests()
     clear_sent_ptp()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_session_gate():
+    """With FAABRIC_LOCKCHECK=1, the whole run doubles as a deadlock
+    hunt: any held-before cycle observed across every test fails the
+    session (teardown assertion), and the full report prints in the
+    terminal summary either way."""
+    yield
+    from faabric_tpu.analysis import lockcheck
+
+    if not lockcheck.installed():
+        return
+    if os.environ.get("FAABRIC_LOCKCHECK_GATE", "1") in ("0", "false"):
+        return
+    rep = lockcheck.report()
+    assert not rep["cycles"], (
+        "lockcheck: potential deadlock cycle(s) observed:\n"
+        + lockcheck.format_report(rep))
+
+
+def pytest_terminal_summary(terminalreporter):
+    from faabric_tpu.analysis import lockcheck
+
+    if lockcheck.installed():
+        terminalreporter.write_line("")
+        terminalreporter.write_line(lockcheck.format_report())
 
 
 def run_threads(fns, timeout=60.0):

@@ -1,0 +1,158 @@
+"""Thread/fd-leak gate for the dist suite (ISSUE 7 satellite).
+
+Every dist test stands up real runtimes (planner + workers + brokers +
+bulk servers, often several logical hosts in one process). The contract
+this gate enforces: once the module's cluster fixtures tear down,
+``WorkerRuntime.stop()``/``PlannerServer.stop()`` must have left
+**zero** extra live threads and **zero** extra open fds versus the
+module-entry snapshot — a leaked daemon thread or socket per test is
+how a 500-test run ends in scheduler thrash and EMFILE.
+
+Two layers (cluster fixtures are module-scoped, and pooled connections
+dial lazily mid-test, so a strict per-test zero-diff would flag
+legitimate module-lifetime infrastructure):
+
+- **per test**: diff live threads + ``/proc/self/fd`` against the
+  pre-test snapshot. New arrivals are recorded as *candidates*
+  attributed to that test (and a runaway burst — more than
+  ``FAABRIC_LEAK_GATE_BURST`` new threads that never drain — fails the
+  test immediately).
+- **per module**: after the last fixture (i.e. after every runtime's
+  ``stop()``) the gate polls for up to ``FAABRIC_LEAK_GATE_GRACE``
+  seconds, then fails the module if anything beyond the module-entry
+  snapshot survives — listing which test introduced each leak.
+
+``FAABRIC_LEAK_GATE=0`` disables. Allowlisted: process-wide singletons
+that legitimately outlive the module — the native uffd event thread
+(never re-installed), JAX/XLA pool threads (first device use
+initialises them for the process lifetime), library-owned executor
+pools.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+_ENABLED = os.environ.get("FAABRIC_LEAK_GATE", "1") not in (
+    "0", "false", "off")
+_GRACE_S = float(os.environ.get("FAABRIC_LEAK_GATE_GRACE", "20"))
+_BURST = int(os.environ.get("FAABRIC_LEAK_GATE_BURST", "24"))
+
+# Thread-name prefixes that legitimately outlive a module, not leaks
+_ALLOWED_THREAD_PREFIXES = (
+    "uffd",                # native uffd tracker event thread
+    "jax",                 # jax-internal pools
+    "pjrt",                # XLA runtime pools
+    "ThreadPoolExecutor",  # library-owned executor pools
+    "asyncio",
+    "pydevd",              # debugger, when attached
+    # Planner recovery threads sleep through requeue backoffs (up to
+    # ~30 s by design, daemon, budget-bounded) — after a chaos module
+    # SIGKILLs workers they can outlive any sane teardown grace
+    "recover-",
+)
+
+
+def _fd_map() -> dict[str, str]:
+    out: dict[str, str] = {}
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                out[fd] = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                out[fd] = "?"
+    except OSError:
+        pass
+    return out
+
+
+def _live_threads() -> set[threading.Thread]:
+    return {
+        t for t in threading.enumerate()
+        if t.is_alive() and t is not threading.current_thread()
+        and not t.name.startswith(_ALLOWED_THREAD_PREFIXES)
+    }
+
+
+class _ModuleLedger:
+    """Module-entry snapshot + per-test attribution of new arrivals."""
+
+    def __init__(self) -> None:
+        self.threads = _live_threads()
+        self.fds = set(_fd_map())
+        # thread/fd → nodeid of the test that introduced it
+        self.thread_owner: dict[threading.Thread, str] = {}
+        self.fd_owner: dict[str, str] = {}
+
+    def attribute(self, nodeid: str) -> None:
+        for t in _live_threads() - self.threads:
+            self.thread_owner.setdefault(t, nodeid)
+        for fd in set(_fd_map()) - self.fds:
+            self.fd_owner.setdefault(fd, nodeid)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_leak_gate():
+    if not _ENABLED:
+        yield
+        return
+    ledger = _ModuleLedger()
+    yield ledger
+    # Runs AFTER the module's cluster fixtures tore down (reverse
+    # finalization order: autouse module fixtures set up first)
+    deadline = time.monotonic() + _GRACE_S
+    while True:
+        threads = _live_threads() - ledger.threads
+        fds = {fd: path for fd, path in _fd_map().items()
+               if fd not in ledger.fds}
+        if not threads and not fds:
+            return
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.2)
+    lines = [f"leak gate: module left {len(threads)} thread(s) and "
+             f"{len(fds)} fd(s) after all fixtures tore down "
+             f"(grace {_GRACE_S:.0f}s):"]
+    for t in sorted(threads, key=lambda t: t.name):
+        src = ledger.thread_owner.get(t, "<module setup>")
+        lines.append(f"  thread {t.name!r} (daemon={t.daemon}) — "
+                     f"introduced by {src}")
+    for fd, path in sorted(fds.items(), key=lambda kv: int(kv[0])):
+        src = ledger.fd_owner.get(fd, "<module setup>")
+        lines.append(f"  fd {fd}: {path} — introduced by {src}")
+    lines.append("WorkerRuntime.stop()/PlannerServer.stop() must leave "
+                 "zero extra daemon threads and sockets — fix the "
+                 "teardown, or allowlist a process-wide singleton here "
+                 "with a justification.")
+    pytest.fail("\n".join(lines), pytrace=False)
+
+
+@pytest.fixture(autouse=True)
+def _test_leak_gate(request, _module_leak_gate):
+    if not _ENABLED:
+        yield
+        return
+    ledger: _ModuleLedger = _module_leak_gate
+    before = _live_threads()
+    yield
+    # Attribute new arrivals to this test for the module-teardown
+    # report, and catch runaway growth right here: a burst of threads
+    # that never drains points at a per-call leak (e.g. a thread per
+    # message), which must not hide behind module-lifetime pools.
+    deadline = time.monotonic() + _GRACE_S
+    while True:
+        new = _live_threads() - before
+        if len(new) <= _BURST or time.monotonic() > deadline:
+            break
+        time.sleep(0.2)
+    ledger.attribute(request.node.nodeid)
+    if len(new) > _BURST:
+        names = sorted(t.name for t in new)
+        pytest.fail(
+            f"leak gate: {request.node.nodeid} grew the process by "
+            f"{len(new)} threads that never drained (burst cap "
+            f"{_BURST}): {names[:30]}", pytrace=False)

@@ -4,6 +4,9 @@ tuned dedicated sockets, merged with RPC-plane ordering.
 Reference analog: the raw-TCP MPI data plane
 (include/faabric/transport/tcp/Socket.h:75-78)."""
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -525,3 +528,52 @@ def test_ring_push_timeout_declares_ring_dead(bulk_pair, monkeypatch):
     got = b.recv_message(GROUP, 0, 1, must_order=True, timeout=10)
     assert bytes(got) == payload
     assert stripe.ring is None and stripe.ring_refused
+
+
+def test_bulk_server_stop_races_connection_churn():
+    """Regression (ISSUE 7 concheck guard-unlocked on _threads): the
+    accept loop appends handler threads while stop() walks the list —
+    the old post-start append outside the lock could corrupt stop()'s
+    iteration under churn. Hammer connects while stopping; stop() must
+    complete cleanly and leave no handler thread behind."""
+    import socket as socket_mod
+
+    from faabric_tpu.transport.bulk import BulkServer
+
+    class _NullBroker:
+        def deliver(self, *a, **k):
+            pass
+
+        def deliver_many(self, *a, **k):
+            pass
+
+    srv = BulkServer(_NullBroker(), port_offset=27_000)
+    srv.start()
+    stop_churn = threading.Event()
+
+    def churn():
+        while not stop_churn.is_set():
+            try:
+                c = socket_mod.create_connection(("127.0.0.1", srv.port),
+                                                 timeout=0.5)
+                c.close()
+            except OSError:
+                return
+
+    churners = [threading.Thread(target=churn) for _ in range(4)]
+    for t in churners:
+        t.start()
+    time.sleep(0.2)
+    srv.stop()  # old code: RuntimeError under churn (rarely) / leaks
+    stop_churn.set()
+    for t in churners:
+        t.join(timeout=5.0)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not any(t.is_alive() and t.name.startswith("bulk-")
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    leftovers = [t.name for t in threading.enumerate()
+                 if t.is_alive() and t.name.startswith("bulk-")]
+    assert not leftovers, leftovers

@@ -1,0 +1,332 @@
+"""Tests for the static concurrency conformance passes (ISSUE 7):
+guarded-by lint, check-then-act, blocking-under-lock, protocol drift,
+pragma/baseline mechanics — and the gate run against the real codebase.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from faabric_tpu.analysis.guards import analyze_source
+from faabric_tpu.analysis.protodrift import analyze_package
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _rules(findings):
+    return {(f.rule, f.subject) for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Guarded-by lint
+# ---------------------------------------------------------------------------
+
+def test_guarded_field_escape_is_reported():
+    src = textwrap.dedent('''
+        import threading
+
+        class C:
+            GUARDS = {"_items": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def ok(self):
+                with self._lock:
+                    return list(self._items)
+
+            def bad(self):
+                return len(self._items)
+    ''')
+    findings = analyze_source(src, "x.py")
+    assert ("guard-unlocked", "_items") in _rules(findings)
+    # The locked accessor must NOT fire
+    assert all(f.qualname != "C.ok" for f in findings)
+
+
+def test_comment_guard_annotation_and_writes():
+    src = textwrap.dedent('''
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0  # guard: self._lock
+
+            def bump(self):
+                self._count += 1
+    ''')
+    findings = analyze_source(src, "x.py")
+    assert [(f.rule, f.subject, f.qualname) for f in findings] == [
+        ("guard-unlocked", "_count", "C.bump")]
+
+
+def test_module_level_guard_map():
+    src = textwrap.dedent('''
+        import threading
+
+        _mock_lock = threading.Lock()
+        _calls = []  # guard: _mock_lock
+
+        def record(x):
+            _calls.append(x)
+
+        def record_ok(x):
+            with _mock_lock:
+                _calls.append(x)
+    ''')
+    findings = analyze_source(src, "m.py")
+    assert [(f.rule, f.qualname) for f in findings] == [
+        ("guard-unlocked", "record")]
+
+
+def test_locked_suffix_convention_assumes_lock_held():
+    src = textwrap.dedent('''
+        import threading
+
+        class C:
+            GUARDS = {"_items": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def _drain_locked(self):
+                out, self._items = self._items, []
+                return out
+    ''')
+    assert analyze_source(src, "x.py") == []
+
+
+def test_check_then_act_across_lock_release():
+    src = textwrap.dedent('''
+        import threading, time
+
+        class C:
+            GUARDS = {"_state": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = 0
+
+            def bad(self):
+                with self._lock:
+                    n = self._state
+                time.sleep(0.1)
+                if n == 0:
+                    with self._lock:
+                        self._state = 5
+
+            def good_revalidates(self):
+                with self._lock:
+                    n = self._state
+                time.sleep(0.1)
+                with self._lock:
+                    if self._state == n:
+                        self._state = 5
+    ''')
+    findings = analyze_source(src, "x.py")
+    hits = [f for f in findings if f.rule == "check-then-act"]
+    assert [f.qualname for f in hits] == ["C.bad"]
+    # Re-reading the guarded attr under the re-acquired lock (the fix
+    # pattern) is recognised as safe
+
+
+def test_blocking_call_under_lock():
+    src = textwrap.dedent('''
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_socket(self, sock):
+                with self._lock:
+                    sock.sendall(b"x")
+
+            def bad_rpc(self, client):
+                with self._lock:
+                    client.sync_send(1, {})
+
+            def bad_indefinite_wait(self, ev):
+                with self._lock:
+                    ev.wait()
+
+            def ok_bounded_wait(self, ev):
+                with self._lock:
+                    ev.wait(1.0)
+
+            def ok_no_lock(self, sock):
+                sock.sendall(b"x")
+
+            def ok_cv_wait(self):
+                with self._cv:
+                    self._cv.wait()
+    ''')
+    findings = analyze_source(src, "x.py")
+    hits = sorted((f.qualname, f.rule) for f in findings
+                  if f.rule == "blocking-under-lock")
+    assert hits == [("C.bad_indefinite_wait", "blocking-under-lock"),
+                    ("C.bad_rpc", "blocking-under-lock"),
+                    ("C.bad_socket", "blocking-under-lock")]
+
+
+def test_nested_function_starts_unlocked():
+    src = textwrap.dedent('''
+        import threading
+
+        class C:
+            GUARDS = {"_items": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def spawn(self):
+                with self._lock:
+                    def later():
+                        return len(self._items)  # runs on a thread
+                    return later
+    ''')
+    findings = analyze_source(src, "x.py")
+    # The nested def body runs later, without the lock: must be flagged
+    assert ("guard-unlocked", "_items") in _rules(findings)
+
+
+def test_pragma_suppression_whole_and_per_rule():
+    src = textwrap.dedent('''
+        import threading
+
+        class C:
+            GUARDS = {"_items": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def fast_path(self):
+                return len(self._items)  # concheck: ok
+
+            def fast_path2(self):
+                return len(self._items)  # concheck: ok(guard-unlocked)
+
+            def wrong_rule(self):
+                return len(self._items)  # concheck: ok(check-then-act)
+
+            def own_line(self):
+                # concheck: ok(guard-unlocked) — documented fast path
+                return len(self._items)
+    ''')
+    findings = analyze_source(src, "x.py")
+    assert [f.qualname for f in findings] == ["C.wrong_rule"]
+
+
+def test_fingerprint_is_line_stable():
+    src = textwrap.dedent('''
+        import threading
+
+        class C:
+            GUARDS = {"_items": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def bad(self):
+                return len(self._items)
+    ''')
+    f1 = analyze_source(src, "x.py")
+    f2 = analyze_source("\n\n\n" + src, "x.py")  # shift every line
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f2]
+    assert f1[0].line != f2[0].line
+
+
+# ---------------------------------------------------------------------------
+# Protocol drift
+# ---------------------------------------------------------------------------
+
+def _write_pkg(tmp_path, server_src: str) -> str:
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "server.py").write_text(server_src)
+    return str(tmp_path)
+
+
+def test_handlerless_enum_member_is_reported(tmp_path):
+    root = _write_pkg(tmp_path, textwrap.dedent('''
+        import enum
+
+        class DemoCalls(enum.IntEnum):
+            NO_CALL = 0
+            PING = 1
+            ORPHANED = 2
+
+        class Server:
+            def do_sync_recv(self, msg):
+                if msg.code == int(DemoCalls.PING):
+                    return "pong"
+                raise ValueError(msg.code)
+    '''))
+    findings = analyze_package(root, subdirs=("pkg",))
+    assert [(f.rule, f.subject) for f in findings] == [
+        ("unhandled-call", "ORPHANED")]
+    # NO_-prefixed null members are exempt; PING is handled
+
+
+def test_undeclared_enum_member_usage_is_reported(tmp_path):
+    root = _write_pkg(tmp_path, textwrap.dedent('''
+        import enum
+
+        class DemoCalls(enum.IntEnum):
+            NO_CALL = 0
+            PING = 1
+
+        class Server:
+            def do_sync_recv(self, msg):
+                if msg.code == int(DemoCalls.PING):
+                    return "pong"
+
+        def client_call(c):
+            c.sync_send(int(DemoCalls.PINNG))  # typo: drift
+    '''))
+    findings = analyze_package(root, subdirs=("pkg",))
+    assert ("undeclared-call-member", "DemoCalls.PINNG") in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# The real codebase + the gate CLI
+# ---------------------------------------------------------------------------
+
+def test_real_codebase_is_clean_against_baseline(capsys):
+    """The committed guard maps + pragmas keep the whole package clean
+    against tools/concheck_baseline.txt — the acceptance bar. Run the
+    actual gate entry point so the CLI plumbing is covered too."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "concheck_cli", os.path.join(REPO, "tools", "concheck.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "concheck: ok" in out
+
+
+def test_baseline_ratchet_reports_fixed_entries(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "concheck_cli", os.path.join(REPO, "tools", "concheck.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("pkg/ghost.py::C.gone::guard-unlocked::_x\n")
+    rc = mod.main(["--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 0  # stale baseline entries never fail the gate...
+    assert "fixed:" in out  # ...but are surfaced for deletion

@@ -1,0 +1,169 @@
+"""Sanitizer wiring for the native layer (ISSUE 7 satellite).
+
+``FAABRIC_NATIVE_SAN=tsan|asan`` makes ``util/native.py`` compile every
+native helper with the matching ``-fsanitize`` flag into a suffixed
+``.so``. Loading a sanitized library into an unsanitized interpreter
+requires the sanitizer runtime to come first, so these tests drive a
+SUBPROCESS with ``LD_PRELOAD=$(g++ -print-file-name=lib<san>.so)`` and
+assert (a) the exercise passes and (b) the sanitizer printed no
+reports.
+
+Exercised under the sanitizer: the SPSC shm ring across many
+wraparounds with a real producer/consumer thread pair (the atomics +
+futex protocol TSAN exists for), and segv/uffd tracker start/stop with
+a dirty-page readback (best-effort: signal-handler tracking and a
+sanitizer runtime can be mutually unavailable on some kernels — the
+script reports what it skipped, the ring part is mandatory).
+
+Slow-marked: each run pays a sanitized g++ build + an interpreter under
+interceptors.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SCRIPT = textwrap.dedent('''
+    import os, sys, threading
+    import numpy as np
+
+    os.environ.setdefault("FAABRIC_METRICS", "0")
+    from faabric_tpu.transport import shm
+
+    if not shm.shm_available():
+        print("SAN_SKIP: sanitized shm ring unavailable "
+              "(build failed or no /dev/shm)")
+        sys.exit(0)
+
+    # -- ring wraparound under a real producer/consumer pair ----------
+    r = shm.ShmRing.create("san", 1 << 14)
+    c = shm.ShmRing.attach(r.name)
+    rng = np.random.RandomState(7)
+    frames = [rng.randint(0, 256, rng.randint(1, 3000), dtype=np.uint8)
+              .astype(np.uint8) for _ in range(300)]
+    got = []
+
+    def produce():
+        for f in frames:
+            assert r.push([f], timeout=20.0)
+
+    def consume():
+        while len(got) < len(frames):
+            f = c.try_pop()
+            if f is None:
+                c.wait_data(20_000)
+            else:
+                got.append(f)
+
+    tp = threading.Thread(target=produce)
+    tc = threading.Thread(target=consume)
+    tp.start(); tc.start()
+    tp.join(60); tc.join(60)
+    assert not tp.is_alive() and not tc.is_alive(), "ring hung"
+    assert len(got) == len(frames)
+    for i, (a, b) in enumerate(zip(got, frames)):
+        # np.array_equal, NOT np.testing.assert_array_equal: the
+        # testing machinery import under TSAN interceptors takes
+        # minutes (observed: one call never finished in 90 s)
+        assert np.array_equal(a, b), f"frame {i} corrupted"
+    c.close()
+    r.close(unlink=True)
+    print("RING_OK")
+
+    # -- tracker start/stop under the sanitizer (best-effort) ----------
+    from faabric_tpu.util.dirty import SegvTracker, UffdTracker
+
+    for cls in (SegvTracker, UffdTracker):
+        try:
+            tr = cls()
+        except RuntimeError as e:
+            print(f"TRACKER_SKIP {cls.__name__}: {e}")
+            continue
+        buf = np.zeros(16 * 4096, dtype=np.uint8)
+        tr.start_tracking(buf)
+        buf[5 * 4096] = 1
+        buf[9 * 4096] = 2
+        pages = tr.get_dirty_pages(buf)
+        tr.stop_tracking(buf)
+        assert len(pages) >= 2, (cls.__name__, pages)
+        print(f"TRACKER_OK {cls.__name__}")
+
+    print("SAN_OK")
+''')
+
+_SAN_REPORT_MARKERS = (
+    "WARNING: ThreadSanitizer",
+    "ERROR: AddressSanitizer",
+    "SUMMARY: ThreadSanitizer",
+    "SUMMARY: AddressSanitizer",
+)
+
+
+def _runtime_lib(name: str) -> str | None:
+    try:
+        out = subprocess.run(["g++", f"-print-file-name=lib{name}.so"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    path = (out.stdout or "").strip()
+    # g++ echoes the bare name back when it cannot find the library
+    if not path or path == f"lib{name}.so" or not os.path.exists(path):
+        return None
+    return path
+
+
+def _run_sanitized(mode: str, lib: str) -> subprocess.CompletedProcess:
+    # Pre-build the sanitized .so WITHOUT the preload: the build
+    # subprocess strips LD_PRELOAD defensively too, but paying the g++
+    # time in a clean interpreter keeps the sanitized run's timeout for
+    # the exercise itself (the load attempt here fails cleanly — a
+    # sanitized lib needs the runtime preloaded — which is also the
+    # fallback path this satellite promises stays clean).
+    prebuild_env = dict(os.environ, FAABRIC_NATIVE_SAN=mode,
+                        JAX_PLATFORMS="cpu")
+    prebuild_env.pop("LD_PRELOAD", None)
+    subprocess.run(
+        [sys.executable, "-c",
+         "from faabric_tpu.util import native\n"
+         "native.get_shmring_lib(); native.get_segv_lib()\n"
+         "native.get_uffd_lib()"],
+        env=prebuild_env, capture_output=True, text=True, timeout=300,
+        cwd=REPO)
+    env = dict(
+        os.environ,
+        FAABRIC_NATIVE_SAN=mode,
+        LD_PRELOAD=lib,
+        JAX_PLATFORMS="cpu",
+        TSAN_OPTIONS="exitcode=66 halt_on_error=0",
+        ASAN_OPTIONS="detect_leaks=0 exitcode=66 "
+                     "allocator_may_return_null=1",
+    )
+    return subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=540,
+                          cwd=REPO)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode,libname", [("tsan", "tsan"),
+                                          ("asan", "asan")])
+def test_native_layer_under_sanitizer(mode, libname):
+    lib = _runtime_lib(libname)
+    if lib is None:
+        pytest.skip(f"lib{libname}.so not available from g++")
+    out = _run_sanitized(mode, lib)
+    text = (out.stdout or "") + (out.stderr or "")
+    assert out.returncode == 0, text[-4000:]
+    if "SAN_SKIP" in text:
+        pytest.skip(text.strip().splitlines()[0])
+    assert "RING_OK" in text, text[-4000:]
+    assert "SAN_OK" in text, text[-4000:]
+    hits = [m for m in _SAN_REPORT_MARKERS if m in text]
+    assert not hits, f"sanitizer reports under {mode}:\n{text[-6000:]}"

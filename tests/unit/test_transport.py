@@ -299,3 +299,37 @@ def test_sync_send_recovers_stale_keepalive_but_not_fresh_failure():
     finally:
         cli2.close()
         lst.close()
+
+
+def test_await_request_latch_keeps_rearmed_latch():
+    """Regression (ISSUE 7 concheck check-then-act): an awaiter clearing
+    the latch it waited on must not clobber a latch re-armed between its
+    wait() returning and the clear — only the latch it actually waited
+    on may be removed."""
+    from faabric_tpu.transport.server import MessageEndpointServer
+
+    srv = MessageEndpointServer(1, 2, label="latch-test")  # never started
+
+    class FakeLatch:
+        def __init__(self):
+            self.entered = threading.Event()
+            self.release = threading.Event()
+
+        def wait(self):
+            self.entered.set()
+            assert self.release.wait(5.0)
+
+    a = FakeLatch()
+    with srv._latch_lock:
+        srv._request_latch = a
+    t = threading.Thread(target=srv.await_request_latch)
+    t.start()
+    assert a.entered.wait(5.0)  # awaiter holds latch A, blocked in wait
+    b = FakeLatch()
+    with srv._latch_lock:
+        srv._request_latch = b  # re-armed while the awaiter is parked
+    a.release.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    # The old code unconditionally cleared to None, dropping B
+    assert srv._request_latch is b

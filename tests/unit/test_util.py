@@ -222,3 +222,56 @@ class TestConcurrentMap:
         for t in threads:
             t.join()
         assert len(m.get("k")) == 400
+
+
+def test_native_build_runs_outside_module_lock(monkeypatch):
+    """Regression (ISSUE 7 concheck blocking-under-lock): _load_native
+    used to hold the module lock across the g++ subprocess (up to 120s),
+    serializing every other native lib's first use behind it. The build
+    now runs outside the lock with per-name in-progress events: a
+    concurrent loader of a DIFFERENT lib proceeds, a concurrent loader
+    of the SAME lib parks and reuses the single build's verdict."""
+    from faabric_tpu.util import native
+
+    started = threading.Event()
+    release = threading.Event()
+    builds = []
+
+    def slow_build(name, *args, **kwargs):
+        assert not native._lock.locked(), \
+            "build must not run under the module lock"
+        builds.append(name)
+        started.set()
+        assert release.wait(5.0)
+        return None
+
+    monkeypatch.setattr(native, "_build_and_load", slow_build)
+    results = []
+
+    def load(name):
+        results.append(native._load_native(
+            name, "x.cpp", "x.so", lambda lib: None))
+
+    try:
+        t1 = threading.Thread(target=load, args=("san_test_a",))
+        t2 = threading.Thread(target=load, args=("san_test_a",))
+        t1.start()
+        assert started.wait(5.0)
+        t2.start()  # same name: parks on the in-progress event
+        # While san_test_a builds, the module lock must be free —
+        # another lib's loader can take it without blocking
+        assert native._lock.acquire(timeout=1.0)
+        native._lock.release()
+        time.sleep(0.1)
+        assert builds == ["san_test_a"]  # second loader did not rebuild
+        release.set()
+        t1.join(5.0)
+        t2.join(5.0)
+        assert not t1.is_alive() and not t2.is_alive()
+        assert results == [None, None]  # both saw the single verdict
+        assert builds == ["san_test_a"]
+    finally:
+        release.set()
+        with native._lock:
+            native._cache.pop("san_test_a", None)
+            native._in_progress.pop("san_test_a", None)

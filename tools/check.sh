@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# One-shot repo conformance gate (ISSUE 7 satellite): ruff (when
+# installed) + the concurrency conformance suite + the tier-1 failure
+# gate, each against its committed baseline.
+#
+#   tools/check.sh [--with-tests]
+#
+# Without --with-tests the failure gate re-reads the last tier-1 log at
+# /tmp/_t1.log (written by the canonical tier-1 command in ROADMAP.md);
+# with it, the tier-1 suite runs first. Exit nonzero on the first
+# failing gate.
+set -u -o pipefail
+
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo"
+rc=0
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    if ! ruff check .; then rc=1; fi
+elif python -m ruff --version >/dev/null 2>&1; then
+    if ! python -m ruff check .; then rc=1; fi
+else
+    echo "ruff not installed; skipping (pyproject.toml pins the config" \
+         "for environments that have it — do not pip install here)"
+fi
+
+echo "== concheck (guarded-by lint + protocol drift) =="
+if ! python tools/concheck.py; then rc=1; fi
+
+if [ "${1:-}" = "--with-tests" ]; then
+    echo "== tier-1 suite =="
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+    t1=${PIPESTATUS[0]}
+    if [ "$t1" -ne 0 ]; then
+        echo "tier-1 exited $t1 (failure gate decides pass/fail below)"
+    fi
+fi
+
+echo "== failure gate (tier-1 vs baseline) =="
+if [ -f /tmp/_t1.log ]; then
+    if ! python tools/failure_gate.py --log /tmp/_t1.log; then rc=1; fi
+else
+    echo "no tier-1 log at /tmp/_t1.log; run tools/check.sh --with-tests"
+    rc=1
+fi
+
+exit $rc

@@ -1,0 +1,108 @@
+"""Concurrency conformance gate: guarded-by lint + protocol drift,
+ratcheted against a committed baseline (the failure_gate pattern).
+
+    python tools/concheck.py [--baseline tools/concheck_baseline.txt]
+                             [--write-baseline] [--verbose]
+
+Runs the static passes from ``faabric_tpu/analysis`` over the package
+and diffs finding *fingerprints* (path::qualname::rule::subject — no
+line numbers, so unrelated edits don't churn the baseline) against the
+committed baseline. Exit codes:
+
+- 0: every finding is baselined (entries that no longer fire are
+  printed as shrink-the-baseline notes);
+- 1: NEW findings — concurrency-contract violations the baseline does
+  not carry. Fix them, pragma them with a justification
+  (``# concheck: ok(rule)``), or — for known pre-existing debt being
+  tracked — add the fingerprint to the baseline.
+
+``--write-baseline`` rewrites the baseline to exactly the current
+finding set (the ratchet: run it after fixing entries so the floor
+moves down and stays down).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from faabric_tpu.analysis.guards import Finding, analyze_paths  # noqa: E402
+from faabric_tpu.analysis.protodrift import analyze_package  # noqa: E402
+
+
+def collect_findings(root: str = _REPO) -> list[Finding]:
+    findings = analyze_paths(root, subdirs=("faabric_tpu",))
+    findings.extend(analyze_package(root, subdirs=("faabric_tpu",)))
+    return findings
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="concheck")
+    parser.add_argument("--baseline",
+                        default=os.path.join(_REPO, "tools",
+                                             "concheck_baseline.txt"))
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline to the current "
+                             "finding set (ratchet)")
+    parser.add_argument("--verbose", action="store_true",
+                        help="print every finding, baselined or not")
+    args = parser.parse_args(argv)
+
+    findings = collect_findings()
+    by_fp: dict[str, Finding] = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint, f)
+    current = set(by_fp)
+    baseline = load_baseline(args.baseline)
+
+    if args.write_baseline:
+        with open(args.baseline, "w") as f:
+            f.write("# concheck baseline: known findings being tracked "
+                    "as debt.\n# Fingerprints are path::qualname::rule::"
+                    "subject (no line numbers).\n# Delete entries as "
+                    "they are fixed — the gate prints candidates.\n")
+            for fp in sorted(current):
+                f.write(fp + "\n")
+        print(f"concheck: baseline rewritten with {len(current)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+
+    new = sorted(fp for fp in current if fp not in baseline)
+    fixed = sorted(fp for fp in baseline if fp not in current)
+
+    print(f"concheck: {len(current)} finding(s), baseline carries "
+          f"{len(baseline)} ({os.path.basename(args.baseline)})")
+    for fp in fixed:
+        print(f"  fixed: {fp} — no longer firing; delete it from the "
+              "baseline to ratchet the floor down")
+    if args.verbose:
+        for fp in sorted(current - set(new)):
+            print(f"  known: {by_fp[fp].render()}")
+    for fp in new:
+        print(f"  NEW FINDING: {by_fp[fp].render()}")
+        print(f"               fingerprint: {fp}")
+    if new:
+        print(f"concheck: FAILED ({len(new)} new finding(s) vs baseline)")
+        return 1
+    print("concheck: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
