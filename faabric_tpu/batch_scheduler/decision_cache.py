@@ -1,9 +1,17 @@
 """Cache of scheduling decisions for repeated fork-join shapes.
 
 Reference analog: include/faabric/batch-scheduler/DecisionCache.h:14-33.
-Keyed by (user, function, message count): a runtime that forks the same
-N-wide THREADS batch repeatedly reuses the group id and host placement
+Keyed by (user, function, batch type, message count): a runtime that
+forks the same N-wide THREADS batch repeatedly reuses the host placement
 instead of re-planning every time.
+
+ISSUE 8 promoted this cache to the invocation-ingress **admission fast
+path**: plain FUNCTIONS batches with a signature already seen skip the
+policy run entirely inside the scheduling tick and go straight to claim
++ dispatch (planner `_decision_from_cache` still validates the cached
+hosts against live capacity — a stale placement falls back to the
+policy and re-caches). Hit/miss counters feed the planner's ``/healthz``
+decision-cache block so the fast-path's effectiveness is observable.
 """
 
 from __future__ import annotations
@@ -12,6 +20,17 @@ import threading
 from typing import Optional
 
 from faabric_tpu.proto import BatchExecuteRequest
+from faabric_tpu.telemetry import get_metrics
+
+_metrics = get_metrics()
+_HITS = _metrics.counter(
+    "faabric_decision_cache_hits_total",
+    "Scheduling decisions served from the decision cache (policy run "
+    "skipped)")
+_MISSES = _metrics.counter(
+    "faabric_decision_cache_misses_total",
+    "Decision-cache lookups that fell through to the policy (absent "
+    "signature or stale capacity)")
 
 
 class CachedDecision:
@@ -33,13 +52,26 @@ class CachedDecision:
 
 
 class DecisionCache:
+    # Concurrency contract (tools/concheck.py): map + counters under the
+    # cache's own leaf lock.
+    GUARDS = {"_cache": "_lock", "_hits": "_lock", "_misses": "_lock"}
+
     def __init__(self) -> None:
         self._cache: dict[str, CachedDecision] = {}
         self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
 
     @staticmethod
     def _key(req: BatchExecuteRequest) -> str:
-        return f"{req.user}/{req.function}:{req.n_messages()}"
+        # The full fork signature: user/function, batch TYPE, tenant tag
+        # and width. Type matters since ISSUE 8 — a THREADS fork and a
+        # FUNCTIONS invocation of the same function must never share a
+        # placement row (their scheduling semantics differ) — and so does
+        # subtype: the compact policy uses it as a tenant id, and two
+        # tenants must never collide onto one cached placement.
+        return (f"{req.user}/{req.function}:{req.type}:{req.subtype}:"
+                f"{req.n_messages()}")
 
     def get_cached_decision(self, req: BatchExecuteRequest) -> Optional[CachedDecision]:
         with self._lock:
@@ -53,6 +85,26 @@ class DecisionCache:
             )
         with self._lock:
             self._cache[self._key(req)] = CachedDecision(hosts, group_id)
+
+    def record_outcome(self, hit: bool) -> None:
+        """Count one admission fast-path lookup outcome (a capacity-
+        invalidated entry counts as a miss — the policy ran)."""
+        with self._lock:
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+        (_HITS if hit else _MISSES).inc()
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "entries": len(self._cache),
+                "hits": self._hits,
+                "misses": self._misses,
+                "hitRate": round(self._hits / total, 4) if total else 0.0,
+            }
 
     def clear(self) -> None:
         with self._lock:

@@ -80,11 +80,13 @@ class PlannerHttpEndpoint:
             def do_POST(self) -> None:  # noqa: N802 — stdlib API
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                status, payload = endpoint.handle(body)
+                status, payload, extra_headers = endpoint.handle(body)
                 data = payload.encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for key, val in (extra_headers or {}).items():
+                    self.send_header(key, val)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -199,21 +201,28 @@ class PlannerHttpEndpoint:
         return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
 
     # ------------------------------------------------------------------
-    def handle(self, body: bytes) -> tuple[int, str]:
-        """(status_code, response_json) for one HttpMessage."""
+    def handle(self, body: bytes) -> tuple[int, str, dict]:
+        """(status_code, response_json, extra_headers) for one
+        HttpMessage. Handlers may return 2- or 3-tuples; the headers
+        slot carries e.g. ``Retry-After`` on a 429 shed."""
         try:
             msg = json.loads(body or b"{}")
         except json.JSONDecodeError:
-            return 400, json.dumps({"error": "Bad JSON in request"})
+            return 400, json.dumps({"error": "Bad JSON in request"}), {}
         if not isinstance(msg, dict):
-            return 400, json.dumps({"error": "Request body must be an object"})
+            return (400,
+                    json.dumps({"error": "Request body must be an object"}),
+                    {})
         http_type = msg.get("http_type", int(HttpMessageType.NO_TYPE))
         payload = msg.get("payload", "")
         try:
-            return self._dispatch(http_type, payload)
+            out = self._dispatch(http_type, payload)
         except Exception as e:  # noqa: BLE001 — REST errors cross the wire
             logger.exception("HTTP handler error (type %s)", http_type)
-            return 500, json.dumps({"error": str(e)})
+            return 500, json.dumps({"error": str(e)}), {}
+        if len(out) == 2:
+            return out[0], out[1], {}
+        return out
 
     def _dispatch(self, http_type: int, payload: str) -> tuple[int, str]:
         planner = self.planner
@@ -271,7 +280,30 @@ class PlannerHttpEndpoint:
             req = BatchExecuteRequest.from_dict(json.loads(payload))
             if not is_batch_exec_request_valid(req):
                 return 400, json.dumps({"error": "Bad BatchExecRequest"})
-            decision = planner.call_batch(req)
+            # Through the invocation ingress (ISSUE 8): admission
+            # control + batched scheduling ticks. Sources are tenants
+            # (the request's user) — one runaway tenant sheds before it
+            # can starve the others. A lone request takes the immediate
+            # cutover path, so interactive latency is unchanged.
+            from faabric_tpu.ingress import IngressShedError
+
+            try:
+                # Queue wait bounded to ~1s: each waiting REST request
+                # parks a live ThreadingHTTPServer thread, and a full
+                # cluster must answer "No available hosts" promptly
+                # (pre-ingress semantics) instead of accumulating up to
+                # a queue-bound's worth of parked HTTP threads
+                decision = planner.ingress.submit(
+                    req, source=req.user or "rest", timeout=1.0)
+            except IngressShedError as e:
+                # Load shedding, not failure: bounded queue + explicit
+                # backpressure instead of collapse. Retry-After is the
+                # backlog-scaled hint admission computed.
+                return (429, json.dumps({
+                    "error": "Overloaded: invocation shed",
+                    "reason": e.reason,
+                    "retryAfterSeconds": round(e.retry_after, 3),
+                }), {"Retry-After": str(max(1, int(e.retry_after + 0.5)))})
             if decision.app_id == NOT_ENOUGH_SLOTS:
                 return 500, json.dumps({"error": "No available hosts"})
             if decision.app_id == MUST_FREEZE:

@@ -1,0 +1,20 @@
+"""High-QPS invocation ingress (ISSUE 8).
+
+Admission control + batched scheduling ticks between the endpoints
+(HTTP REST, planner RPC) and the planner core. See admission.py and
+tick.py module docs, and docs/invocation_path.md for the architecture.
+"""
+
+from faabric_tpu.ingress.admission import (
+    AdmissionController,
+    AdmissionVerdict,
+    IngressShedError,
+)
+from faabric_tpu.ingress.tick import IngressCoordinator
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionVerdict",
+    "IngressCoordinator",
+    "IngressShedError",
+]

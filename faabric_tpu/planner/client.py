@@ -158,6 +158,8 @@ class PlannerClient(MessageEndpointClient):
         "_pending_bytes": "_pending_lock",
         "_recent_results": "_pending_lock",
         "_recent_bytes": "_pending_lock",
+        "_out_results": "_pending_lock",
+        "_out_sending": "_pending_lock",
     }
 
     def __init__(self, this_host: str = "",
@@ -199,6 +201,14 @@ class PlannerClient(MessageEndpointClient):
         self._pending_results: list[Message] = []
         self._pending_bytes = 0
         self._recent_bytes = 0
+        # Result coalescing (ISSUE 8): results that arrive while a push
+        # RPC is already in flight queue here and ride the NEXT push as
+        # one batched frame — group commit by contention. Zero added
+        # latency when idle (an uncontended result sends inline exactly
+        # as before); at high QPS the result plane automatically
+        # batches instead of paying one RPC per result.
+        self._out_results: list[Message] = []
+        self._out_sending = False
         # Recently async-pushed results (bounded by count AND age): a
         # result written into the kernel buffer of a connection whose
         # planner just died is silently lost — the send "succeeds", the
@@ -347,8 +357,47 @@ class PlannerClient(MessageEndpointClient):
                         req.snapshot_key, snap)
 
         header, tail = ber_to_wire(req)
-        resp = self.sync_send(int(PlannerCalls.CALL_BATCH), {"ber": header}, tail)
+        # The host identity keys per-source admission credits on the
+        # ingress — without it every sync caller would share one
+        # anonymous credit bucket
+        resp = self.sync_send(int(PlannerCalls.CALL_BATCH),
+                              {"ber": header, "host": self.this_host},
+                              tail)
         return SchedulingDecision.from_dict(resp.header["decision"])
+
+    def submit_functions(self, req: BatchExecuteRequest
+                         ) -> tuple[bool, float]:
+        """High-QPS submission (ISSUE 8): enqueue the batch into the
+        planner's ingress and return ``(accepted, retry_after)``
+        immediately — no scheduling decision in the response. The
+        planner's tick batches admitted invocations; results arrive
+        through the normal result plane (``get_batch_results`` /
+        ``get_message_result``). ``accepted=False`` means admission
+        shed the batch — back off ``retry_after`` seconds and retry."""
+        return self.submit_functions_many([req])
+
+    def submit_functions_many(self, reqs: list[BatchExecuteRequest]
+                              ) -> tuple[bool, float]:
+        """Bulk high-QPS submission: many INDEPENDENT apps in one RPC
+        (the client-side analog of the planner's pipelined dispatch —
+        at thousands of invocations per second, one sync round-trip per
+        invocation is the client's dominant cost). Admission is
+        all-or-nothing for the bulk: size submissions modestly and back
+        off ``retry_after`` on a shed."""
+        if not reqs:
+            return True, 0.0
+        if is_mock_mode():
+            with _mock_lock:
+                _mock_batch_calls.extend(reqs)
+            return True, 0.0
+        from faabric_tpu.proto import bers_to_wire
+
+        header, tail = bers_to_wire(reqs)
+        header["host"] = self.this_host
+        resp = self.sync_send(int(PlannerCalls.SUBMIT_BATCH), header,
+                              tail)
+        return (bool(resp.header.get("accepted")),
+                float(resp.header.get("retry_after", 0.0)))
 
     # ------------------------------------------------------------------
     def set_message_result(self, msg: Message) -> None:
@@ -364,28 +413,94 @@ class PlannerClient(MessageEndpointClient):
         # read only costs one early/late flush attempt
         if self._pending_results:
             self.flush_pending_results()
+        with self._pending_lock:
+            self._out_results.append(msg)
+            if self._out_sending:
+                # Another thread's push RPC is in flight; it drains the
+                # queue when it finishes — this result rides the next
+                # frame (coalesced result plane, ISSUE 8)
+                return
+            self._out_sending = True
+        self._drain_out_results()
+
+    def _drain_out_results(self) -> None:
+        """Owner loop of the coalesced result plane: send whatever has
+        accumulated as ONE batched push, and keep going until the queue
+        is empty (results that landed during the send ride the next
+        frame). Exactly one thread owns this loop at a time
+        (_out_sending) — which is why EVERY exit path, including an
+        unexpected exception, must clear the flag: a wedged True would
+        silently park every future result on this worker forever."""
         try:
-            dicts, tail = messages_to_wire([msg])
-            retried = self.async_send(int(PlannerCalls.SET_MESSAGE_RESULT),
-                                      {"msg": dicts[0]}, tail)
-        except RpcError:
-            self._buffer_result(msg)
-        else:
+            while True:
+                with self._pending_lock:
+                    batch = self._out_results
+                    self._out_results = []
+                    if not batch:
+                        self._out_sending = False
+                        return
+                try:
+                    dicts, tail = messages_to_wire(batch)
+                    header = ({"msg": dicts[0]} if len(dicts) == 1
+                              else {"msgs": dicts})
+                    retried = self.async_send(
+                        int(PlannerCalls.SET_MESSAGE_RESULT), header, tail)
+                except RpcError:
+                    for m in batch:
+                        self._buffer_result(m)
+                    continue
+                except Exception:  # noqa: BLE001 — one poison message
+                    # (unencodable field) must not sink the batch, and
+                    # must not wedge the drain loop: retry each result
+                    # alone, dropping only the poison (matches the
+                    # pre-coalescing behavior where the bad message
+                    # raised out of its own push and was lost alone)
+                    logger.exception(
+                        "Batched result push from %s failed; retrying "
+                        "the %d result(s) individually", self.this_host,
+                        len(batch))
+                    self._push_results_individually(batch)
+                    continue
+                with self._pending_lock:
+                    for m in batch:
+                        self._remember_result_locked(m)
+                if retried:
+                    # The frame only went out after a reconnect: an
+                    # EARLIER result pushed on the old connection may
+                    # have died in the old peer's kernel buffer (that
+                    # write "succeeded"; only this one saw the error).
+                    # Re-deliver the recent window through the confirmed
+                    # flush — the planner's first-write-wins dedups
+                    # everything that did land.
+                    logger.warning(
+                        "Result push from %s needed a reconnect; "
+                        "re-delivering the recent result window",
+                        self.this_host)
+                    self.requeue_recent_results()
+                    self.flush_pending_results()
+        except BaseException:
+            # Abnormal exit (should be unreachable — kept so the
+            # ownership flag can never stay latched)
             with self._pending_lock:
-                self._remember_result_locked(msg)
-            if retried:
-                # The frame only went out after a reconnect: an EARLIER
-                # result pushed on the old connection may have died in
-                # the old peer's kernel buffer (that write "succeeded";
-                # only this one saw the error). Re-deliver the recent
-                # window through the confirmed flush — the planner's
-                # first-write-wins dedups everything that did land.
-                logger.warning(
-                    "Result push from %s needed a reconnect; "
-                    "re-delivering the recent result window",
-                    self.this_host)
-                self.requeue_recent_results()
-                self.flush_pending_results()
+                self._out_sending = False
+            raise
+
+    def _push_results_individually(self, batch: list[Message]) -> None:
+        """Fallback for a failed coalesced frame: one push per result so
+        only the genuinely unsendable message is dropped."""
+        for m in batch:
+            try:
+                dicts, tail = messages_to_wire([m])
+                self.async_send(int(PlannerCalls.SET_MESSAGE_RESULT),
+                                {"msg": dicts[0]}, tail)
+            except RpcError:
+                self._buffer_result(m)
+            except Exception:  # noqa: BLE001
+                logger.exception("Dropping unsendable result %d from %s",
+                                 m.id, self.this_host)
+            else:
+                with self._pending_lock:
+                    self._remember_result_locked(m)
 
     def _remember_result_locked(self, msg: Message) -> None:
         now = time.monotonic()
@@ -704,6 +819,7 @@ class PlannerClient(MessageEndpointClient):
         with self._pending_lock:
             self._pending_results.clear()
             self._recent_results.clear()
+            self._out_results.clear()
             self._pending_bytes = 0
             self._recent_bytes = 0
 

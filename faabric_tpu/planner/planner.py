@@ -143,10 +143,10 @@ class Planner:
     # mutating atomically, and the hot path (one dict hit per RPC) does
     # not contend enough to shard it. NOT listed: boot_id (immutable
     # after __init__), _telemetry_scrapes (GIL-atomic setdefault/pop by
-    # design), _clients/_snapshot_clients/_journal/snapshot_registry
-    # (internally synchronized), _journal_replay_stats/_reconcile_stats
-    # (write-once diagnostics), _reconcile_timer (start/stop sequenced
-    # by recovery).
+    # design), _clients/_snapshot_clients/_journal/snapshot_registry/
+    # ingress (internally synchronized), _journal_replay_stats/
+    # _reconcile_stats (write-once diagnostics), _reconcile_timer
+    # (start/stop sequenced by recovery).
     GUARDS = {
         "_hosts": "_lock",
         "_in_flight": "_lock",
@@ -164,6 +164,8 @@ class Planner:
         "_state_masters": "_lock",
         "_device_plane": "_lock",
         "_journal_last_hosts": "_lock",
+        "_results_count": "_lock",
+        "_results_failed": "_lock",
     }
 
     def __init__(self) -> None:
@@ -190,6 +192,12 @@ class Planner:
         self._next_idx: dict[int, int] = {}
         # Completed apps in completion order, for bounded result retention
         self._completed_order: list[int] = []
+        # Results recorded this incarnation (monotonic; /healthz
+        # resultsTotal — what a high-QPS driver polls for completion)
+        # and how many of them were FAILED (a driver counting
+        # completions must be able to tell success from shed/failure)
+        self._results_count = 0
+        self._results_failed = 0
         # (app_id, msg_id) → hosts to push the result to
         self._waiters: dict[tuple[int, int], set[str]] = {}
         # app_id → recovery requeues already spent (bounded by
@@ -249,6 +257,14 @@ class Planner:
         self._reconcile_timer: Optional[threading.Timer] = None
         if self._journal.enabled:
             self._recover_from_journal()
+
+        # High-QPS invocation ingress (ISSUE 8): admission control +
+        # batched scheduling ticks between the endpoints and call_batch.
+        # Internally synchronized; its tick thread starts lazily on the
+        # first batched submission and is stopped by PlannerServer.
+        from faabric_tpu.ingress import IngressCoordinator
+
+        self.ingress = IngressCoordinator(self)
 
     # ------------------------------------------------------------------
     # Host membership (reference Planner.cpp:267-392)
@@ -495,7 +511,7 @@ class Planner:
                             and decision_type == DecisionType.NEW)
             from_cache = False
             if decision is None and is_cacheable:
-                decision = self._decision_from_cache(req, host_map)
+                decision = self._decision_from_cache_locked(req, host_map)
                 from_cache = decision is not None
 
             if decision is None:
@@ -564,6 +580,215 @@ class Planner:
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         return result
+
+    # ------------------------------------------------------------------
+    # Batched scheduling ticks (ISSUE 8): the ingress coordinator hands
+    # a whole tick's worth of NEW invocations to call_batch_group — one
+    # lock pass, one host-map build + expiry sweep, the decision cache
+    # as an admission fast path, one group-commit journal record, and
+    # pipelined (per-host) mapping + dispatch RPCs.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def is_batchable_shape(req: BatchExecuteRequest) -> bool:
+        """Lock-free half of the tick-eligibility check: a plain
+        FUNCTIONS/PROCESSES batch with no MPI messages. The admission
+        hot path uses ONLY this — probing planner state there would
+        serialize every submission behind in-progress scheduling ticks,
+        and the tick pass re-checks statefully under the lock anyway
+        (requests that turn out to be scale-changes etc. are deferred
+        to the classic path)."""
+        if req.type not in (int(BatchExecuteType.FUNCTIONS),
+                            int(BatchExecuteType.PROCESSES)):
+            return False
+        return bool(req.messages) and not any(m.is_mpi
+                                              for m in req.messages)
+
+    def call_batch_group(self, reqs: list[BatchExecuteRequest]
+                         ) -> tuple[list[Optional[SchedulingDecision]],
+                                    set[int]]:
+        """Schedule one tick's batch of NEW invocations.
+
+        Returns ``(results, deferred)``: ``results[i]`` is the detached
+        decision clone, or ``None`` when the cluster had no capacity
+        this tick (the caller requeues — slots free as results land);
+        indices in ``deferred`` raced out of batch eligibility and must
+        go through the classic ``call_batch``.
+
+        Against the per-request path this amortises: ONE planner-lock
+        acquisition and host-map/expiry pass for the whole batch, the
+        decision cache as an admission fast path (a repeat signature
+        skips the policy run), ONE group-commit journal record, and
+        dispatch/mapping RPCs coalesced per host by the caller-facing
+        tail of this method."""
+        from faabric_tpu.batch_scheduler import get_decision_cache
+        from faabric_tpu.proto import update_batch_exec_app_id
+
+        results: list[Optional[SchedulingDecision]] = [None] * len(reqs)
+        deferred: set[int] = set()
+        mapping_clones: list[SchedulingDecision] = []
+        dispatch_groups: dict[str, list[BatchExecuteRequest]] = {}
+        journal_apps: list[int] = []
+        cache = get_decision_cache()
+        t0 = time.monotonic()
+        with span("planner", "call_batch_group", n_requests=len(reqs)):
+            with self._lock:
+                scheduler = get_batch_scheduler()
+                # ONE shared host-map view for the whole tick (includes
+                # the expiry sweep), updated in place as claims land —
+                # vs one build per request on the classic path
+                view = self._policy_host_map_locked()
+                # Free-slot watermark: when the cluster cannot fit a
+                # request, it goes straight to the backlog WITHOUT a
+                # policy run (or a cache lookup) — a full cluster must
+                # make a tick cost one int compare per queued entry,
+                # not one policy pass each (slots free as results land;
+                # the next tick retries)
+                free = sum(max(0, h.slots - h.used_slots)
+                           for h in view.values())
+                for i, req in enumerate(reqs):
+                    update_batch_exec_app_id(req, req.app_id)
+                    decision_type = scheduler.get_decision_type(
+                        self._in_flight, req)
+                    if (decision_type != DecisionType.NEW
+                            or req.app_id in self._evicted
+                            or req.app_id in self._preloaded):
+                        deferred.add(i)
+                        continue
+                    if req.n_messages() > free:
+                        continue  # results[i] stays None: backlog
+                    decision = self._decision_from_cache_locked(req, view)
+                    from_cache = decision is not None
+                    cache.record_outcome(from_cache)
+                    if decision is None:
+                        decision = scheduler.make_scheduling_decision(
+                            view, self._in_flight, req)
+                    if decision.app_id == NOT_ENOUGH_SLOTS:
+                        continue  # results[i] stays None: backlog
+                    if is_sentinel_decision(decision):
+                        deferred.add(i)
+                        continue
+                    if not from_cache:
+                        cache.add_cached_decision(
+                            req, list(decision.hosts), 0)
+                    decision, mappings, dispatches = \
+                        self._handle_new_locked(req, decision)
+                    free -= decision.n_messages
+                    for ip in decision.hosts:
+                        h = view.get(ip)
+                        if h is not None:
+                            h.used_slots += 1
+                    results[i] = decision.clone()
+                    mapping_clones.append(mappings.clone())
+                    gids, hosts = self._group_hosts.get(
+                        req.app_id, (set(), set()))
+                    self._group_hosts[req.app_id] = (
+                        gids | {mappings.group_id},
+                        hosts | set(mappings.hosts))
+                    journal_apps.append(req.app_id)
+                    for ip, sub in dispatches:
+                        dispatch_groups.setdefault(ip, []).append(sub)
+                if journal_apps and self._journal.enabled:
+                    self._journal_group_commit_locked(journal_apps)
+                _IN_FLIGHT_APPS.set(len(self._in_flight))
+            # Network strictly outside the lock, coalesced per host:
+            # mappings first (guest code blocks on wait_for_mappings
+            # before messaging), then ONE dispatch RPC per (host, tick)
+            if mapping_clones:
+                from faabric_tpu.transport.ptp_remote import (
+                    send_mappings_for_decisions,
+                )
+
+                send_mappings_for_decisions(mapping_clones)
+            self._do_dispatch_pipelined(dispatch_groups)
+        if journal_apps:
+            _SCHEDULE_SECONDS.observe(
+                (time.monotonic() - t0) / len(journal_apps))
+        return results, deferred
+
+    def _journal_group_commit_locked(self, app_ids: list[int]) -> None:
+        """ONE group-commit journal record for the tick's scheduling
+        mutations (vs one write-through append per app): same
+        durability class as ``append_durable`` — in the kernel before
+        dispatch — inside a single fsync boundary."""
+        j = self._journal
+        j.append_group([("app_update", self._app_update_fields_locked(a))
+                        for a in app_ids])
+        if j.since_compact >= j.compact_records:
+            with span("journal", "compact", records=j.since_compact):
+                j.compact(self._journal_snapshot_locked())
+
+    def _do_dispatch_pipelined(
+            self, groups: dict[str, list[BatchExecuteRequest]]) -> None:
+        """One EXECUTE_BATCHES RPC per (host, tick) carrying every
+        sub-batch bound for that host, instead of one RPC per app. A
+        failed host fans its sub-batches into the normal dispatch
+        recovery (requeue onto survivors)."""
+        if not groups:
+            return
+        t0 = time.monotonic()
+
+        def dispatch_one(ip: str, subs: list[BatchExecuteRequest]) -> None:
+            try:
+                if _FAULTS:
+                    verdict = _FP_DISPATCH.fire(
+                        host=ip, app_id=subs[0].app_id)
+                    if verdict is DROP:
+                        return
+                self._get_client(ip).execute_functions_many(subs)
+            except Exception:  # noqa: BLE001 — a dead host must not
+                # stall the tick's other hosts
+                logger.exception(
+                    "Pipelined dispatch of %d app(s) to %s failed",
+                    len(subs), ip)
+                for sub in subs:
+                    self._recover_dispatch(sub, ip, b"Dispatch failed")
+                return
+            logger.debug("Dispatched %d app(s) (%d msgs) to %s in "
+                         "one RPC", len(subs),
+                         sum(s.n_messages() for s in subs), ip)
+
+        with span("planner", "dispatch_pipelined", n_hosts=len(groups)):
+            if len(groups) == 1:
+                ip, subs = next(iter(groups.items()))
+                dispatch_one(ip, subs)
+            else:
+                # Hosts dispatch concurrently: the per-host RPCs run on
+                # the shared tick thread, and serially one unreachable
+                # host's connect/send timeout would head-of-line-block
+                # every healthy host's frame AND all subsequent ticks.
+                # Joined: a slow host costs one socket timeout, never an
+                # unbounded dispatcher-thread pileup.
+                workers = [threading.Thread(
+                    target=dispatch_one, args=(ip, subs),
+                    name=f"dispatch-{ip}", daemon=True)
+                    for ip, subs in groups.items()]
+                for w in workers:
+                    w.start()
+                for w in workers:
+                    w.join()
+        _DISPATCH_SECONDS.observe(time.monotonic() - t0)
+
+    def fail_unscheduled(self, req: BatchExecuteRequest,
+                         reason: bytes) -> None:
+        """Terminal path for a fire-and-forget submission shed before it
+        was ever scheduled: record the expected count and FAILED results
+        so batch-status pollers finish instead of hanging on an app the
+        planner never placed."""
+        with self._lock:
+            if req.app_id in self._in_flight:
+                return  # a schedule won the race; results arrive normally
+            self._expected.setdefault(req.app_id, req.n_messages())
+        for m in req.messages:
+            m.return_value = int(ReturnValue.FAILED)
+            m.output_data = reason
+        try:
+            self.set_message_results(req.messages)
+        except Exception:  # noqa: BLE001
+            logger.exception("Failing unscheduled app %d", req.app_id)
+        with self._lock:
+            if req.app_id not in self._completed_order:
+                self._completed_order.append(req.app_id)
+                self._evict_old_results_locked()
 
     # -- decision handling (all run under self._lock; they return the
     # mapping distribution + dispatches to perform after the lock drops) --
@@ -1003,11 +1228,15 @@ class Planner:
             args=(sub.app_id, list(sub.messages), reason),
             name=f"recover-{sub.app_id}", daemon=True).start()
 
-    def _decision_from_cache(self, req: BatchExecuteRequest,
+    def _decision_from_cache_locked(self, req: BatchExecuteRequest,
                              host_map) -> Optional[SchedulingDecision]:
         """Rebuild a decision from the cached placement of an identical
-        fork shape, if the cached hosts still have capacity."""
-        from faabric_tpu.batch_scheduler import get_decision_cache
+        fork shape, if the cached hosts still have capacity AND still
+        pass the active policy's host filter."""
+        from faabric_tpu.batch_scheduler import (
+            get_batch_scheduler,
+            get_decision_cache,
+        )
 
         cached = get_decision_cache().get_cached_decision(req)
         if cached is None:
@@ -1021,6 +1250,16 @@ class Planner:
             if h is None or h.available < n or h.for_eviction:
                 # Topology changed / host leaving: fall back to the policy
                 return None
+        # The policy's filter is part of placement correctness, not just
+        # preference — compact uses it for tenant isolation (a cached
+        # host may have acquired ANOTHER tenant's app since the entry
+        # was written), spot for eviction. Probe it with just the needed
+        # hosts: any removal invalidates the cached placement. The
+        # default (bin-pack) filter is a no-op, so the steady-state fast
+        # path pays one tiny dict build.
+        probe = {ip: host_map[ip] for ip in need}
+        if get_batch_scheduler().filter_hosts(probe, self._in_flight, req):
+            return None
         decision = SchedulingDecision(req.app_id, 0)
         for i, msg in enumerate(req.messages):
             decision.add_message(hosts[i], msg.id, msg.app_idx,
@@ -1167,47 +1406,67 @@ class Planner:
     # Results (reference Planner::setMessageResult / getMessageResult)
     # ------------------------------------------------------------------
     def set_message_result(self, msg: Message) -> None:
-        redispatch = None
+        self.set_message_results([msg])
+
+    def set_message_results(self, msgs: list[Message]) -> None:
+        """Record one or many results. The batched form is the receive
+        side of the coalesced result plane (ISSUE 8): one planner-lock
+        pass over the whole frame, waiter pushes collected and sent
+        after the lock, and group cleanups coalesced into ONE
+        clear-groups RPC per host instead of one per completed app."""
+        pushes: list[tuple] = []  # (client, msg)
+        cleanups: dict[str, set[int]] = {}  # host → finished group ids
+        redispatches: list[tuple] = []
         with self._lock:
-            app_id, msg_id = msg.app_id, msg.id
+            for msg in msgs:
+                app_id, msg_id = msg.app_id, msg.id
 
-            migrated = msg.return_value == int(ReturnValue.MIGRATED)
-            frozen = msg.return_value == int(ReturnValue.FROZEN)
-            if migrated:
-                # The rank vacated its old host; its new placement is
-                # already in the post-migration decision — re-dispatch it
-                # there as a MIGRATION batch (reference §3.5)
-                redispatch = self._build_migration_redispatch_locked(app_id, msg_id)
-            if not migrated and not frozen:
-                if not self._record_result_locked(msg):
-                    return
-                if self._journal.enabled:
-                    # Lazy fields: the drain thread runs to_dict. Safe —
-                    # a stored result is never mutated afterwards (the
-                    # first-write-wins store is also the read source)
-                    self._journal_append_fields(
-                        "result", lambda m=msg: {"msg": m.to_dict()})
+                migrated = msg.return_value == int(ReturnValue.MIGRATED)
+                frozen = msg.return_value == int(ReturnValue.FROZEN)
+                if migrated:
+                    # The rank vacated its old host; its new placement
+                    # is already in the post-migration decision —
+                    # re-dispatch it there as a MIGRATION batch
+                    # (reference §3.5)
+                    redispatch = self._build_migration_redispatch_locked(
+                        app_id, msg_id)
+                    if redispatch is not None:
+                        redispatches.append(redispatch)
+                if not migrated and not frozen:
+                    if not self._record_result_locked(msg):
+                        continue
+                    if self._journal.enabled:
+                        # Lazy fields: the drain thread runs to_dict.
+                        # Safe — a stored result is never mutated
+                        # afterwards (the first-write-wins store is
+                        # also the read source)
+                        self._journal_append_fields(
+                            "result", lambda m=msg: {"msg": m.to_dict()})
 
-            waiters = self._waiters.pop((app_id, msg_id), set())
-            clients = [self._get_client(ip) for ip in waiters]
-            group_cleanup = None
-            if app_id not in self._in_flight:
-                group_cleanup = self._group_hosts.pop(app_id, None)
+                waiters = self._waiters.pop((app_id, msg_id), set())
+                for ip in waiters:
+                    pushes.append((self._get_client(ip), msg))
+                if app_id not in self._in_flight:
+                    group_cleanup = self._group_hosts.pop(app_id, None)
+                    if group_cleanup is not None:
+                        gids, hosts = group_cleanup
+                        for host in hosts:
+                            cleanups.setdefault(host, set()).update(gids)
 
         # Push results + group cleanup outside the lock (network)
-        for client in clients:
+        for client, msg in pushes:
             try:
                 client.set_message_result(msg)
             except Exception:  # noqa: BLE001
-                logger.exception("Failed pushing result %d to waiter", msg_id)
-        if group_cleanup is not None:
-            from faabric_tpu.transport.ptp_remote import send_clear_group
+                logger.exception("Failed pushing result %d to waiter",
+                                 msg.id)
+        if cleanups:
+            from faabric_tpu.transport.ptp_remote import send_clear_groups
 
-            gids, hosts = group_cleanup
-            for gid in gids:
-                send_clear_group(gid, sorted(hosts))
+            for host, gids in cleanups.items():
+                send_clear_groups(host, sorted(gids))
 
-        if redispatch is not None:
+        for redispatch in redispatches:
             self._do_dispatch([redispatch])
 
     def _record_result_locked(self, msg: Message,
@@ -1231,6 +1490,9 @@ class Planner:
         self._release_message_locked(app_id, msg_id)
         self._results.setdefault(app_id, {})[msg_id] = msg
         if not replay:
+            self._results_count += 1
+            if msg.return_value == int(ReturnValue.FAILED):
+                self._results_failed += 1
             _RESULTS_TOTAL.inc()
             if msg.timestamp:
                 _RESULT_ROUNDTRIP.observe(
@@ -1391,13 +1653,14 @@ class Planner:
             with span("journal", "compact", records=j.since_compact):
                 j.compact(self._journal_snapshot_locked())
 
-    def _journal_app_update_locked(self, app_id: int) -> None:
-        """Journal the app's live in-flight record (request + decision +
-        index bookkeeping) — the one record kind that captures
-        scheduling mutations of every decision type, including requeue
-        merges. If the app already completed (fast tasks can finish
-        before call_batch re-takes the lock), only the expected count is
-        durable — its results carry the rest."""
+    def _app_update_fields_locked(self, app_id: int) -> dict:
+        """One app_update record's fields: the app's live in-flight
+        record (request + decision + index bookkeeping) — the one
+        record kind that captures scheduling mutations of every
+        decision type, including requeue merges. If the app already
+        completed (fast tasks can finish before call_batch re-takes the
+        lock), only the expected count is durable — its results carry
+        the rest."""
         fields: dict = {
             "app_id": app_id,
             "expected": self._expected.get(app_id, 0),
@@ -1410,7 +1673,11 @@ class Planner:
             req, decision = in_flight
             fields["req"] = req.to_dict()
             fields["decision"] = decision.to_dict()
-        self._journal_append("app_update", **fields)
+        return fields
+
+    def _journal_app_update_locked(self, app_id: int) -> None:
+        self._journal_append("app_update",
+                             **self._app_update_fields_locked(app_id))
 
     def _journal_snapshot_locked(self) -> dict:
         """The full durable state, as one JSON-serializable dict — the
@@ -1523,6 +1790,13 @@ class Planner:
             self._state_masters[rec["key"]] = rec["host"]
         elif kind == "state_drop":
             self._state_masters.pop(rec["key"], None)
+        elif kind == "group":
+            # Group commit (ISSUE 8): one tick's scheduling-class
+            # records coalesced into one on-disk record. Atomic by the
+            # record CRC — a torn tail drops the whole tick — and
+            # idempotent because every sub-branch is.
+            for sub in rec.get("recs") or []:
+                self._apply_journal_record_locked(sub)
         elif kind == "requeued":
             pass  # forensic marker; state rides in its app_update
         elif kind == "flush_scheduling":
@@ -1768,6 +2042,8 @@ class Planner:
             in_flight_apps = len(self._in_flight)
             in_flight_messages = sum(
                 d.n_messages for _, d in self._in_flight.values())
+            results_total = self._results_count
+            results_failed = self._results_failed
         # Breaker states live on the pooled dispatch clients; a host with
         # no client yet simply has no breaker row
         breakers = {}
@@ -1792,11 +2068,20 @@ class Planner:
             journal["lastReplay"] = self._journal_replay_stats
         if self._reconcile_stats is not None:
             journal["lastReconcile"] = self._reconcile_stats
+        from faabric_tpu.batch_scheduler import get_decision_cache
+
         return {
             "status": "ok",
             "hosts": hosts,
             "inFlightApps": in_flight_apps,
             "inFlightMessages": in_flight_messages,
+            "resultsTotal": results_total,
+            "resultsFailed": results_failed,
+            # ISSUE 8 satellite: admission-queue depth/shed, tick
+            # occupancy and the decision-cache hit rate, so an operator
+            # can see the ingress breathe under load
+            "ingress": self.ingress.stats(),
+            "decisionCache": get_decision_cache().stats(),
             "journal": journal,
         }
 
@@ -1919,6 +2204,11 @@ class Planner:
 
         get_decision_cache().clear()
         close_mapping_clients()
+        # AFTER the wipe: shed_all records terminal FAILED results for
+        # fire-and-forget submissions still queued at reset time — done
+        # before the wipe those results would be erased and their
+        # batch-status pollers would hang forever
+        self.ingress.shed_all("planner reset")
 
     def flush_scheduling_state(self) -> None:
         with self._lock:

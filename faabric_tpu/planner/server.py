@@ -69,6 +69,13 @@ class PlannerCalls(enum.IntEnum):
     # independent of the worker-pair link, so the far side learns of
     # the abort in bounded time instead of waiting out a socket timeout
     RELAY_GROUP_ABORT = 17
+    # High-QPS submission (ISSUE 8): enqueue an invocation into the
+    # ingress admission queue and return IMMEDIATELY with the admission
+    # verdict — the scheduling tick batches it; results flow back
+    # through the normal result plane. Unlike CALL_BATCH the response
+    # does not carry a decision, so thousands of submissions per second
+    # never serialize behind scheduling.
+    SUBMIT_BATCH = 18
 
 
 class PlannerServer(MessageEndpointServer):
@@ -98,6 +105,9 @@ class PlannerServer(MessageEndpointServer):
         from faabric_tpu.faults import set_fault_identity
 
         set_fault_identity("planner")
+        # Re-arm the (possibly previously stopped) ingress coordinator
+        # before the transport can deliver submissions
+        self.planner.ingress.start()
         super().start()
         self.snapshot_server.start()
         # Check at quarter-timeout: worst-case detection latency stays
@@ -108,6 +118,10 @@ class PlannerServer(MessageEndpointServer):
     def stop(self) -> None:
         self.expiry_reaper.stop()
         self.snapshot_server.stop()
+        # Stop the ingress tick thread BEFORE the transport: queued
+        # invocations resolve as unschedulable rather than dispatching
+        # into a closing server
+        self.planner.ingress.stop()
         super().stop()
         # Clean stop: drain the write-behind buffer, fsync, and release
         # the journal fd + drain thread (in-process start/stop cycles
@@ -117,8 +131,12 @@ class PlannerServer(MessageEndpointServer):
     # ------------------------------------------------------------------
     def do_async_recv(self, msg: TransportMessage) -> None:
         if msg.code == int(PlannerCalls.SET_MESSAGE_RESULT):
-            result = messages_from_wire([msg.header["msg"]], msg.payload)[0]
-            self.planner.set_message_result(result)
+            # Single ("msg") or a coalesced frame ("msgs", ISSUE 8):
+            # workers batch results that complete while a push RPC is
+            # already in flight
+            dicts = msg.header.get("msgs") or [msg.header["msg"]]
+            results = messages_from_wire(dicts, msg.payload)
+            self.planner.set_message_results(results)
         elif msg.code == int(PlannerCalls.RELAY_GROUP_ABORT):
             self._relay_group_abort(int(msg.header["group_id"]),
                                     str(msg.header.get("reason", "")),
@@ -228,8 +246,43 @@ class PlannerServer(MessageEndpointServer):
 
         if code == int(PlannerCalls.CALL_BATCH):
             req = ber_from_wire(msg.header["ber"], msg.payload)
-            decision = self.planner.call_batch(req)
+            # Through the ingress (ISSUE 8): a lone call takes the
+            # immediate cutover (classic call_batch latency); concurrent
+            # callers batch into one scheduling tick. Ineligible
+            # requests (MPI/THREADS/migrations/scale) pass straight
+            # through. A shed maps to NOT_ENOUGH_SLOTS on this plane —
+            # the REST surface is where 429 + Retry-After lives. The
+            # queue wait is capped at ~2 ticks: this sync plane has a
+            # small worker pool, and a full cluster must keep answering
+            # NOT_ENOUGH_SLOTS promptly (pre-ingress semantics) instead
+            # of parking server threads that keep-alives need.
+            from faabric_tpu.batch_scheduler.decision import (
+                not_enough_slots_decision,
+            )
+            from faabric_tpu.ingress import IngressShedError
+            from faabric_tpu.util.config import get_system_config
+
+            wait_s = max(0.05, get_system_config().planner_tick_ms / 250)
+            try:
+                decision = self.planner.ingress.submit(
+                    req, source=h.get("host", ""), timeout=wait_s)
+            except IngressShedError:
+                decision = not_enough_slots_decision()
             return handler_response(header={"decision": decision.to_dict()})
+
+        if code == int(PlannerCalls.SUBMIT_BATCH):
+            from faabric_tpu.ingress import IngressShedError
+            from faabric_tpu.proto import bers_from_wire
+
+            reqs = bers_from_wire(h, msg.payload)
+            try:
+                self.planner.ingress.submit_many(reqs,
+                                                 source=h.get("host", ""))
+            except IngressShedError as e:
+                return handler_response(header={
+                    "accepted": False, "retry_after": e.retry_after,
+                    "reason": e.reason})
+            return handler_response(header={"accepted": True})
 
         if code == int(PlannerCalls.CHECK_MIGRATION):
             decision = self.planner.check_migration(h["app_id"])

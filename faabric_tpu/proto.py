@@ -91,10 +91,19 @@ class Message:
     is_migration: bool = False
 
     def to_dict(self) -> dict[str, Any]:
-        # Hand-rolled (field list must track the dataclass):
-        # dataclasses.asdict deep-copies recursively at ~22 µs per
-        # message, and this sits on every wire send and every planner
-        # journal append (~3 µs this way)
+        """REST/journal form: payloads hex-encoded in place. Built on
+        the one hand-rolled field list (to_wire_dict)."""
+        d = self.to_wire_dict()
+        d["input_data"] = self.input_data.hex()
+        d["output_data"] = self.output_data.hex()
+        return d
+
+    def to_wire_dict(self) -> dict[str, Any]:
+        """THE hand-rolled field dict (the list must track the
+        dataclass): payload fields carry LENGTHS — the bytes ride the
+        transport frame's binary tail. Hand-rolled, not
+        dataclasses.asdict (which deep-copies at ~22 µs/message): this
+        sits on every dispatch, result push and journal append."""
         return {
             "id": self.id,
             "app_id": self.app_id,
@@ -103,8 +112,8 @@ class Message:
             "type": self.type,
             "user": self.user,
             "function": self.function,
-            "input_data": self.input_data.hex(),
-            "output_data": self.output_data.hex(),
+            "input_data": len(self.input_data),
+            "output_data": len(self.output_data),
             "timestamp": self.timestamp,
             "executed_host": self.executed_host,
             "finish_timestamp": self.finish_timestamp,
@@ -408,12 +417,13 @@ def messages_to_wire(msgs: list[Message]) -> tuple[list[dict[str, Any]], bytes]:
     tail = bytearray()
     dicts: list[dict[str, Any]] = []
     for m in msgs:
-        d = dataclasses.asdict(m)
-        d["input_data"] = len(m.input_data)
-        d["output_data"] = len(m.output_data)
+        # to_wire_dict, not dataclasses.asdict: asdict deep-copies
+        # recursively (~22 µs/message) and this sits on every dispatch
+        # and result push — at invocation-plane QPS that was a top-three
+        # per-message cost (ISSUE 8)
+        dicts.append(m.to_wire_dict())
         tail += m.input_data
         tail += m.output_data
-        dicts.append(d)
     return dicts, bytes(tail)
 
 
@@ -460,6 +470,44 @@ def ber_to_wire(req: BatchExecuteRequest) -> tuple[dict[str, Any], bytes]:
         "evicted_host": req.evicted_host,
     }
     return header, tail
+
+
+def bers_to_wire(reqs: list[BatchExecuteRequest]
+                 ) -> tuple[dict[str, Any], bytes]:
+    """Pipelined wire form (ISSUE 8): many independent batches in one
+    frame — per-request headers under ``bers`` with per-request tail
+    lengths under ``tails``, binary tails concatenated in order. Shared
+    by EXECUTE_BATCHES dispatch and bulk SUBMIT_BATCH so the offset
+    arithmetic exists exactly once per direction."""
+    headers: list[dict[str, Any]] = []
+    tails: list[bytes] = []
+    for req in reqs:
+        header, tail = ber_to_wire(req)
+        headers.append(header)
+        tails.append(tail)
+    return ({"bers": headers, "tails": [len(t) for t in tails]},
+            b"".join(tails))
+
+
+def bers_from_wire(header: dict[str, Any],
+                   payload: bytes) -> list[BatchExecuteRequest]:
+    """Inverse of ``bers_to_wire``."""
+    bers = header.get("bers", [])
+    lengths = [int(n) for n in header.get("tails", [])]
+    if len(bers) != len(lengths):
+        raise ValueError(
+            f"Wire batch list has {len(bers)} headers but "
+            f"{len(lengths)} tail lengths")
+    if sum(lengths) != len(payload):
+        raise ValueError(
+            f"Wire batch tails declare {sum(lengths)} bytes but the "
+            f"payload carries {len(payload)}")
+    out: list[BatchExecuteRequest] = []
+    off = 0
+    for h, n in zip(bers, lengths):
+        out.append(ber_from_wire(h, payload[off:off + n]))
+        off += n
+    return out
 
 
 def ber_from_wire(header: dict[str, Any], tail: bytes) -> BatchExecuteRequest:

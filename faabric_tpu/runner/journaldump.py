@@ -49,9 +49,37 @@ def render(records: list[dict], last: int | None = None) -> str:
     t0 = records[0].get("ts", 0.0)
     lines = []
     for rec in records:
+        if rec.get("k") == "group":
+            # Group commit (ISSUE 8): one scheduling tick's records in
+            # one on-disk record — render the envelope plus each
+            # coalesced sub-record indented under it
+            subs = rec.get("recs") or []
+            lines.append(f"{rec.get('ts', 0.0) - t0:+10.3f}s "
+                         f"{'group':<18} n={len(subs)}")
+            for sub in subs:
+                lines.append(f"{'':>12} └ {sub.get('k', '?'):<16} "
+                             f"{_fmt_fields(sub)}")
+            continue
         lines.append(f"{rec.get('ts', 0.0) - t0:+10.3f}s "
                      f"{rec.get('k', '?'):<18} {_fmt_fields(rec)}")
     return "\n".join(lines)
+
+
+def filter_kind(records: list[dict], kind: str) -> list[dict]:
+    """--kind filter that understands group commits: a group record
+    matches when its own kind matches, or when any coalesced sub-record
+    does (the group is then narrowed to the matching subs)."""
+    out = []
+    for rec in records:
+        if rec.get("k") == kind:
+            out.append(rec)
+            continue
+        if rec.get("k") == "group":
+            subs = [s for s in (rec.get("recs") or [])
+                    if s.get("k") == kind]
+            if subs:
+                out.append({**rec, "recs": subs, "n": len(subs)})
+    return out
 
 
 def snapshot_summary(state: dict | None) -> str:
@@ -86,7 +114,7 @@ def main(argv: list[str] | None = None) -> int:
 
     snapshot, records, meta = load_journal_dir(args.directory)
     if args.kind:
-        records = [r for r in records if r.get("k") == args.kind]
+        records = filter_kind(records, args.kind)
 
     if args.json:
         body = {"meta": meta, "snapshot": snapshot, "records":

@@ -57,6 +57,21 @@ class Scheduler:
         self._lock = threading.RLock()
         # func string → executors (warm pool)
         self._executors: dict[str, list[Executor]] = {}
+        # func string → executors that announced idle (ISSUE 8): an
+        # O(1) claim free-list — at high invocation QPS the linear
+        # try_claim scan over a deep warm pool was a measurable share
+        # of per-message cost. Entries may be stale (claimed via the
+        # scan fallback, or reaped); a failed try_claim on pop simply
+        # discards them, and the reaper prunes its casualties.
+        self._idle: dict[str, list[Executor]] = {}
+        # id()s of currently-registered executors: the O(1) park-
+        # eligibility check for notify_executor_idle (a list membership
+        # scan over a deep warm pool would re-introduce the linear cost
+        # the free-list removed). Maintained strictly alongside
+        # _executors under _lock; ids of removed executors are dropped
+        # while _executors still references them, so id reuse cannot
+        # alias a live entry.
+        self._parkable: set[int] = set()
 
         self._reaper = ReaperThread(self)
         self._started = False
@@ -92,6 +107,8 @@ class Scheduler:
         with self._lock:
             executors = [e for lst in self._executors.values() for e in lst]
             self._executors.clear()
+            self._idle.clear()
+            self._parkable.clear()
         for e in executors:
             e.shutdown()
         self._snapshot_clients.close_all()
@@ -110,6 +127,8 @@ class Scheduler:
         with self._lock:
             executors = [e for lst in self._executors.values() for e in lst]
             self._executors.clear()
+            self._idle.clear()
+            self._parkable.clear()
         for e in executors:
             e.shutdown()
         try:
@@ -158,6 +177,11 @@ class Scheduler:
         (reference Scheduler.cpp:339-386)."""
         func = func_to_string(msg)
         with self._lock:
+            idle = self._idle.get(func)
+            while idle:
+                e = idle.pop()
+                if e.try_claim():
+                    return e
             for e in self._executors.get(func, []):
                 if e.try_claim():
                     return e
@@ -171,13 +195,26 @@ class Scheduler:
             if not executor.try_claim():  # pragma: no cover — fresh executor
                 return None
             self._executors.setdefault(func, []).append(executor)
+            self._parkable.add(id(executor))
             logger.debug("%s created executor %s (%d warm)", self.host,
                          executor.id, len(self._executors[func]))
             return executor
 
     def notify_executor_idle(self, executor: Executor) -> None:
-        """Hook from the executor when its batch drains; reaping happens on
-        the periodic thread."""
+        """Hook from the executor when its batch drains: park it on the
+        O(1) claim free-list. Reaping still happens on the periodic
+        thread."""
+        if executor.bound_msg is None:
+            return
+        func = func_to_string(executor.bound_msg)
+        with self._lock:
+            # Only executors still registered may park: an executor whose
+            # last batch drains concurrently with flush()/shutdown() (which
+            # clear _executors and then shut it down) must not re-enter the
+            # free-list, or a later claim would hand out a dead executor
+            # whose pool thread already exited.
+            if id(executor) in self._parkable:
+                self._idle.setdefault(func, []).append(executor)
 
     def reap_idle_executors(self) -> None:
         conf = get_system_config()
@@ -188,12 +225,21 @@ class Scheduler:
                 for e in lst:
                     if not e.is_claimed() and e.uptime_idle() > conf.bound_timeout:
                         to_shutdown.append(e)
+                        self._parkable.discard(id(e))
                     else:
                         keep.append(e)
                 if keep:
                     self._executors[func] = keep
                 else:
                     self._executors.pop(func, None)
+                # Free-list entries for reaped executors must not be
+                # claimable: rebuild against the surviving set
+                if func in self._idle:
+                    keep_set = set(map(id, keep))
+                    self._idle[func] = [e for e in self._idle[func]
+                                        if id(e) in keep_set]
+                    if not self._idle[func]:
+                        self._idle.pop(func, None)
         for e in to_shutdown:
             logger.debug("Reaping executor %s (idle %.1fs)", e.id, e.uptime_idle())
             e.shutdown()

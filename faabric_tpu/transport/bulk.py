@@ -46,6 +46,7 @@ stripes alike.
 
 from __future__ import annotations
 
+import errno
 import os
 import socket
 import struct
@@ -226,7 +227,19 @@ class BulkServer:
             pass
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("0.0.0.0", self.port))
+        # Brief EADDRINUSE retry, same hardening as
+        # MessageEndpointServer._listen: a just-torn-down fixture's
+        # port (or a transient ephemeral-source squatter) must not
+        # fail a startup that would succeed a moment later
+        for attempt in range(10):
+            try:
+                s.bind(("0.0.0.0", self.port))
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or attempt == 9:
+                    s.close()
+                    raise
+                time.sleep(0.2)
         s.listen(64)
         self._listener = s
         t = threading.Thread(target=self._accept_loop,

@@ -103,6 +103,26 @@ class PointToPointClient(MessageEndpointClient):
         self.sync_send(int(PointToPointCall.MAPPING),
                        {"mappings": mappings.to_dict()}, idempotent=True)
 
+    def send_mappings_many(self,
+                           mappings: list[PointToPointMappings]) -> None:
+        """Pipelined mapping distribution (ISSUE 8): one ASYNC RPC
+        carrying every group's mappings bound for this host in a
+        scheduling tick, instead of one sync MAPPING round-trip per
+        group. Fire-and-forget is safe here: consumers block in
+        wait_for_mappings until the mappings land, and the scheduling
+        tick must not stall on each worker's apply loop (a sync wait
+        per host serialized inside the tick was a measured multi-ms
+        stall per tick)."""
+        if not mappings:
+            return
+        if is_mock_mode():
+            with _mock_lock:
+                for m in mappings:
+                    _sent_mappings.append((self.host, m))
+            return
+        self.async_send(int(PointToPointCall.MAPPING),
+                        {"mappings_list": [m.to_dict() for m in mappings]})
+
     def send_message(self, group_id: int, send_idx: int, recv_idx: int,
                      data: bytes, seq: int = -1, channel: int = 0) -> None:
         if is_mock_mode():
@@ -144,6 +164,15 @@ class PointToPointClient(MessageEndpointClient):
             return
         self.async_send(int(PointToPointCall.CLEAR_GROUP),
                         {"group_id": group_id})
+
+    def clear_groups(self, group_ids: list[int]) -> None:
+        """Batched group cleanup (ISSUE 8): every finished group in one
+        async RPC — at high invocation QPS, one clear per completed app
+        was a visible share of the planner's result-path cost."""
+        if is_mock_mode() or not group_ids:
+            return
+        self.async_send(int(PointToPointCall.CLEAR_GROUP),
+                        {"group_ids": list(group_ids)})
 
     def abort_group(self, group_id: int, reason: str) -> None:
         if is_mock_mode():
@@ -211,8 +240,17 @@ class PointToPointServer(MessageEndpointServer):
                 group.lock(h["group_idx"], recursive)
             else:
                 group.unlock(h["group_idx"], recursive)
+        elif code == int(PointToPointCall.MAPPING):
+            # Async (fire-and-forget) mapping delivery: the batched
+            # tick distribution plane (ISSUE 8). The sync form below
+            # remains for callers that need the apply confirmed.
+            for d in h.get("mappings_list") or [h["mappings"]]:
+                self.broker.set_up_local_mappings_from_mappings(
+                    PointToPointMappings.from_dict(d))
         elif code == int(PointToPointCall.CLEAR_GROUP):
-            self.broker.clear_group(h["group_id"])
+            # Single ("group_id") or batched ("group_ids", ISSUE 8)
+            for gid in h.get("group_ids") or [h["group_id"]]:
+                self.broker.clear_group(gid)
         elif code == int(PointToPointCall.ABORT_GROUP):
             # propagate=False: the originator already told every member
             # host — re-broadcasting would just bounce the (idempotent)
@@ -225,8 +263,14 @@ class PointToPointServer(MessageEndpointServer):
 
     def do_sync_recv(self, msg: TransportMessage) -> TransportMessage:
         if msg.code == int(PointToPointCall.MAPPING):
-            mappings = PointToPointMappings.from_dict(msg.header["mappings"])
-            self.broker.set_up_local_mappings_from_mappings(mappings)
+            # Single group ("mappings") or a whole scheduling tick's
+            # worth pipelined into one call ("mappings_list", ISSUE 8)
+            dicts = msg.header.get("mappings_list")
+            if dicts is None:
+                dicts = [msg.header["mappings"]]
+            for d in dicts:
+                self.broker.set_up_local_mappings_from_mappings(
+                    PointToPointMappings.from_dict(d))
             return handler_response()
         raise ValueError(f"Unknown sync PTP call {msg.code}")
 
@@ -240,36 +284,58 @@ _dist_clients: dict[str, PointToPointClient] = {}
 _dist_lock = threading.Lock()
 
 
+def _get_dist_client(host: str) -> PointToPointClient:
+    with _dist_lock:
+        client = _dist_clients.get(host)
+        if client is None:
+            client = PointToPointClient(host)
+            _dist_clients[host] = client
+        return client
+
+
 def send_mappings_from_decision(decision: SchedulingDecision) -> None:
     if decision.n_messages == 0 or not decision.group_id:
         return
     mappings = mappings_from_decision(decision)
     for host in decision.unique_hosts():
-        with _dist_lock:
-            client = _dist_clients.get(host)
-            if client is None:
-                client = PointToPointClient(host)
-                _dist_clients[host] = client
         try:
-            client.send_mappings(mappings)
+            _get_dist_client(host).send_mappings(mappings)
         except Exception:  # noqa: BLE001 — a dead host must not stall others
             logger.exception("Failed sending mappings of group %d to %s",
                              decision.group_id, host)
 
 
-def send_clear_group(group_id: int, hosts: list[str]) -> None:
-    """Tell every involved host to drop a finished group's broker state —
-    without this, long-lived workers accumulate mappings/queues per batch."""
-    for host in hosts:
-        with _dist_lock:
-            client = _dist_clients.get(host)
-            if client is None:
-                client = PointToPointClient(host)
-                _dist_clients[host] = client
+def send_mappings_for_decisions(decisions) -> None:
+    """Pipelined mapping distribution for one scheduling tick (ISSUE 8):
+    group every decision's mappings by target host and deliver each
+    host's set in ONE sync RPC, instead of one round-trip per (decision,
+    host)."""
+    per_host: dict[str, list] = {}
+    for decision in decisions:
+        if decision.n_messages == 0 or not decision.group_id:
+            continue
+        mappings = mappings_from_decision(decision)
+        for host in decision.unique_hosts():
+            per_host.setdefault(host, []).append(mappings)
+    for host, mlist in per_host.items():
         try:
-            client.clear_group(group_id)
-        except Exception:  # noqa: BLE001
-            logger.debug("Failed sending clear-group %d to %s", group_id, host)
+            _get_dist_client(host).send_mappings_many(mlist)
+        except Exception:  # noqa: BLE001 — a dead host must not stall
+            # the tick's other hosts
+            logger.exception("Failed sending %d mapping set(s) to %s",
+                             len(mlist), host)
+
+
+def send_clear_groups(host: str, group_ids: list[int]) -> None:
+    """Tell ``host`` to drop finished groups' broker state in one async
+    RPC (the coalesced result plane hands these over per frame) —
+    without this, long-lived workers accumulate mappings/queues per
+    batch."""
+    try:
+        _get_dist_client(host).clear_groups(group_ids)
+    except Exception:  # noqa: BLE001
+        logger.debug("Failed sending clear-groups %s to %s", group_ids,
+                     host)
 
 
 def close_mapping_clients() -> None:

@@ -100,6 +100,15 @@ class SystemConfig:
     planner_journal_fsync_interval: float = 0.05
     planner_journal_compact_records: int = 20000
     planner_reconcile_grace: float = 0.0
+    # High-QPS invocation ingress (ISSUE 8): batched scheduling tick
+    # period; admission-queue bound (messages); per-source credit cap
+    # (outstanding queued messages per source before that source sheds);
+    # and how long a queued invocation may wait for capacity before it
+    # is failed back to the caller
+    planner_tick_ms: float = 5.0
+    ingress_queue_max: int = 20000
+    ingress_source_credits: int = 8192
+    ingress_queue_timeout: float = 30.0
 
     # MPI fault propagation: while a recv on a watched (MPI) group
     # blocks, the expected sender's host is probed every this many
@@ -177,6 +186,12 @@ class SystemConfig:
             "FAABRIC_PLANNER_JOURNAL_COMPACT_RECORDS", 20000)
         self.planner_reconcile_grace = _env_float(
             "FAABRIC_PLANNER_RECONCILE_GRACE", 0.0)
+        self.planner_tick_ms = _env_float("FAABRIC_PLANNER_TICK_MS", 5.0)
+        self.ingress_queue_max = _env_int("FAABRIC_INGRESS_QUEUE_MAX", 20000)
+        self.ingress_source_credits = _env_int(
+            "FAABRIC_INGRESS_SOURCE_CREDITS", 8192)
+        self.ingress_queue_timeout = _env_float(
+            "FAABRIC_INGRESS_QUEUE_TIMEOUT", 30.0)
         self.mpi_abort_check_seconds = _env_float(
             "MPI_ABORT_CHECK_SECONDS", 2.0)
 

@@ -66,7 +66,13 @@ def _slot_looks_free(base: int) -> bool:
 
     from faabric_tpu.transport import common as tc
 
-    service_ports = range(tc.STATE_ASYNC_PORT, tc.PLANNER_SYNC_PORT + 1)
+    from faabric_tpu.transport.bulk import BULK_PORT
+
+    # The bulk data-plane listener (8014) sits past the contiguous RPC
+    # range — a squatter there sailed past this probe and EADDRINUSE'd
+    # a fixture's BulkServer (observed once in a tier-1 run)
+    service_ports = [*range(tc.STATE_ASYNC_PORT, tc.PLANNER_SYNC_PORT + 1),
+                     BULK_PORT]
     for off in (0, 1000, 2000):
         for port in service_ports:
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -48,6 +48,13 @@ class DistExecutor(Executor):
         return fn(msg, req)
 
     # ------------------------------------------------------------------
+    def fn_noop(self, msg, req):
+        """ISSUE 8 high-QPS workload: the cheapest possible invocation,
+        so the bench/chaos QPS numbers measure the invocation PATH
+        (admission, tick, journal, dispatch, result), not the task."""
+        msg.output_data = b"ok"
+        return int(ReturnValue.SUCCESS)
+
     def fn_square(self, msg, req):
         n = int(msg.input_data.decode())
         msg.output_data = str(n * n).encode()

@@ -16,6 +16,7 @@ import random
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -405,6 +406,136 @@ def wait_finished_tolerant(me, app_id, timeout):
             pass
         time.sleep(0.25)
     raise AssertionError(f"batch {app_id} never finished: {status}")
+
+
+@pytest.mark.slow
+def test_chaos_worker_sigkill_at_full_qps_recovers(tmp_path):
+    """ISSUE 8 acceptance: SIGKILL a worker while the invocation
+    ingress is at full QPS (a continuous stream of 1-message no-op
+    apps through bulk SUBMIT_BATCH → scheduling ticks → pipelined
+    dispatch). Throughput must recover via the PR 2 requeue machinery
+    (expiry moves the dead worker's in-flight messages to the
+    survivor), EVERY app must finish with exactly one SUCCESS result
+    (no lost, no duplicated results), the planner journal must stay
+    intact (group-commit records verifiable, no torn tail), and the
+    flight recorder must show the requeue."""
+    import json
+    import urllib.request
+
+    journal_dir = str(tmp_path / "journal")
+    flight_dir = str(tmp_path / "flight")
+    cluster = ChaosCluster(
+        "ckQ", n_workers=2, slots=(16, 16),
+        extra_env={"PLANNER_HOST_TIMEOUT": "2",
+                   "PLANNER_REQUEUE_BACKOFF": "0.2",
+                   "PLANNER_MAX_REQUEUES": "5",
+                   "FAABRIC_PLANNER_JOURNAL_DIR": journal_dir,
+                   "FAABRIC_FLIGHT_DIR": flight_dir})
+    http_port = cluster.base + 3100
+    cluster.env["DIST_HTTP_PORT"] = str(http_port)
+    cluster.start()
+    try:
+        me = cluster.me
+        total, bulk = 600, 25
+
+        def results_total() -> int:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/healthz",
+                    timeout=5) as r:
+                return json.loads(r.read()).get("resultsTotal", 0)
+
+        app_ids: list[int] = []
+        submit_errs: list[str] = []
+        submitted = threading.Event()
+
+        def pump() -> None:
+            try:
+                left = total
+                while left > 0:
+                    n = min(bulk, left)
+                    reqs = [batch_exec_factory("dist", "noop", 1)
+                            for _ in range(n)]
+                    while True:
+                        ok, retry_after = \
+                            me.planner_client.submit_functions_many(reqs)
+                        if ok:
+                            break
+                        time.sleep(retry_after)
+                    app_ids.extend(r.app_id for r in reqs)
+                    left -= n
+            except Exception as e:  # noqa: BLE001
+                submit_errs.append(str(e))
+            finally:
+                submitted.set()
+
+        pumper = threading.Thread(target=pump, name="qps-pump")
+        pumper.start()
+
+        # Let the stream reach full QPS, then kill a worker mid-flight
+        deadline = time.time() + 30
+        while results_total() < 120 and time.time() < deadline:
+            time.sleep(0.1)
+        before_kill = results_total()
+        assert before_kill >= 120, "stream never reached QPS"
+        t_kill = cluster.kill(cluster.workers[1])
+
+        pumper.join(timeout=60)
+        assert not submit_errs, submit_errs
+
+        # Throughput recovers: every invocation completes despite the
+        # kill (expiry + requeue move the dead worker's messages)
+        deadline = time.time() + 90
+        done = 0
+        while time.time() < deadline:
+            done = results_total()
+            if done >= total:
+                break
+            time.sleep(0.25)
+        recovery_s = time.monotonic() - t_kill
+        assert done >= total, f"only {done}/{total} completed"
+        assert recovery_s < 75, f"recovery took {recovery_s:.1f}s"
+
+        # No lost and no duplicated results: every app finished with
+        # exactly one SUCCESS result, all on the surviving worker or
+        # the pre-kill victim
+        bad = []
+        for app_id in app_ids:
+            status = me.planner_client.get_batch_results(app_id)
+            if (not status.finished
+                    or len(status.message_results) != 1
+                    or status.message_results[0].return_value
+                    != int(ReturnValue.SUCCESS)):
+                bad.append((app_id, status.finished,
+                            [(m.return_value, m.output_data)
+                             for m in status.message_results]))
+        assert not bad, f"{len(bad)} bad apps, e.g. {bad[:3]}"
+
+        # Planner journal intact: no torn tail, no snapshot corruption,
+        # and the tick group-commits are on the timeline
+        from faabric_tpu.runner import journaldump
+
+        snapshot, records, meta = journaldump.load_journal_dir(
+            journal_dir)
+        assert not meta.get("torn") and not meta.get("snapshot_error")
+        # Group commits either still in the log or already folded into
+        # a compaction snapshot
+        has_groups = any(r.get("k") == "group" for r in records)
+        assert has_groups or snapshot is not None
+
+        # Flight recorder kept the requeue forensics
+        from faabric_tpu.runner import flightdump
+
+        deadline = time.time() + 15
+        kinds: set = set()
+        while time.time() < deadline:
+            kinds = {e["kind"] for e in flightdump.merge(flight_dir)}
+            if "planner_requeued" in kinds:
+                break
+            time.sleep(0.5)
+        assert "planner_recovery" in kinds or "planner_requeued" in kinds, \
+            kinds
+    finally:
+        cluster.stop()
 
 
 @pytest.mark.slow

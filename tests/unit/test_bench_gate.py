@@ -151,6 +151,44 @@ def test_gate_data_plane_regression_fails(tmp_path):
     assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
 
 
+def test_gate_fails_when_invocations_per_s_vanishes(tmp_path, capsys):
+    """ISSUE 8: invocations_per_s is a REQUIRED key — a round where it
+    vanishes (the ingress bench section crashed) is a FAILURE, not a
+    note."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.6,
+                  "host_sendrecv_gibs": 1.1,
+                  "invocations_per_s": 2300.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_allreduce_procs_gibs": 1.6,
+                  "host_sendrecv_gibs": 1.1})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+    out = capsys.readouterr().out
+    assert "invocations_per_s" in out and "MISSING" in out
+
+
+def test_gate_invocations_per_s_is_higher_better(tmp_path):
+    """The _per_s suffix must classify as throughput (higher-better),
+    not get caught by the trailing-_s latency rule: a >20% DROP fails;
+    the reference keys (serial baseline, p50) stay reported-only."""
+    assert bench_gate.direction("invocations_per_s") == 1
+    assert bench_gate.direction("invocation_p50_ms") == -1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"invocations_per_s": 2300.0,
+                  "invocations_per_s_serial": 600.0,
+                  "invocation_p50_ms": 1.5})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"invocations_per_s": 1500.0,      # -35%: gated
+                  "invocations_per_s_serial": 100.0,  # noisy: reported
+                  "invocation_p50_ms": 9.0})          # noisy: reported
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 1
+    _write_round(tmp_path, "BENCH_r03.json", 0.05,
+                 {"invocations_per_s": 1450.0,      # within 20% of r02
+                  "invocations_per_s_serial": 100.0,
+                  "invocation_p50_ms": 9.0})
+    assert bench_gate.main(["--repo", str(tmp_path), "--quiet"]) == 0
+
+
 def test_gate_within_threshold_passes(tmp_path):
     _write_round(tmp_path, "BENCH_r01.json", 0.05,
                  {"host_allreduce_gibs": 1.0})

@@ -435,5 +435,10 @@ def test_threads_decision_cache_reuses_placement(cluster):
             w.planner_client.get_message_result(req.app_id, m.id,
                                                 timeout=10.0)
     assert placements[0] == placements[1]
+    # The cache key includes the batch TYPE since ISSUE 8 (a FUNCTIONS
+    # invocation of the same shape must not share a THREADS placement)
+    probe = batch_exec_factory("demo", "echo", 4)
+    probe.type = int(BatchExecuteType.THREADS)
+    assert get_decision_cache().get_cached_decision(probe) is not None
     assert get_decision_cache().get_cached_decision(
-        batch_exec_factory("demo", "echo", 4)) is not None
+        batch_exec_factory("demo", "echo", 4)) is None
