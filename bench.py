@@ -475,6 +475,187 @@ def bench_host_allreduce_procs(elems: int = 25_500_000,
         clear_host_aliases()
 
 
+def _hier_bench_world(my_host_idx: int, n_hosts: int,
+                      ranks_per_host: int, app_id: int = 9):
+    """Every bench process builds the same INTERLEAVED world: rank r on
+    simulated host (r % n_hosts) — the topology-BLIND placement where
+    every flat-ring link crosses hosts. This is the worst case the
+    gang-scheduling hook prevents and the hierarchical composition
+    repairs; grouped placement would hide most of the wire savings."""
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.mpi import MpiWorld
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+    from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+    hosts = [f"xhier{i}" for i in range(n_hosts)]
+    n = n_hosts * ranks_per_host
+    d = SchedulingDecision(app_id=app_id, group_id=app_id)
+    for r in range(n):
+        d.add_message(hosts[r % n_hosts], 60 + r, r, r)
+    broker = PointToPointBroker(hosts[my_host_idx])
+    server = PointToPointServer(broker)
+    server.start()
+    broker.set_up_local_mappings_from_decision(d)
+    world = MpiWorld(broker, app_id, n, app_id)
+    world.refresh_rank_hosts()
+    my_ranks = [r for r in range(n) if r % n_hosts == my_host_idx]
+    return broker, server, world, my_ranks
+
+
+def _hier_allreduce_modes(world, my_ranks, elems, rounds):
+    """Run the allreduce workload once per algorithm mode (flat ring,
+    then hierarchical), barrier-fenced so every process flips
+    ``hier_enabled`` at a quiesced point. Returns
+    (per-mode elapsed seconds, per-mode outbound comm-matrix byte
+    deltas for THIS process, ok)."""
+    import numpy as np
+
+    from faabric_tpu.telemetry import get_comm_matrix
+
+    def cm_bytes():
+        # Data planes only (as the dist test): the ptp control plane
+        # (barriers, mappings) would bias the hier/flat ratio toward 1
+        return sum(c["bytes"] for c in
+                   (get_comm_matrix().snapshot() or {}).get("cells", [])
+                   if c["plane"] in ("shm", "bulk-tcp"))
+
+    elapsed, cross, oks = {}, {}, []
+    # "force": the simulated hosts all resolve to loopback, and plain
+    # "on" composes only across real machines (_hier_wins)
+    for mode, hier in (("flat", False), ("hier", "force")):
+        world.hier_enabled = hier
+        results = {}
+
+        def rank_fn(rank):
+            data = np.full(elems, rank + 1, dtype=np.int32)
+            world.barrier(rank)
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(rounds):
+                out = world.allreduce(rank, data, _mpi_sum())
+            world.barrier(rank)
+            results[rank] = (time.perf_counter() - t0, out)
+
+        b0 = cm_bytes()
+        threads = [threading.Thread(target=rank_fn, args=(r,))
+                   for r in my_ranks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cross[mode] = cm_bytes() - b0
+        elapsed[mode] = max(v[0] for v in results.values())
+        expected = world.size * (world.size + 1) // 2
+        oks.append(all(int(v[1][0]) == expected
+                       for v in results.values()))
+    return elapsed, cross, all(oks)
+
+
+def _hier_worker_main(host_idx: int, n_hosts: int, ranks_per_host: int,
+                      elems: int, rounds: int) -> None:
+    """Child body: one simulated host's ranks (aliases via env)."""
+    broker, server, world, my_ranks = _hier_bench_world(
+        host_idx, n_hosts, ranks_per_host)
+    print("READY", flush=True)
+    try:
+        _, cross, ok = _hier_allreduce_modes(world, my_ranks, elems,
+                                             rounds)
+        print(f"BYTES {cross['flat']} {cross['hier']}", flush=True)
+        print("DONE" if ok else "FAILED bad-allreduce-value", flush=True)
+    except Exception as e:  # noqa: BLE001 — reported to parent
+        print(f"FAILED {e!r}"[:160], flush=True)
+    finally:
+        server.stop()
+        broker.clear()
+
+
+def bench_host_allreduce_hier(n_hosts: int = 4, ranks_per_host: int = 2,
+                              elems: int = 6_000_000,
+                              rounds: int = 2) -> dict:
+    """ISSUE 9 acceptance bench: hierarchical allreduce over
+    ``n_hosts`` SIMULATED hosts (one OS process each) × N ranks with a
+    topology-blind interleaved placement. Runs the same payload through
+    the flat ring and the hierarchical composition and reports both
+    rates plus ``cross_host_bytes`` — the comm-matrix byte totals the
+    two algorithms put on the wire (sum over every process's outbound
+    cells; in-process same-host traffic is invisible to the matrix by
+    design). Model: flat moves 2·(N−1)·payload across processes, the
+    leader ring 2·(H−1)·payload → ratio ≈ (H−1)/(N−1) ≈
+    1/ranks-per-host."""
+    import subprocess
+
+    from faabric_tpu.mpi import MpiWorld
+    from faabric_tpu.transport.common import (
+        clear_host_aliases,
+        register_host_alias,
+    )
+
+    # Below the ring/hier eligibility floor BOTH modes silently run the
+    # leader tree and the "ratio" measures nothing — fail loudly instead
+    assert elems * 4 >= 2 * MpiWorld.CHUNK_BYTES, (
+        f"payload {elems * 4} B below the 2×CHUNK_BYTES "
+        f"({2 * MpiWorld.CHUNK_BYTES} B) ring/hier floor")
+
+    base = random.randint(10, 50) * 100
+    clear_host_aliases()
+    aliases = []
+    for i in range(n_hosts):
+        register_host_alias(f"xhier{i}", "127.0.0.1", base + i * 5000)
+        aliases.append(f"xhier{i}=127.0.0.1+{base + i * 5000}")
+    env = {**os.environ, "FAABRIC_HOST_ALIASES": ",".join(aliases)}
+
+    broker, server, world, my_ranks = _hier_bench_world(
+        0, n_hosts, ranks_per_host)
+    children = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--hier-worker",
+         str(i), str(n_hosts), str(ranks_per_host), str(elems),
+         str(rounds)],
+        stdout=subprocess.PIPE, text=True, env=env)
+        for i in range(1, n_hosts)]
+    try:
+        for c in children:
+            line = c.stdout.readline().strip()
+            assert line == "READY", f"hier worker said {line!r}"
+        elapsed, cross, ok = _hier_allreduce_modes(world, my_ranks,
+                                                   elems, rounds)
+        assert ok, "parent ranks saw a bad allreduce value"
+        flat_bytes, hier_bytes = cross["flat"], cross["hier"]
+        for c in children:
+            bline = c.stdout.readline().split()
+            assert bline and bline[0] == "BYTES", bline
+            flat_bytes += int(bline[1])
+            hier_bytes += int(bline[2])
+            status = c.stdout.readline().strip()
+            assert status == "DONE", f"hier worker reported {status!r}"
+
+        n = n_hosts * ranks_per_host
+        payload_bytes = elems * 4
+        effective = 4 * (n - 1) * payload_bytes * rounds
+        return {
+            "effective_gibs": effective / elapsed["hier"] / (1 << 30),
+            "flat_effective_gibs": effective / elapsed["flat"] / (1 << 30),
+            "np": n, "n_hosts": n_hosts,
+            "ranks_per_host": ranks_per_host,
+            "payload_mib": payload_bytes / (1 << 20), "rounds": rounds,
+            "placement": "interleaved",
+            "cross_host_bytes": {
+                "flat": flat_bytes, "hier": hier_bytes,
+                "ratio": round(hier_bytes / flat_bytes, 4)
+                if flat_bytes else None,
+                "model_ratio": round((n_hosts - 1) / (n - 1), 4),
+            },
+        }
+    finally:
+        server.stop()
+        broker.clear()
+        for c in children:
+            try:
+                c.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                c.kill()
+        clear_host_aliases()
+
+
 def _bench_journal_micro(quick: bool = False) -> dict:
     """ISSUE 4 micro-costs: raw journal append latency, the cost of the
     disabled-path gate, and the end-to-end overhead the journal adds to
@@ -2399,6 +2580,14 @@ def main() -> None:
     host_section("host_allreduce_procs", lambda: bench_host_allreduce_procs(
         elems=1_000_000 if quick else 25_500_000,
         rounds=1 if quick else 3))
+    host_section("host_allreduce_hier",
+                 lambda: bench_host_allreduce_hier(
+                     # quick must stay ABOVE the 2×CHUNK_BYTES (8 MiB)
+                     # ring/hier eligibility floor or BOTH modes
+                     # silently run the leader tree and the byte ratio
+                     # reads a meaningless ~1.0
+                     elems=2_500_000 if quick else 6_000_000,
+                     rounds=1 if quick else 2))
     host_section("concurrency", lambda: bench_concurrency(quick))
     host_section("invocations", lambda: bench_invocations(quick))
     host_section("robustness", lambda: bench_robustness(quick))
@@ -2453,6 +2642,15 @@ def main() -> None:
     if arp.get("effective_gibs"):
         summary["host_allreduce_procs_gibs"] = round(
             arp["effective_gibs"], 2)
+    # ISSUE 9 hierarchical keys (REPORTED_ONLY in bench_gate this first
+    # round): the 4-simulated-host hierarchical rate, and the measured
+    # wire-byte ratio hier/flat (model: (H-1)/(N-1) ≈ 1/ranks-per-host)
+    hr = extras.get("host_allreduce_hier") or {}
+    if hr.get("effective_gibs"):
+        summary["host_allreduce_hier_gibs"] = round(
+            hr["effective_gibs"], 2)
+    if (hr.get("cross_host_bytes") or {}).get("ratio") is not None:
+        summary["cross_host_bytes_ratio"] = hr["cross_host_bytes"]["ratio"]
     sr = extras.get("host_sendrecv_procs") or {}
     if sr.get("rate_gibs"):
         summary["host_sendrecv_gibs"] = round(sr["rate_gibs"], 2)
@@ -2502,6 +2700,10 @@ if __name__ == "__main__":
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         i = sys.argv.index("--allreduce-worker")
         _allreduce_worker_main(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+    elif "--hier-worker" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        i = sys.argv.index("--hier-worker")
+        _hier_worker_main(*(int(a) for a in sys.argv[i + 1:i + 6]))
     elif "--device-only" in sys.argv:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         out_path = None

@@ -1,6 +1,10 @@
 """Bin-pack policy (reference src/batch-scheduler/BinPackScheduler.cpp).
 
-NEW: fill hosts in decreasing order of free capacity. SCALE_CHANGE: co-locate
+NEW: fill hosts in decreasing order of free capacity — except MPI
+batches, which gang-schedule (ISSUE 9): the sort consults the world's
+prospective Topology and prefers FILLING one host with the world's
+ranks before spilling, so the ranks land co-located and the
+hierarchical collectives get their shm tier. SCALE_CHANGE: co-locate
 with the app's existing placement first. DIST_CHANGE: re-schedule from
 scratch (app's slots virtually freed) and migrate only if the placement
 spans fewer hosts or cuts cross-host links.
@@ -25,6 +29,31 @@ def sort_hosts_larger_first(hosts: list[HostState]) -> list[HostState]:
     return sorted(hosts, key=lambda h: (h.available, h.slots, h.ip), reverse=True)
 
 
+def sort_hosts_gang(hosts: list[HostState], world_size: int) -> list[HostState]:
+    """Gang order for an MPI world of ``world_size`` ranks: the host
+    that can swallow the most of the REMAINDER first; among hosts that
+    fit the whole remainder, the tightest fit wins (an 8-rank world
+    lands on the 8-free host, keeping the 16-free host whole for a
+    bigger world). Greedy simulation rather than a one-shot key sort:
+    after the first host spills, the remainder shrinks, and the
+    tightest-fit rule must apply to THAT (hosts 6/5/4 free, world of
+    10 → 6-host then the exact-fit 4-host, not the 5-host it would
+    fragment). Hosts the world never reaches follow in the classic
+    larger-first order. Capacity-blind larger-first would fragment the
+    big host and scatter the next world topology-blind."""
+    pool = list(hosts)
+    order: list[HostState] = []
+    remaining = world_size
+    while pool and remaining > 0:
+        best = max(pool, key=lambda h: (min(h.available, remaining),
+                                        -h.available, h.ip))
+        pool.remove(best)
+        order.append(best)
+        remaining -= best.available
+    order.extend(sort_hosts_larger_first(pool))
+    return order
+
+
 def sort_hosts_by_app_freq(hosts: list[HostState],
                            freq: dict[str, int]) -> list[HostState]:
     # App placement count desc first, then the NEW criteria
@@ -37,26 +66,31 @@ def sort_hosts_by_app_freq(hosts: list[HostState],
 
 
 def locality_score(decision: SchedulingDecision) -> tuple[int, int]:
-    """(number of hosts, cross-host links in the fully-connected rank graph)
-    — reference BinPackScheduler.cpp:97-148. On TPU the cross-host links are
-    the collective hops that leave the ICI domain and ride DCN, which is why
-    fewer is strictly better."""
-    freq = decision.host_freq_count()
-    if len(freq) <= 1:
-        return (len(freq), 0)
-    total = sum(freq.values())
-    # Each message has an edge to every message on a different host; halve
-    # the double count.
-    cross = sum(n * (total - n) for n in freq.values()) // 2
-    return (len(freq), cross)
+    """(number of hosts, cross-host links in the fully-connected rank
+    graph) — reference BinPackScheduler.cpp:97-148, read from the
+    placement's Topology (the same object the MPI collectives compose
+    over). On TPU the cross-host links are the collective hops that
+    leave the ICI domain and ride DCN, which is why fewer is strictly
+    better."""
+    topo = decision.topology()
+    return (topo.n_hosts, topo.cross_host_pairs())
+
+
+def is_mpi_request(req: BatchExecuteRequest) -> bool:
+    return req.n_messages() > 0 and bool(req.messages[0].is_mpi)
 
 
 class BinPackScheduler(BatchScheduler):
     def get_sorted_hosts(self, host_map: HostMap, in_flight: InFlightReqs,
                          req: BatchExecuteRequest,
                          decision_type: DecisionType) -> list[HostState]:
+        from faabric_tpu.util.config import get_system_config
+
         hosts = list(host_map.values())
         if decision_type == DecisionType.NEW:
+            if (is_mpi_request(req)
+                    and get_system_config().gang_schedule_mpi):
+                return sort_hosts_gang(hosts, req.n_messages())
             return sort_hosts_larger_first(hosts)
 
         old_decision = in_flight[req.app_id][1]

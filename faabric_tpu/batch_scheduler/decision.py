@@ -98,6 +98,16 @@ class SchedulingDecision:
             freq[h] = freq.get(h, 0) + 1
         return freq
 
+    def topology(self):
+        """The placement's Topology (mpi/topology.py): group idx (the
+        MPI rank of gang-scheduled worlds) → host → leader/local rank.
+        The SAME object MpiWorld composes its hierarchical collectives
+        over — the scheduler reads it for locality scoring and the
+        planner exports it (get_cluster_topology)."""
+        from faabric_tpu.mpi.topology import Topology
+
+        return Topology.from_decision(self)
+
     # ------------------------------------------------------------------
     def to_dict(self) -> dict[str, Any]:
         # Hand-rolled (parallel-vector copies): dataclasses.asdict

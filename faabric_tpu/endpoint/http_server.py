@@ -105,6 +105,9 @@ class PlannerHttpEndpoint:
                     elif path == "/healthz":
                         body = endpoint.healthz_json().encode()
                         ctype = "application/json"
+                    elif path == "/topology":
+                        body = endpoint.topology_json().encode()
+                        ctype = "application/json"
                     else:
                         body = b'{"status": "running"}'
                         ctype = "application/json"
@@ -182,6 +185,13 @@ class PlannerHttpEndpoint:
 
     def healthz_json(self) -> str:
         return json.dumps(self.planner.health_summary())
+
+    def topology_json(self) -> str:
+        """Cluster topology snapshot (ISSUE 9): per-host capacity plus
+        the rank→host Topology of every in-flight gang-scheduled MPI
+        world — the scrape surface for dashboards and placement
+        debugging (`Planner.get_cluster_topology`)."""
+        return json.dumps(self.planner.get_cluster_topology())
 
     def trace_json(self) -> str:
         """Chrome trace_event JSON merging every host's span buffer onto

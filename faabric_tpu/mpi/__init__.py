@@ -10,6 +10,7 @@ from faabric_tpu.mpi.types import (
     mpi_dtype_for,
     np_dtype_for,
 )
+from faabric_tpu.mpi.topology import Topology
 from faabric_tpu.mpi.window import MpiWindow
 from faabric_tpu.mpi.world import MAIN_RANK, MpiWorld, MpiWorldAborted
 from faabric_tpu.mpi.registry import MpiContext, MpiWorldRegistry, get_mpi_context
@@ -25,6 +26,7 @@ __all__ = [
     "MpiWorld",
     "MpiWorldAborted",
     "MpiWorldRegistry",
+    "Topology",
     "UserOp",
     "apply_op",
     "get_mpi_context",

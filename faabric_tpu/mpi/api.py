@@ -186,6 +186,16 @@ def mpi_get_processor_name() -> str:
     return world.host_for_rank(rank)
 
 
+def mpi_topology(comm=MPI_COMM_WORLD):
+    """The communicator's Topology (mpi/topology.py): rank→host→
+    leader/local-rank — the same structure the scheduler's gang-
+    placement hook reads and the hierarchical collectives compose over.
+    Guest code uses it to shard work by locality (e.g. one I/O rank per
+    host via ``topo.is_leader(rank)``)."""
+    world, _ = _current(comm)
+    return world.topology()
+
+
 # ---------------------------------------------------------------------------
 # Point-to-point
 # ---------------------------------------------------------------------------

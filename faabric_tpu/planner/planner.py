@@ -811,6 +811,21 @@ class Planner:
         self._next_idx[req.app_id] = 1 + max(
             (m.app_idx for m in req.messages), default=req.n_messages() - 1)
         self._results.setdefault(req.app_id, {})
+        if req.messages and req.messages[0].is_mpi:
+            # Placement-shape accounting for gang-scheduled worlds: the
+            # hierarchical collectives' wire bytes scale with hosts and
+            # ranks/host, so the shape IS the perf-relevant outcome
+            topo = decision.topology()
+            _metrics.counter(
+                "faabric_planner_mpi_placements_total",
+                "Scheduled MPI worlds by placement shape",
+                hosts=str(topo.n_hosts),
+                gang="1" if topo.hosts_contiguous() else "0").inc()
+            logger.debug(
+                "MPI world app=%d placed: %d rank(s) on %d host(s), "
+                "max %d/host, contiguous=%s", req.app_id, topo.size,
+                topo.n_hosts, topo.max_ranks_per_host,
+                topo.hosts_contiguous())
         return decision, decision, self._build_dispatches(req, decision)
 
     def _handle_scale_change_locked(self, req: BatchExecuteRequest,
@@ -933,6 +948,23 @@ class Planner:
             self._journal_append(
                 "app_freeze", app_id=req.app_id,
                 req=self._evicted[req.app_id].to_dict())
+
+    def get_cluster_topology(self) -> dict:
+        """Scheduler-readable cluster topology snapshot: per-host
+        capacity plus the rank→host Topology of every in-flight
+        gang-scheduled (MPI) world — the cluster-level counterpart of
+        ``MpiWorld.topology()`` (one ``Topology`` per world, JSON-safe),
+        for dashboards, tests and placement debugging."""
+        with self._lock:
+            hosts = {ip: {"slots": h.state.slots,
+                          "used_slots": h.state.used_slots,
+                          "n_devices": h.state.n_devices}
+                     for ip, h in self._hosts.items()}
+            worlds = {}
+            for app_id, (req, dec) in self._in_flight.items():
+                if req.n_messages() and req.messages[0].is_mpi:
+                    worlds[app_id] = dec.topology().to_dict()
+        return {"hosts": hosts, "worlds": worlds}
 
     # -- resource accounting ---------------------------------------------
     def _policy_host_map_locked(self) -> dict[str, HostState]:

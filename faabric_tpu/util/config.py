@@ -50,6 +50,13 @@ class SystemConfig:
 
     # Scheduling
     batch_scheduler_mode: str = "bin-pack"  # bin-pack | compact | spot
+    # Gang-schedule MPI batches (ISSUE 9): bin-pack consults the world's
+    # prospective Topology and prefers FILLING a host with the world's
+    # ranks (best fit among hosts that hold the whole remainder) before
+    # spilling — fewest hosts, co-located ranks, so the hierarchical
+    # collectives get their shm tier. Off → the capacity-blind
+    # larger-first order also applies to MPI worlds.
+    gang_schedule_mpi: bool = True
     override_cpu_count: int = 0
     override_free_cpu_start: int = 0
     default_mpi_world_size: int = 5
@@ -144,6 +151,8 @@ class SystemConfig:
         self.redis_port = _env_int("REDIS_PORT", 6379)
 
         self.batch_scheduler_mode = _env("BATCH_SCHEDULER_MODE", "bin-pack")
+        self.gang_schedule_mpi = _env(
+            "FAABRIC_GANG_SCHEDULE", "1").lower() not in ("0", "false", "off")
         self.override_cpu_count = _env_int("OVERRIDE_CPU_COUNT", 0)
         self.override_free_cpu_start = _env_int("OVERRIDE_FREE_CPU_START", 0)
         self.default_mpi_world_size = _env_int("DEFAULT_MPI_WORLD_SIZE", 5)

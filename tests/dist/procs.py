@@ -151,6 +151,13 @@ class DistExecutor(Executor):
             world = ctx.join_world(msg)
         rank = msg.mpi_rank
         world.refresh_rank_hosts()
+        # This workload pins the FLAT chunked ring (algo=ring).
+        # Defensive: the simulated hosts resolve to loopback, so plain
+        # "on" already stays flat (_hier_wins), but the pin keeps this
+        # true even if that rule changes — identically on every process
+        # of the world, or algorithm choice desyncs. The composed path
+        # has its own dist coverage (test_hier_collectives.py).
+        world.hier_enabled = False
         n = 10 << 20  # 40 MiB int32 per rank → ~5 MiB ring segments
         seg_bytes = (n * 4) // world.size
         base = np.arange(n, dtype=np.int32) % 1000

@@ -66,20 +66,44 @@ def test_gate_exempts_container_drift_keys(tmp_path, capsys):
     assert "REGRESSION" not in out
 
 
-def test_gate_reports_lifecycle_keys_without_gating(tmp_path, capsys):
-    """ISSUE 6 disruption latencies are tracked round-over-round but
-    not yet required: a big move prints as a tagged note, and the keys
-    vanishing never fails the gate."""
+def test_gate_lifecycle_keys_promoted_to_gated(tmp_path, capsys):
+    """ISSUE 9 satellite: the ISSUE 6 disruption latencies graduated
+    from REPORTED_ONLY — several rounds of spread exist, so a >20%
+    move now FAILS the gate like any latency key (vanishing still only
+    notes: they are not in REQUIRED_KEYS)."""
+    for key in ("migration_pause_ms", "thaw_to_first_result_s",
+                "partition_heal_s"):
+        assert key not in bench_gate.REPORTED_ONLY
     _write_round(tmp_path, "BENCH_r01.json", 0.05,
                  {"migration_pause_ms": 400.0,
                   "thaw_to_first_result_s": 0.5,
                   "partition_heal_s": 3.0})
     _write_round(tmp_path, "BENCH_r02.json", 0.05,
                  {"migration_pause_ms": 900.0})           # +125%
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "migration_pause_ms" in out
+    # the two keys that only exist in r01 stay notes, not failures
+    assert "thaw_to_first_result_s" not in out.split("REGRESSION", 1)[1]
+
+
+def test_gate_hier_keys_reported_only_first_round(tmp_path, capsys):
+    """ISSUE 9 first-round keys: the hierarchical allreduce rate and
+    the wire-byte ratio (lower-better via the _ratio suffix) are
+    tracked but not gated until a round of spread exists."""
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_hier_gibs": 3.0,
+                  "cross_host_bytes_ratio": 0.27})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_allreduce_hier_gibs": 1.0,       # -67%
+                  "cross_host_bytes_ratio": 0.9})        # +233% (worse)
     assert bench_gate.main(["--repo", str(tmp_path)]) == 0
     out = capsys.readouterr().out
-    assert "migration_pause_ms" in out and "reported-only" in out
+    assert "host_allreduce_hier_gibs" in out and "reported-only" in out
+    assert "cross_host_bytes_ratio" in out
     assert "REGRESSION" not in out
+    # direction sanity: _ratio classifies lower-is-better
+    assert bench_gate.direction("cross_host_bytes_ratio") == -1
 
 
 def test_gate_tolerates_new_and_missing_keys(tmp_path):

@@ -352,6 +352,32 @@ def test_rest_metrics_and_trace(cluster, endpoint):
     assert "faabric_planner_schedule_seconds_bucket" in text
 
 
+def test_rest_topology_scrape(cluster, endpoint):
+    """GET /topology (ISSUE 9): per-host capacity plus the Topology of
+    every in-flight gang-scheduled MPI world, as the planner's
+    dashboard-scrapeable surface of `get_cluster_topology`."""
+    req = batch_exec_factory("demo", "blocker", 4)
+    for m in req.messages:
+        m.is_mpi = True
+    status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH,
+                       json.dumps(req.to_dict()))
+    assert status == 200
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{endpoint}/topology", timeout=10) as resp:
+            assert resp.status == 200
+            topo = json.loads(resp.read())
+        assert set(topo["hosts"]) == {"hostA", "hostB"}
+        assert all(h["slots"] == 4 for h in topo["hosts"].values())
+        world = topo["worlds"][str(req.app_id)]
+        # Gang-scheduled: 4 ranks land co-located on ONE host
+        assert world["size"] == 4 and world["n_hosts"] == 1
+        assert len(world["leaders"]) == 1
+        assert not world["hierarchical"]
+    finally:
+        GateExecutor.blocker_gate.set()
+
+
 def test_rest_bad_requests(cluster, endpoint):
     status, out = post(endpoint, HttpMessageType.EXECUTE_BATCH, "{}")
     assert status == 400

@@ -319,6 +319,162 @@ def test_allreduce_emits_phase_spans(mpi_cluster):
         6 * 200_000 * 8
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical topology-composed collectives (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _force_hier(world_for_rank, enabled=True, chunk=64 * 1024):
+    """Make small test payloads hierarchy-eligible: shrink the pipeline
+    chunk threshold on BOTH host worlds (identically — algorithm choice
+    must agree across every process of a world) and flip the knob.
+    "force" (not True) because the fixture's two simulated hosts live
+    in one process — plain "on" composes only across real machines."""
+    for world in {id(world_for_rank(r)): world_for_rank(r)
+                  for r in range(6)}.values():
+        world.hier_enabled = "force" if enabled else False
+        world.CHUNK_BYTES = chunk
+
+
+def test_world_topology_object(mpi_cluster):
+    t = mpi_cluster(0).topology()
+    assert t.size == 6 and t.hosts == ("mpiA", "mpiB")
+    assert t.host_ranks == {"mpiA": (0, 1, 2), "mpiB": (3, 4, 5)}
+    assert t.leaders == (0, 3)
+    assert t.hierarchical and t.hosts_contiguous()
+    # cached: same object until the rank map is refreshed
+    assert mpi_cluster(0).topology() is t
+
+
+def test_hier_allreduce_bitwise_matches_flat(mpi_cluster):
+    """The composed path (shm reduce-scatter → leader ring →
+    redistribute) must be bitwise-identical to the flat ring on exact
+    dtypes, and tag its spans algo=hier with the three phase levels."""
+    from faabric_tpu.telemetry import reset_tracing, set_tracing, trace_events
+
+    rng = np.random.default_rng(11)
+    datas = {r: rng.integers(-9999, 9999, 200_000).astype(np.int64)
+             for r in range(6)}
+    expected = sum(datas.values())
+
+    def fn(world, rank):
+        return world.allreduce(rank, datas[rank].copy(), MpiOp.SUM)
+
+    _force_hier(mpi_cluster, enabled=False)
+    flat = run_ranks(mpi_cluster, fn)
+
+    _force_hier(mpi_cluster, enabled=True)
+    set_tracing(True)
+    reset_tracing()
+    try:
+        hier = run_ranks(mpi_cluster, fn)
+        events = [e for e in trace_events() if e.get("ph") == "X"]
+    finally:
+        reset_tracing()
+        set_tracing(False)
+
+    for r in range(6):
+        np.testing.assert_array_equal(hier[r], flat[r])
+        np.testing.assert_array_equal(hier[r], expected)
+        assert hier[r].flags.writeable  # private, caller-mutable
+
+    allreduces = [e for e in events if e["cat"] == "mpi"
+                  and e["name"] == "allreduce"]
+    assert len(allreduces) == 6
+    assert all(e["args"]["algo"] == "hier" for e in allreduces)
+    phases = {e["args"].get("phase") for e in events
+              if e["cat"] == "mpi.phase"}
+    assert {"intra", "leader", "redistribute"} <= phases
+
+
+def test_hier_reduce_scatter_and_allgather_match_flat(mpi_cluster):
+    rng = np.random.default_rng(12)
+    rs_datas = {r: rng.integers(-9999, 9999, 120_000).astype(np.int64)
+                for r in range(6)}
+    ag_datas = {r: rng.integers(-9999, 9999, 30_000).astype(np.int64)
+                for r in range(6)}
+
+    def rs_fn(world, rank):
+        return world.reduce_scatter(rank, rs_datas[rank].copy(), MpiOp.SUM)
+
+    def ag_fn(world, rank):
+        return world.allgather(rank, ag_datas[rank].copy())
+
+    _force_hier(mpi_cluster, enabled=False)
+    rs_flat = run_ranks(mpi_cluster, rs_fn)
+    ag_flat = run_ranks(mpi_cluster, ag_fn)
+
+    _force_hier(mpi_cluster, enabled=True)
+    rs_hier = run_ranks(mpi_cluster, rs_fn)
+    ag_hier = run_ranks(mpi_cluster, ag_fn)
+
+    total = sum(rs_datas.values())
+    gathered = np.concatenate([ag_datas[r] for r in range(6)])
+    for r in range(6):
+        np.testing.assert_array_equal(rs_hier[r], rs_flat[r])
+        np.testing.assert_array_equal(rs_hier[r],
+                                      total[r * 20_000:(r + 1) * 20_000])
+        np.testing.assert_array_equal(ag_hier[r], ag_flat[r])
+        np.testing.assert_array_equal(ag_hier[r], gathered)
+        assert rs_hier[r].flags.writeable
+        assert ag_hier[r].flags.writeable
+
+
+def test_hier_fallbacks_stay_flat(mpi_cluster):
+    """Degenerate/ineligible shapes must keep the flat paths: knob off,
+    sub-threshold payloads, and non-commuting user ops."""
+    from faabric_tpu.mpi import UserOp
+    from faabric_tpu.telemetry import reset_tracing, set_tracing, trace_events
+
+    def algos_for(fn):
+        set_tracing(True)
+        reset_tracing()
+        try:
+            run_ranks(mpi_cluster, fn)
+            return {e["args"]["algo"] for e in trace_events()
+                    if e.get("ph") == "X" and e["cat"] == "mpi"
+                    and e["name"] == "allreduce"}
+        finally:
+            reset_tracing()
+            set_tracing(False)
+
+    data = np.full(200_000, 1, dtype=np.int64)
+
+    _force_hier(mpi_cluster, enabled=False)
+    assert "hier" not in algos_for(
+        lambda w, r: w.allreduce(r, data.copy(), MpiOp.SUM))
+
+    _force_hier(mpi_cluster, enabled=True)
+    small = np.full(64, 1, dtype=np.int64)  # below 2 pipeline chunks
+    assert "hier" not in algos_for(
+        lambda w, r: w.allreduce(r, small.copy(), MpiOp.SUM))
+
+    noncommute = UserOp(lambda a, b: a + b, commute=False)
+    assert "hier" not in algos_for(
+        lambda w, r: w.allreduce(r, data.copy(), noncommute))
+
+    # dtype-PROMOTING commuting UserOp stays eligible and correct:
+    # apply_op casts every fold back to the input dtype, so the chunk
+    # protocol's input-itemsize bounds hold on every rank
+    promoting = UserOp(lambda a, b: (a + b).astype(np.float64),
+                       commute=True)
+    assert algos_for(
+        lambda w, r: w.allreduce(r, data.copy(), promoting)) == {"hier"}
+
+    # plain "on" (not "force"): both simulated hosts resolve to this
+    # machine, where the flat ring out-pipelines the composition — the
+    # host_allreduce_procs shape must keep its fast path (_hier_wins)
+    _force_hier(mpi_cluster, enabled=True)
+    for w in {id(mpi_cluster(r)): mpi_cluster(r) for r in range(6)}.values():
+        w.hier_enabled = True
+    assert "hier" not in algos_for(
+        lambda w, r: w.allreduce(r, data.copy(), MpiOp.SUM))
+
+    # eligible control: same payload, commuting op, forced → hier
+    _force_hier(mpi_cluster, enabled=True)
+    assert algos_for(
+        lambda w, r: w.allreduce(r, data.copy(), MpiOp.SUM)) == {"hier"}
+
+
 def test_reduce_to_nonzero_root(mpi_cluster):
     expected = sum(per_rank_data(r) for r in range(6))
 

@@ -1,0 +1,207 @@
+"""Topology object + gang-scheduling placement tests (ISSUE 9).
+
+The Topology is the single structure both sides of the system read:
+``MpiWorld`` composes its hierarchical collectives over it and the
+bin-pack scheduler's gang hook orders hosts by it. These tests pin the
+structure (leader election, host order, degeneracy predicates) and the
+placement ordering the gang hook produces.
+"""
+
+import pytest
+
+from faabric_tpu.batch_scheduler import (
+    BinPackScheduler,
+    HostState,
+    SchedulingDecision,
+    locality_score,
+    reset_batch_scheduler,
+)
+from faabric_tpu.batch_scheduler.bin_pack import (
+    is_mpi_request,
+    sort_hosts_gang,
+    sort_hosts_larger_first,
+)
+from faabric_tpu.mpi.topology import Topology, interleave_hosts, leader_ring
+from faabric_tpu.proto import batch_exec_factory
+from faabric_tpu.util.config import get_system_config
+
+
+def hosts(*specs):
+    """specs: (ip, slots, used)"""
+    return {ip: HostState(ip=ip, slots=s, used_slots=u) for ip, s, u in specs}
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    reset_batch_scheduler()
+    get_system_config().reset()
+
+
+# ---------------------------------------------------------------------------
+# Topology structure
+# ---------------------------------------------------------------------------
+
+def test_topology_structure_and_leader_election():
+    t = Topology({0: "a", 1: "a", 2: "b", 3: "b", 4: "b", 5: "c"})
+    assert t.size == 6
+    assert t.hosts == ("a", "b", "c")  # first appearance by rank
+    assert t.host_ranks == {"a": (0, 1), "b": (2, 3, 4), "c": (5,)}
+    assert t.leaders == (0, 2, 5)  # lowest rank per host
+    assert [t.leader_of(r) for r in range(6)] == [0, 0, 2, 2, 2, 5]
+    assert [t.is_leader(r) for r in range(6)] == \
+        [True, False, True, False, False, True]
+    assert [t.local_rank(r) for r in range(6)] == [0, 1, 0, 1, 2, 0]
+    assert t.ranks_on_host("b") == (2, 3, 4)
+    assert t.ranks_on_host("nope") == ()
+    assert t.host_of(4) == "b"
+    assert t.n_hosts == 3
+    assert t.ranks_per_host == {"a": 2, "b": 3, "c": 1}
+    assert t.max_ranks_per_host == 3
+    assert leader_ring(t) == [0, 2, 5]
+
+
+def test_topology_host_order_follows_rank_zero():
+    """Host order is first-appearance-by-rank, so every participant
+    derives the identical leader ring with no exchange — rank 0's host
+    first even when its name sorts last."""
+    t = Topology({0: "zz", 1: "aa", 2: "zz", 3: "aa"})
+    assert t.hosts == ("zz", "aa")
+    assert t.leaders == (0, 1)
+
+
+def test_topology_rank_set_must_be_dense():
+    with pytest.raises(ValueError):
+        Topology({0: "a", 2: "a"})  # hole at rank 1
+    with pytest.raises(ValueError):
+        Topology({1: "a", 2: "a"})  # starts at 1
+
+
+def test_topology_degenerate_shapes():
+    single = Topology({0: "a", 1: "a", 2: "a"})
+    assert single.single_host and not single.hierarchical
+    assert single.cross_host_pairs() == 0
+
+    spread = Topology({0: "a", 1: "b", 2: "c"})
+    assert spread.one_rank_per_host and not spread.hierarchical
+    assert spread.leaders == (0, 1, 2)
+
+    hier = Topology({0: "a", 1: "a", 2: "b", 3: "b"})
+    assert hier.hierarchical
+
+
+def test_topology_contiguity():
+    assert Topology({0: "a", 1: "a", 2: "b", 3: "b"}).hosts_contiguous()
+    assert not Topology(interleave_hosts(["a", "b"], 4)).hosts_contiguous()
+    # single-rank hosts are trivially contiguous
+    assert Topology({0: "a", 1: "b"}).hosts_contiguous()
+
+
+def test_topology_cross_host_pairs_matches_locality_score():
+    d = SchedulingDecision(app_id=1)
+    for h in ("a", "a", "b", "b"):
+        d.add_message(h, 0, 0, 0)
+    t = d.topology()
+    assert t.cross_host_pairs() == 4
+    assert locality_score(d) == (2, 4)
+
+
+def test_topology_from_decision_fallback_positional():
+    """Decisions whose group idxs are not a dense rank set (non-gang
+    batches) fall back to positional order: host structure survives."""
+    d = SchedulingDecision(app_id=1)
+    d.add_message("a", 10, 0, 7)
+    d.add_message("b", 11, 1, 9)
+    t = d.topology()
+    assert t.size == 2 and t.hosts == ("a", "b")
+
+
+def test_topology_eq_hash_to_dict():
+    t1 = Topology({0: "a", 1: "b"})
+    t2 = Topology({0: "a", 1: "b"})
+    assert t1 == t2 and hash(t1) == hash(t2)
+    assert t1 != Topology({0: "b", 1: "a"})
+    d = t1.to_dict()
+    assert d["n_hosts"] == 2 and d["hosts"] == {"a": [0], "b": [1]}
+    assert d["hierarchical"] is False
+
+
+def test_interleave_hosts_round_robin():
+    assert interleave_hosts(["a", "b"], 4) == {0: "a", 1: "b", 2: "a", 3: "b"}
+
+
+# ---------------------------------------------------------------------------
+# Gang-scheduling placement ordering
+# ---------------------------------------------------------------------------
+
+def _mpi_req(n):
+    req = batch_exec_factory("mpi", "main", n)
+    for m in req.messages:
+        m.is_mpi = True
+    return req
+
+
+def test_is_mpi_request():
+    assert is_mpi_request(_mpi_req(2))
+    assert not is_mpi_request(batch_exec_factory("demo", "echo", 2))
+
+
+def test_sort_hosts_gang_tightest_full_fit_wins():
+    """Among hosts that hold the WHOLE world, the tightest fit wins: an
+    8-rank world lands on the 8-free host, keeping the 16-free host
+    whole for a bigger world. Capacity-blind larger-first would pick
+    the 16-free host."""
+    hm = hosts(("big", 16, 0), ("tight", 8, 0), ("small", 4, 0))
+    order = [h.ip for h in sort_hosts_gang(list(hm.values()), 8)]
+    assert order == ["tight", "big", "small"]
+    assert [h.ip for h in sort_hosts_larger_first(list(hm.values()))][0] \
+        == "big"
+
+
+def test_sort_hosts_gang_swallow_most_when_none_fits():
+    hm = hosts(("a", 4, 0), ("b", 6, 0), ("c", 2, 0))
+    order = [h.ip for h in sort_hosts_gang(list(hm.values()), 10)]
+    assert order == ["b", "a", "c"]
+
+
+def test_sort_hosts_gang_tightest_fit_applies_to_remainder():
+    """The tightest-fit rule re-evaluates against the SHRINKING
+    remainder: world of 10 over 6/5/4-free hosts spills from the 6-host
+    onto the exact-fit 4-host, not the 5-host it would fragment."""
+    hm = hosts(("a", 6, 0), ("b", 5, 0), ("c", 4, 0))
+    order = [h.ip for h in sort_hosts_gang(list(hm.values()), 10)]
+    assert order == ["a", "c", "b"]
+
+
+def test_bin_pack_gang_schedules_mpi_world():
+    sched = BinPackScheduler()
+    hm = hosts(("10.0.0.1", 16, 0), ("10.0.0.2", 8, 0))
+    d = sched.make_scheduling_decision(hm, {}, _mpi_req(8))
+    assert d.hosts == ["10.0.0.2"] * 8  # one host, gang-packed
+    assert d.topology().single_host
+
+    # the same shape non-MPI keeps the classic larger-first order
+    d2 = sched.make_scheduling_decision(hm, {},
+                                        batch_exec_factory("demo", "e", 8))
+    assert d2.hosts == ["10.0.0.1"] * 8
+
+
+def test_bin_pack_gang_spills_contiguously():
+    """A world too big for any host fills the most-swallowing host
+    first and spills the remainder — a contiguous, hierarchical-ready
+    placement (ranks 0..5 on one host, 6..9 on the next; the b/c tie
+    breaks ip-descending like the classic sort)."""
+    sched = BinPackScheduler()
+    hm = hosts(("a", 6, 0), ("b", 4, 0), ("c", 4, 0))
+    d = sched.make_scheduling_decision(hm, {}, _mpi_req(10))
+    assert d.hosts == ["a"] * 6 + ["c"] * 4
+    t = d.topology()
+    assert t.hosts_contiguous() and t.hierarchical
+
+
+def test_bin_pack_gang_knob_off_restores_larger_first():
+    get_system_config().gang_schedule_mpi = False
+    sched = BinPackScheduler()
+    hm = hosts(("10.0.0.1", 16, 0), ("10.0.0.2", 8, 0))
+    d = sched.make_scheduling_decision(hm, {}, _mpi_req(8))
+    assert d.hosts == ["10.0.0.1"] * 8
