@@ -502,12 +502,24 @@ def _hier_bench_world(my_host_idx: int, n_hosts: int,
     return broker, server, world, my_ranks
 
 
+def _quant_bench_data(rank: int, elems: int):
+    """Deterministic varied fp32 payload for the quant mode — every
+    process derives the same per-rank arrays (constant vectors would
+    quantize exactly and report a misleading 0 error)."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + rank)
+    return rng.uniform(-1000.0, 1000.0, elems).astype(np.float32)
+
+
 def _hier_allreduce_modes(world, my_ranks, elems, rounds):
-    """Run the allreduce workload once per algorithm mode (flat ring,
-    then hierarchical), barrier-fenced so every process flips
-    ``hier_enabled`` at a quiesced point. Returns
+    """Run the allreduce workload once per mode — flat ring,
+    hierarchical, and hierarchical + int8 leader-ring quantization
+    (FAABRIC_ALLREDUCE_QUANT satellite, fp32 payload) — barrier-fenced
+    so every process flips the world knobs at a quiesced point. Returns
     (per-mode elapsed seconds, per-mode outbound comm-matrix byte
-    deltas for THIS process, ok)."""
+    deltas for THIS process, ok, max-abs quantization error over this
+    process's ranks)."""
     import numpy as np
 
     from faabric_tpu.telemetry import get_comm_matrix
@@ -520,14 +532,20 @@ def _hier_allreduce_modes(world, my_ranks, elems, rounds):
                    if c["plane"] in ("shm", "bulk-tcp"))
 
     elapsed, cross, oks = {}, {}, []
+    quant_err = 0.0
     # "force": the simulated hosts all resolve to loopback, and plain
     # "on" composes only across real machines (_hier_wins)
-    for mode, hier in (("flat", False), ("hier", "force")):
+    for mode, hier in (("flat", False), ("hier", "force"),
+                       ("quant", "force")):
         world.hier_enabled = hier
+        world.allreduce_quant = "int8" if mode == "quant" else ""
         results = {}
 
-        def rank_fn(rank):
-            data = np.full(elems, rank + 1, dtype=np.int32)
+        def rank_fn(rank, _mode=mode):
+            if _mode == "quant":
+                data = _quant_bench_data(rank, elems)
+            else:
+                data = np.full(elems, rank + 1, dtype=np.int32)
             world.barrier(rank)
             t0 = time.perf_counter()
             out = None
@@ -545,10 +563,21 @@ def _hier_allreduce_modes(world, my_ranks, elems, rounds):
             t.join()
         cross[mode] = cm_bytes() - b0
         elapsed[mode] = max(v[0] for v in results.values())
-        expected = world.size * (world.size + 1) // 2
-        oks.append(all(int(v[1][0]) == expected
-                       for v in results.values()))
-    return elapsed, cross, all(oks)
+        if mode == "quant":
+            exact = sum(_quant_bench_data(r, elems)
+                        for r in range(world.size))
+            quant_err = max(
+                float(np.max(np.abs(v[1] - exact)))
+                for v in results.values())
+            # Loose sanity bound: per-fold error ≤ scale/2 with interim
+            # magnitudes ≤ n·1000 → scale ≤ n·1000/127; (H−1) fold hops
+            oks.append(quant_err < world.size * 1000.0 / 16)
+        else:
+            expected = world.size * (world.size + 1) // 2
+            oks.append(all(int(v[1][0]) == expected
+                           for v in results.values()))
+    world.allreduce_quant = ""
+    return elapsed, cross, all(oks), quant_err
 
 
 def _hier_worker_main(host_idx: int, n_hosts: int, ranks_per_host: int,
@@ -558,9 +587,10 @@ def _hier_worker_main(host_idx: int, n_hosts: int, ranks_per_host: int,
         host_idx, n_hosts, ranks_per_host)
     print("READY", flush=True)
     try:
-        _, cross, ok = _hier_allreduce_modes(world, my_ranks, elems,
-                                             rounds)
-        print(f"BYTES {cross['flat']} {cross['hier']}", flush=True)
+        _, cross, ok, _err = _hier_allreduce_modes(world, my_ranks, elems,
+                                                   rounds)
+        print(f"BYTES {cross['flat']} {cross['hier']} {cross['quant']}",
+              flush=True)
         print("DONE" if ok else "FAILED bad-allreduce-value", flush=True)
     except Exception as e:  # noqa: BLE001 — reported to parent
         print(f"FAILED {e!r}"[:160], flush=True)
@@ -616,15 +646,17 @@ def bench_host_allreduce_hier(n_hosts: int = 4, ranks_per_host: int = 2,
         for c in children:
             line = c.stdout.readline().strip()
             assert line == "READY", f"hier worker said {line!r}"
-        elapsed, cross, ok = _hier_allreduce_modes(world, my_ranks,
-                                                   elems, rounds)
+        elapsed, cross, ok, quant_err = _hier_allreduce_modes(
+            world, my_ranks, elems, rounds)
         assert ok, "parent ranks saw a bad allreduce value"
         flat_bytes, hier_bytes = cross["flat"], cross["hier"]
+        quant_bytes = cross["quant"]
         for c in children:
             bline = c.stdout.readline().split()
             assert bline and bline[0] == "BYTES", bline
             flat_bytes += int(bline[1])
             hier_bytes += int(bline[2])
+            quant_bytes += int(bline[3])
             status = c.stdout.readline().strip()
             assert status == "DONE", f"hier worker reported {status!r}"
 
@@ -644,6 +676,19 @@ def bench_host_allreduce_hier(n_hosts: int = 4, ranks_per_host: int = 2,
                 if flat_bytes else None,
                 "model_ratio": round((n_hosts - 1) / (n - 1), 4),
             },
+            # FAABRIC_ALLREDUCE_QUANT satellite: same fp32 payload
+            # through the hierarchical path with the leader ring's fold
+            # leg quantized to int8 + per-chunk scales. Model: the fold
+            # leg drops to ~1/4 of its fp32 bytes, the (unquantized)
+            # allgather leg is unchanged → ~5/8 of the hier bytes.
+            "quant": {
+                "mode": "int8",
+                "effective_gibs": effective / elapsed["quant"] / (1 << 30),
+                "max_abs_err": quant_err,
+                "cross_host_bytes": quant_bytes,
+                "vs_hier_bytes_ratio": round(quant_bytes / hier_bytes, 4)
+                if hier_bytes else None,
+            },
         }
     finally:
         server.stop()
@@ -654,6 +699,144 @@ def bench_host_allreduce_hier(n_hosts: int = 4, ranks_per_host: int = 2,
             except Exception:  # noqa: BLE001
                 c.kill()
         clear_host_aliases()
+
+
+def _device_plane_worker_main(elems: int, rounds: int) -> None:
+    """Child body (ISSUE 10 bench): ONE process, 4 rank threads × 4
+    virtual CPU devices. The same payload runs through the host flat
+    ring first (plane not yet activated), then through the activated
+    device plane; prints one JSON line with both rates, bitwise
+    identity, and the comm-matrix accounting proof (device rows carry
+    the traffic, host data planes carry none of it)."""
+    import json as _json
+
+    # The image's sitecustomize force-registers the remote-TPU plugin;
+    # pin the backend back to the env-selected CPU before first use
+    # (same dance as tests/conftest.py)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+    from faabric_tpu.mpi import MpiWorld
+    from faabric_tpu.telemetry import get_comm_matrix
+    from faabric_tpu.transport.point_to_point import PointToPointBroker
+
+    n = 4
+    broker = PointToPointBroker("xdev")
+    d = SchedulingDecision(app_id=12, group_id=12)
+    for r in range(n):
+        d.add_message("xdev", 70 + r, r, r, device_id=r)
+    broker.set_up_local_mappings_from_decision(d)
+    world = MpiWorld(broker, 12, n, 12)
+    world.refresh_rank_hosts()
+
+    datas = {r: np.full(elems, r + 1, dtype=np.int32) for r in range(n)}
+    expected0 = n * (n + 1) // 2
+
+    def run_rounds(tag, n_rounds=None):
+        n_rounds = rounds if n_rounds is None else n_rounds
+        results = {}
+
+        def rank_fn(rank):
+            world.barrier(rank)
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n_rounds):
+                out = world.allreduce(rank, datas[rank], _mpi_sum())
+            world.barrier(rank)
+            results[rank] = (time.perf_counter() - t0, out)
+
+        threads = [threading.Thread(target=rank_fn, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(int(v[1][0]) == expected0 for v in results.values()), (
+            tag, {r: int(v[1][0]) for r, v in results.items()})
+        return (max(v[0] for v in results.values()),
+                {r: v[1] for r, v in results.items()})
+
+    def plane_bytes():
+        cells = (get_comm_matrix().snapshot() or {}).get("cells", [])
+        out: dict = {}
+        for c in cells:
+            out[c["plane"]] = out.get(c["plane"], 0) + c["bytes"]
+        return out
+
+    host_elapsed, host_out = run_rounds("host")
+
+    acts = {}
+
+    def act(rank):
+        acts[rank] = world.activate_device_plane(rank)
+
+    threads = [threading.Thread(target=act, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(acts.values()), f"activation failed: {acts}"
+    run_rounds("warm", n_rounds=1)  # the compile happens off the clock
+    b0 = plane_bytes()
+    dev_elapsed, dev_out = run_rounds("device")
+    b1 = plane_bytes()
+    delta = {p: b1.get(p, 0) - b0.get(p, 0) for p in set(b0) | set(b1)}
+
+    payload = elems * 4
+    effective = 4 * (n - 1) * payload * rounds
+    identical = all(np.array_equal(dev_out[r], host_out[r])
+                    for r in range(n))
+    plane = world.device_plane()
+    print(_json.dumps({
+        "effective_gibs": effective / dev_elapsed / (1 << 30),
+        "host_effective_gibs": effective / host_elapsed / (1 << 30),
+        "np": n, "n_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "payload_mib": payload / (1 << 20), "rounds": rounds,
+        "identical": identical,
+        # Accounting proof: the timed device rounds put n·payload·rounds
+        # on plane=device rows and ZERO on the host data planes
+        "device_bytes": delta.get("device", 0),
+        "device_bytes_expected": n * payload * rounds,
+        "host_plane_bytes": sum(v for p, v in delta.items()
+                                if p in ("shm", "bulk-tcp")),
+        "cached_executables": len(
+            (plane.summary() or {}).get("cached_executables", []))
+        if plane else 0,
+    }), flush=True)
+
+
+def bench_host_allreduce_device(elems: int = 6_000_000,
+                                rounds: int = 2) -> dict:
+    """ISSUE 10 acceptance bench: the device collective plane vs the
+    host flat ring on the SAME payload, same process shape (4 rank
+    threads), CPU backend with 4 virtual devices — the configuration
+    this container can actually run; on TPU the identical code path
+    rides ICI. Subprocess-isolated because the forced device count and
+    backend pin must be set before JAX initialises."""
+    import json as _json
+    import subprocess
+
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": " ".join(flags)}
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--device-plane-worker", str(elems), str(rounds)],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert p.returncode == 0, (p.stdout[-500:], p.stderr[-500:])
+    line = [ln for ln in p.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    out = _json.loads(line)
+    assert out["identical"], "device plane result != host ring result"
+    assert out["host_plane_bytes"] == 0, out
+    assert out["device_bytes"] == out["device_bytes_expected"], out
+    return out
 
 
 def _bench_journal_micro(quick: bool = False) -> dict:
@@ -2588,6 +2771,10 @@ def main() -> None:
                      # reads a meaningless ~1.0
                      elems=2_500_000 if quick else 6_000_000,
                      rounds=1 if quick else 2))
+    host_section("host_allreduce_device",
+                 lambda: bench_host_allreduce_device(
+                     elems=1_500_000 if quick else 6_000_000,
+                     rounds=1 if quick else 2))
     host_section("concurrency", lambda: bench_concurrency(quick))
     host_section("invocations", lambda: bench_invocations(quick))
     host_section("robustness", lambda: bench_robustness(quick))
@@ -2651,6 +2838,16 @@ def main() -> None:
             hr["effective_gibs"], 2)
     if (hr.get("cross_host_bytes") or {}).get("ratio") is not None:
         summary["cross_host_bytes_ratio"] = hr["cross_host_bytes"]["ratio"]
+    if (hr.get("quant") or {}).get("max_abs_err") is not None:
+        summary["allreduce_quant_max_abs_err"] = round(
+            hr["quant"]["max_abs_err"], 4)
+    # ISSUE 10 device collective plane (REPORTED_ONLY first round): the
+    # compiled-mesh allreduce rate on the CPU backend, vs the host flat
+    # ring on the identical payload/process shape
+    dv = extras.get("host_allreduce_device") or {}
+    if dv.get("effective_gibs"):
+        summary["host_allreduce_device_gibs"] = round(
+            dv["effective_gibs"], 2)
     sr = extras.get("host_sendrecv_procs") or {}
     if sr.get("rate_gibs"):
         summary["host_sendrecv_gibs"] = round(sr["rate_gibs"], 2)
@@ -2704,6 +2901,11 @@ if __name__ == "__main__":
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         i = sys.argv.index("--hier-worker")
         _hier_worker_main(*(int(a) for a in sys.argv[i + 1:i + 6]))
+    elif "--device-plane-worker" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        i = sys.argv.index("--device-plane-worker")
+        _device_plane_worker_main(int(sys.argv[i + 1]),
+                                  int(sys.argv[i + 2]))
     elif "--device-only" in sys.argv:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         out_path = None

@@ -29,7 +29,8 @@ def sort_hosts_larger_first(hosts: list[HostState]) -> list[HostState]:
     return sorted(hosts, key=lambda h: (h.available, h.slots, h.ip), reverse=True)
 
 
-def sort_hosts_gang(hosts: list[HostState], world_size: int) -> list[HostState]:
+def sort_hosts_gang(hosts: list[HostState], world_size: int,
+                    prefer_devices: bool = False) -> list[HostState]:
     """Gang order for an MPI world of ``world_size`` ranks: the host
     that can swallow the most of the REMAINDER first; among hosts that
     fit the whole remainder, the tightest fit wins (an 8-rank world
@@ -40,13 +41,28 @@ def sort_hosts_gang(hosts: list[HostState], world_size: int) -> list[HostState]:
     10 → 6-host then the exact-fit 4-host, not the 5-host it would
     fragment). Hosts the world never reaches follow in the classic
     larger-first order. Capacity-blind larger-first would fragment the
-    big host and scatter the next world topology-blind."""
+    big host and scatter the next world topology-blind.
+
+    ``prefer_devices`` (ISSUE 10; default OFF — the caller derives it
+    from the REQUEST via ``request_wants_devices``, never from the host
+    pool, so a world with no device demand cannot be steered onto chip
+    hosts and starve a later device-eligible world of them) adds a
+    mesh-contiguity tie-break: among hosts swallowing the same share of
+    the remainder, one whose device count covers the ranks it would
+    take ranks first — each rank gets its own chip, so the placement's
+    Topology reads mesh_contiguous and the world's device-plane
+    activation resolves cleanly instead of aliasing chips."""
     pool = list(hosts)
     order: list[HostState] = []
     remaining = world_size
     while pool and remaining > 0:
-        best = max(pool, key=lambda h: (min(h.available, remaining),
-                                        -h.available, h.ip))
+        def key(h, _rem=remaining):
+            take = min(h.available, _rem)
+            covers = 1 if (prefer_devices and take > 0
+                           and h.n_devices >= take) else 0
+            return (take, covers, -h.available, h.ip)
+
+        best = max(pool, key=key)
         pool.remove(best)
         order.append(best)
         remaining -= best.available
@@ -80,6 +96,16 @@ def is_mpi_request(req: BatchExecuteRequest) -> bool:
     return req.n_messages() > 0 and bool(req.messages[0].is_mpi)
 
 
+def request_wants_devices(req: BatchExecuteRequest) -> bool:
+    """Device eligibility of a REQUEST (ISSUE 10): does this batch want
+    each rank on its own chip? Today every gang-scheduled MPI world is
+    device-eligible — the planner claims one device per rank
+    unconditionally and the world may run the activation handshake —
+    so this is exactly ``is_mpi_request``. One place to refine when the
+    proto grows an explicit per-request device demand."""
+    return is_mpi_request(req)
+
+
 class BinPackScheduler(BatchScheduler):
     def get_sorted_hosts(self, host_map: HostMap, in_flight: InFlightReqs,
                          req: BatchExecuteRequest,
@@ -90,7 +116,9 @@ class BinPackScheduler(BatchScheduler):
         if decision_type == DecisionType.NEW:
             if (is_mpi_request(req)
                     and get_system_config().gang_schedule_mpi):
-                return sort_hosts_gang(hosts, req.n_messages())
+                return sort_hosts_gang(
+                    hosts, req.n_messages(),
+                    prefer_devices=request_wants_devices(req))
             return sort_hosts_larger_first(hosts)
 
         old_decision = in_flight[req.app_id][1]

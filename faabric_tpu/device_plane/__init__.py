@@ -1,0 +1,43 @@
+"""Device-native collective plane (ISSUE 10 / ROADMAP item 3).
+
+The fourth transport rung of the MPI dispatch ladder (shm → tcp →
+device): worlds whose ranks all resolve onto devices of one JAX mesh
+run allreduce / allgather / reduce_scatter as compiled donated-buffer
+XLA programs over that mesh — ICI on TPU, the gloo CPU collectives
+layer in this container — instead of bouncing device-resident data
+through the host planes.
+
+- :mod:`registry` — the registration handshake: per-rank device
+  registration, the one-shot allgather exchange, and the deterministic
+  mesh-resolution verdict (``MeshMismatch`` → host ladder).
+- :mod:`plane` — :class:`DevicePlane`: the per-world rendezvous
+  executor, the (kind, op, elems, dtype)-keyed compiled-executable
+  cache with input donation, the eligibility/fallback ladder, and the
+  ``plane=device`` comm-matrix + ``phase=compile|execute`` telemetry.
+
+Entry point: ``MpiWorld.activate_device_plane(rank, ...)`` — a
+collective call every rank makes once after the world forms (and after
+any migration remap); see docs/data_plane.md.
+"""
+
+from faabric_tpu.device_plane.plane import (
+    DEVICE_PLANE_TIMEOUT_S,
+    DevicePlane,
+)
+from faabric_tpu.device_plane.registry import (
+    DevicePlaneFallback,
+    MeshMismatch,
+    registration_row,
+    resolve_local_device,
+    resolve_mesh,
+)
+
+__all__ = [
+    "DEVICE_PLANE_TIMEOUT_S",
+    "DevicePlane",
+    "DevicePlaneFallback",
+    "MeshMismatch",
+    "registration_row",
+    "resolve_local_device",
+    "resolve_mesh",
+]

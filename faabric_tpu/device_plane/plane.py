@@ -1,0 +1,385 @@
+"""The device collective plane: compiled, donated-buffer collectives.
+
+The fourth rung of the MPI dispatch ladder (shm → tcp → device): when a
+world's ranks all resolved onto devices of one JAX mesh (registry.py),
+allreduce / allgather / reduce_scatter run as ONE compiled XLA program
+over that mesh instead of chunk-pipelined host rings — on TPU the
+collective rides ICI scheduled by XLA; on this container's CPU backend
+the same code runs over virtual devices (cross-process via the gloo
+collectives layer), which is what the tests and bench drive today.
+
+Execution model (multi-controller SPMD): rank threads of one process
+rendezvous per collective — each deposits its host buffer, the LAST
+arriver becomes the executor: it places every local rank's buffer onto
+its registered device, assembles the global array
+(``make_array_from_single_device_arrays``), runs the cached compiled
+executable with the input **donated** (XLA may reuse the input buffer
+for the output — no second HBM allocation on device backends), and
+hands each local rank the addressable shard of its own device. Worlds
+spanning processes run the identical program in every process, exactly
+like jax's multi-process SPMD model — no cross-process bytes ever touch
+the host shm/tcp planes.
+
+Executables are cached per (kind, op, elems, dtype) — the ISSUE 10
+shape/dtype/op key — and compilation is surfaced as a
+``phase=compile`` span (cache misses are visible in traces next to the
+``phase=execute`` steady state).
+
+Failure contract: eligibility is a pure function of (shape, dtype, op)
+plus the activation verdict, so every rank of every process picks the
+same rung. A backend error while executing disables the plane and
+raises :class:`DevicePlaneFallback`, which MpiWorld catches to re-run
+the collective on the host ladder. Caveat (documented in
+docs/data_plane.md): the backend collective is itself synchronous
+across processes, so a mid-collective backend failure surfaces in every
+process; an error that somehow struck ONE process only would leave the
+others waiting in the backend until its own timeout.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+
+import numpy as np
+
+from faabric_tpu.device_plane.registry import DevicePlaneFallback
+from faabric_tpu.mpi.types import MpiOp, UserOp
+from faabric_tpu.telemetry import get_comm_matrix, get_metrics, span
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+# XLA backends without donation support (CPU) warn per executable; the
+# donation is an optimization contract, not a correctness one — keep the
+# logs quiet where it cannot be honoured (TPU honours it).
+warnings.filterwarnings(
+    "ignore", message=".*donated buffers were not usable.*")
+
+# A rank thread waiting for its rendezvous peers (same process, same
+# collective) — generous: peers are sibling threads, not the network,
+# but a loaded 2-core container can park a thread for seconds
+DEVICE_PLANE_TIMEOUT_S = float(
+    os.environ.get("FAABRIC_DEVICE_PLANE_TIMEOUT", "120"))
+
+_ALLREDUCE_OPS = (MpiOp.SUM, MpiOp.MAX, MpiOp.MIN, MpiOp.PROD)
+
+_metrics = get_metrics()
+_COLLECTIVES = {
+    kind: _metrics.counter(
+        "faabric_device_plane_collectives_total",
+        "Collectives executed on the device plane (per rank)", op=kind)
+    for kind in ("allreduce", "allgather", "reduce_scatter")}
+_COMPILES = _metrics.counter(
+    "faabric_device_plane_compiles_total",
+    "Device-plane executable cache misses (compilations)")
+_FALLBACKS = _metrics.counter(
+    "faabric_device_plane_fallbacks_total",
+    "Device plane disables (collectives re-routed to the host ladder)")
+
+
+class _Round:
+    """One rendezvous: the local rank threads of one collective call.
+    Internally synchronized by the owning plane's lock + the ready
+    event; fields are written before ready.set() and read after."""
+
+    __slots__ = ("deposits", "results", "error", "ready")
+
+    def __init__(self) -> None:
+        self.deposits: dict[int, tuple] = {}  # rank → (key, flat buf)
+        self.results: dict[int, np.ndarray] | None = None
+        self.error: BaseException | None = None
+        self.ready = threading.Event()
+
+
+class DevicePlane:
+    """Compiled collectives bound to one world's resolved mesh."""
+
+    # Rendezvous state and the disable verdict mutate under _lock from
+    # N rank threads; the executable cache under its own leaf lock (the
+    # executor holds it across a compile — seconds — which must not
+    # block peers' deposits for the NEXT round).
+    GUARDS = {
+        "_rounds": "_lock",
+        "_rank_seq": "_lock",
+        "_disabled": "_lock",
+        "_cache": "_cache_lock",
+    }
+
+    def __init__(self, world_id: int, devices, local_ranks,
+                 topology_gen: int, axis_name: str = "ranks") -> None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self.world_id = world_id
+        self.devices = list(devices)          # rank i ↔ devices[i]
+        self.n = len(self.devices)
+        self.local_ranks = tuple(sorted(local_ranks))
+        self.n_local = len(self.local_ranks)
+        self.topology_gen = topology_gen
+        self.axis = axis_name
+        self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self._in_sharding = NamedSharding(self.mesh, P(axis_name, None))
+        self._rank_of_device = {d: r for r, d in enumerate(self.devices)}
+        self._jax = jax
+
+        self._lock = threading.Lock()
+        self._rounds: dict[int, _Round] = {}
+        self._rank_seq: dict[int, int] = {}
+        self._disabled: str | None = None
+        self._cache_lock = threading.Lock()
+        self._cache: dict[tuple, object] = {}
+
+    # ------------------------------------------------------------------
+    # Eligibility / fallback ladder
+    # ------------------------------------------------------------------
+    def eligible(self, kind: str, arr: np.ndarray, op=None) -> bool:
+        """Pure function of (activation verdict, shape, dtype, op):
+        every rank of every process derives the same rung. Ineligible
+        shapes take the host ladder with no device-plane involvement."""
+        with self._lock:
+            if self._disabled is not None:
+                return False
+        a = np.asarray(arr)
+        # Exact int folds and IEEE float reductions compile; bool,
+        # complex, structured (MINLOC pairs) and object dtypes do not
+        if a.size == 0 or a.dtype.kind not in "iuf":
+            return False
+        # Canonicalization guard: with jax_enable_x64 off (this repo
+        # never enables it) device_put silently DOWNCASTS 64-bit
+        # buffers to 32-bit — wrong result dtype and overflow-corrupt
+        # sums past 2^31. Payloads whose canonical jax dtype differs
+        # from their numpy dtype keep the exact host ladder. (The x64
+        # flag, like every ladder input, must agree across the world's
+        # processes — it is process-global jax config.)
+        if self._jax.dtypes.canonicalize_dtype(a.dtype) != a.dtype:
+            return False
+        if isinstance(op, UserOp):
+            return False  # arbitrary python folds cannot compile
+        if kind == "allreduce":
+            return op in _ALLREDUCE_OPS
+        if kind == "reduce_scatter":
+            return op == MpiOp.SUM and a.size % self.n == 0
+        if kind == "allgather":
+            return op is None
+        return False
+
+    def disable(self, reason: str) -> None:
+        """One-way: after any backend error / rendezvous breakdown the
+        plane routes everything to the host ladder (re-activation means
+        a fresh handshake on the next topology generation)."""
+        with self._lock:
+            if self._disabled is not None:
+                return
+            self._disabled = reason
+        _FALLBACKS.inc()
+        logger.warning("Device plane (world %s) disabled: %s",
+                       self.world_id, reason)
+
+    @property
+    def disabled_reason(self) -> str | None:
+        with self._lock:
+            return self._disabled
+
+    # ------------------------------------------------------------------
+    # Collectives (MpiWorld-facing; per-rank host buffers in and out)
+    # ------------------------------------------------------------------
+    def allreduce(self, rank: int, data: np.ndarray,
+                  op: MpiOp = MpiOp.SUM) -> np.ndarray:
+        out = self._collective("allreduce", rank, data, op)
+        return out.reshape(np.asarray(data).shape)
+
+    def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
+        return self._collective("allgather", rank, data, None)
+
+    def reduce_scatter(self, rank: int, data: np.ndarray,
+                       op: MpiOp = MpiOp.SUM) -> np.ndarray:
+        return self._collective("reduce_scatter", rank, data, op)
+
+    # ------------------------------------------------------------------
+    def _collective(self, kind: str, rank: int, data: np.ndarray,
+                    op) -> np.ndarray:
+        flat = np.ascontiguousarray(np.asarray(data).reshape(-1))
+        key = (kind, int(op) if op is not None else -1,
+               flat.size, str(flat.dtype))
+        with self._lock:
+            if self._disabled is not None:
+                raise DevicePlaneFallback(self._disabled)
+            if rank not in self.local_ranks:
+                raise DevicePlaneFallback(
+                    f"rank {rank} is not local to this plane")
+            # Collectives are globally ordered per world, so each
+            # rank's Nth device collective belongs to rendezvous N
+            seq = self._rank_seq.get(rank, 0)
+            self._rank_seq[rank] = seq + 1
+            rnd = self._rounds.get(seq)
+            if rnd is None:
+                rnd = _Round()
+                self._rounds[seq] = rnd
+            rnd.deposits[rank] = (key, flat)
+            last = len(rnd.deposits) == self.n_local
+
+        if last:
+            try:
+                rnd.results = self._execute(kind, key, rnd.deposits)
+            except BaseException as e:  # noqa: BLE001 — delivered to
+                # every waiting peer below; backend errors additionally
+                # disable the plane so later collectives skip the rung
+                if not isinstance(e, DevicePlaneFallback):
+                    self.disable(f"backend error: {e!r}")
+                    e = DevicePlaneFallback(
+                        f"device collective failed: {e!r}")
+                rnd.error = e
+            with self._lock:
+                self._rounds.pop(seq, None)
+            rnd.ready.set()
+        else:
+            while not rnd.ready.wait(DEVICE_PLANE_TIMEOUT_S):
+                with self._lock:
+                    gathered = len(rnd.deposits) == self.n_local
+                if gathered:
+                    # Every local rank deposited — the executor is
+                    # running (a first-shape compile or the backend
+                    # collective itself can outlast the window). Keep
+                    # waiting, exactly like a blocked host collective:
+                    # a timing out here would desync this rank from the
+                    # executor, which WILL return a device result. The
+                    # executor's own failure path sets error + ready.
+                    continue
+                # Peers genuinely missing: a local rank never entered
+                # this collective — protocol breakdown, not slowness
+                with self._lock:
+                    self._rounds.pop(seq, None)
+                self.disable(
+                    f"rendezvous timeout: round {seq} gathered "
+                    f"{len(rnd.deposits)}/{self.n_local} local ranks")
+                raise DevicePlaneFallback(
+                    "device-plane rendezvous timeout")
+
+        if rnd.error is not None:
+            raise rnd.error
+        _COLLECTIVES[kind].inc()
+        # Truthful accounting: this rank's contribution entered the
+        # device plane (ring-neighbour attribution in mesh rank order;
+        # the host planes saw none of it)
+        get_comm_matrix().record(rank, (rank + 1) % self.n, "device",
+                                 int(flat.nbytes))
+        return rnd.results[rank]
+
+    # ------------------------------------------------------------------
+    def _execute(self, kind: str, key: tuple,
+                 deposits: dict[int, tuple]) -> dict[int, np.ndarray]:
+        """Executor body (one thread per process per round): global
+        array assembly → compiled run (donated input) → per-rank shard
+        readback."""
+        jax = self._jax
+        for r, (k, _buf) in deposits.items():
+            if k != key:
+                raise RuntimeError(  # protocol desync — NOT a fallback
+                    f"device-plane rendezvous mismatch: rank {r} "
+                    f"deposited {k}, executor saw {key}")
+        _kind, op_code, m, dtype = key
+
+        with self._cache_lock:
+            compiled = self._cache.get(key)
+        shards = [
+            jax.device_put(buf[None], self.devices[r])
+            for r, (_k, buf) in sorted(deposits.items())]
+        x = jax.make_array_from_single_device_arrays(
+            (self.n, m), self._in_sharding, shards)
+        if compiled is None:
+            # Rounds are sequential per plane (a rank cannot enter round
+            # N+1 before round N released it), so one executor compiles
+            # at a time — the lock only orders the publish
+            _COMPILES.inc()
+            with span("mpi.phase", "compile", phase="compile",
+                      world=self.world_id, kind=kind, elems=m,
+                      dtype=dtype):
+                jfn = self._build(kind, op_code)
+                compiled = jfn.lower(x).compile()
+            with self._cache_lock:
+                self._cache[key] = compiled
+
+        with span("mpi.phase", "execute", phase="execute",
+                  world=self.world_id, kind=kind, elems=m, dtype=dtype):
+            y = compiled(x)
+            return self._distribute(kind, y)
+
+    def _build(self, kind: str, op_code: int):
+        """The jitted program for one (kind, op): a shard_map whose
+        body is the single jax.lax collective, input donated."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from faabric_tpu.parallel.collectives import shard_map_compat
+
+        axis = self.axis
+        check_vma = None
+        if kind == "allreduce":
+            op = MpiOp(op_code)
+            prim = {MpiOp.SUM: jax.lax.psum, MpiOp.MAX: jax.lax.pmax,
+                    MpiOp.MIN: jax.lax.pmin}.get(op)
+            if prim is not None:
+                def f(shard):  # (1, m) → (1, m), every row the reduction
+                    return prim(shard, axis)
+            else:  # PROD: gather + fold (no pprod primitive)
+                def f(shard):
+                    g = jax.lax.all_gather(shard[0], axis, tiled=False)
+                    return jnp.prod(g, axis=0,
+                                    keepdims=True).astype(shard.dtype)
+            out_spec = P(axis, None)
+        elif kind == "reduce_scatter":
+            def f(shard):  # (1, n·k) → (1, k)
+                return jax.lax.psum_scatter(shard, axis,
+                                            scatter_dimension=1,
+                                            tiled=True)
+            out_spec = P(axis, None)
+        elif kind == "allgather":
+            def f(shard):  # (1, k) → (n·k,) replicated
+                return jax.lax.all_gather(shard[0], axis, tiled=True)
+            out_spec = P()
+            # Replicated output the static check cannot infer — the
+            # same version-portable disable parallel/collectives.py uses
+            check_vma = False
+        else:
+            raise RuntimeError(f"unknown device collective {kind}")
+
+        fn = shard_map_compat(f, mesh=self.mesh,
+                              in_specs=P(axis, None),
+                              out_specs=out_spec, check_vma=check_vma)
+        return jax.jit(fn, donate_argnums=0)
+
+    def _distribute(self, kind: str, y) -> dict[int, np.ndarray]:
+        """Per-rank host buffers from the output's addressable shards.
+        Each copy is private and writable (MPI result semantics)."""
+        if kind == "allgather":
+            # Replicated output: one readback, one private copy per rank
+            full = np.array(y.addressable_shards[0].data)
+            return {r: (full if i == 0 else full.copy())
+                    for i, r in enumerate(self.local_ranks)}
+        out: dict[int, np.ndarray] = {}
+        for s in y.addressable_shards:
+            r = self._rank_of_device.get(s.device)
+            if r is not None:
+                out[r] = np.array(s.data)[0]
+        missing = [r for r in self.local_ranks if r not in out]
+        if missing:
+            raise RuntimeError(
+                f"output shards missing for local ranks {missing}")
+        return out
+
+    def summary(self) -> dict:
+        """Observability snapshot (tests / debugging endpoints)."""
+        with self._cache_lock:
+            cached = sorted(str(k) for k in self._cache)
+        return {
+            "world_id": self.world_id,
+            "size": self.n,
+            "local_ranks": list(self.local_ranks),
+            "platform": self.devices[0].platform if self.devices else "",
+            "topology_gen": self.topology_gen,
+            "disabled": self.disabled_reason,
+            "cached_executables": cached,
+        }

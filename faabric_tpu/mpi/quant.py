@@ -1,0 +1,121 @@
+"""EQuARX-style int8 wire quantization for the leader-ring fold.
+
+Opt-in via ``FAABRIC_ALLREDUCE_QUANT=int8`` (or per world through
+``MpiWorld.allreduce_quant`` — like ``hier_enabled`` it must agree
+across every process of a world, or the ring peers disagree on the
+wire format and the collective hangs). When enabled, the hierarchical
+collectives' LEADER ring — the only leg that crosses real machines —
+sends each pipeline chunk as an int8 payload with one per-chunk fp32
+scale instead of raw fp32: 4× fewer bytes on the bandwidth-bound
+cross-host links (EQuARX, arXiv:2506.17615, gets near-2× allreduce
+from exactly this shape of block-wise in-collective quantization).
+
+Scope (deliberately narrow, ROADMAP item 4 groundwork):
+- ALLREDUCE only, as the knob names: the hierarchical reduce_scatter's
+  leader ring stays exact even with the knob on (lossy scatter slices
+  under an allreduce-named knob would surprise; quantize it under its
+  own knob if a later round wants it).
+- fold (reduce-scatter) leg of allreduce's leader ring only. The
+  trailing allgather circulation forwards the SAME folded buffers to
+  every leader verbatim, so all ranks still agree bitwise on the
+  (lossy) result — re-quantizing per allgather hop would compound
+  error for no agreement benefit.
+- ``MpiOp.SUM`` over float32 only: per-chunk scales distribute over a
+  linear fold; other ops / dtypes silently keep the fp32 wire.
+- intra-host phases never quantize — shm/in-process bytes are free.
+
+Error model: one quantization event bounds per-element error by
+scale/2 = max|chunk|/254; a chunk is re-quantized once per leader-ring
+fold hop, so worst case grows with (H−1) and the interim magnitudes.
+The bench block reports the measured ``max_abs_err`` against the exact
+fp32 path (bench_host_allreduce_hier quant mode).
+
+Wire format: one uint8 buffer per chunk — 4-byte little-endian fp32
+scale, then the int8 payload bytes; a NaN scale marks the raw-fp32
+passthrough form for non-finite chunks (divergence must propagate, not
+quantize to garbage). Self-contained per chunk, so the chunk-pipelined
+ring needs no side channel and every participant derives identical
+framing from the shared chunk bounds.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+# Module default (process-wide); per-world override via
+# MpiWorld.allreduce_quant. Values: "" (off) or "int8".
+ALLREDUCE_QUANT = os.environ.get("FAABRIC_ALLREDUCE_QUANT", "").strip().lower()
+
+_SCALE_FMT = "<f"
+_SCALE_BYTES = struct.calcsize(_SCALE_FMT)
+
+
+class Int8ChunkCodec:
+    """Per-chunk max-abs int8 quantizer. Stateless; shared freely."""
+
+    name = "int8"
+    wire_dtype = np.uint8
+
+    def encode(self, chunk: np.ndarray) -> np.ndarray:
+        """float32 chunk → private uint8 buffer [scale | int8 payload].
+        The output is freshly allocated — callers may hand it to the
+        transport zero-copy without freezing the source view.
+
+        Non-finite chunks (a diverging training step's NaN/Inf
+        gradients) must NOT quantize: a NaN element would decode to 0
+        (erasing the divergence signal the exact path propagates) and
+        one Inf makes the scale Inf, flooding the whole chunk with
+        0·Inf = NaN. They ship as raw fp32 behind a NaN-scale sentinel
+        — self-describing per chunk, so both wire formats coexist on
+        one ring with no side channel."""
+        chunk = np.ascontiguousarray(chunk, dtype=np.float32)
+        peak = float(np.max(np.abs(chunk))) if chunk.size else 0.0
+        if not np.isfinite(peak):
+            out = np.empty(_SCALE_BYTES + chunk.nbytes, dtype=np.uint8)
+            out[:_SCALE_BYTES] = np.frombuffer(
+                struct.pack(_SCALE_FMT, float("nan")), dtype=np.uint8)
+            out[_SCALE_BYTES:] = chunk.view(np.uint8)
+            return out
+        scale = peak / 127.0 if peak > 0.0 else 1.0
+        q = np.rint(chunk * (1.0 / scale))
+        np.clip(q, -127, 127, out=q)
+        out = np.empty(_SCALE_BYTES + chunk.size, dtype=np.uint8)
+        out[:_SCALE_BYTES] = np.frombuffer(
+            struct.pack(_SCALE_FMT, scale), dtype=np.uint8)
+        out[_SCALE_BYTES:] = q.astype(np.int8).view(np.uint8)
+        return out
+
+    def decode(self, buf: np.ndarray) -> np.ndarray:
+        """uint8 wire buffer → private writable float32 chunk (the
+        receiver folds into it in place). A NaN scale marks the raw
+        fp32 passthrough form (non-finite source chunk)."""
+        buf = buf.view(np.uint8).reshape(-1)
+        (scale,) = struct.unpack(_SCALE_FMT,
+                                 buf[:_SCALE_BYTES].tobytes())
+        if np.isnan(scale):
+            return buf[_SCALE_BYTES:].view(np.float32).copy()
+        out = buf[_SCALE_BYTES:].view(np.int8).astype(np.float32)
+        out *= scale
+        return out
+
+
+_INT8 = Int8ChunkCodec()
+
+
+def leader_ring_codec(mode, dtype, op) -> Int8ChunkCodec | None:
+    """The codec the leader ring should apply for this (mode, dtype,
+    op), or None for the raw fp32 wire. Deterministic in its inputs —
+    every leader derives the same verdict from the world-wide knob and
+    the collective's own payload, no exchange needed."""
+    from faabric_tpu.mpi.types import MpiOp
+
+    if mode != "int8":
+        return None
+    if np.dtype(dtype) != np.float32:
+        return None
+    if op != MpiOp.SUM:
+        return None
+    return _INT8

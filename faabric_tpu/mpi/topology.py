@@ -40,9 +40,11 @@ class Topology:
     """
 
     __slots__ = ("size", "rank_hosts", "hosts", "host_ranks", "leaders",
-                 "_local_idx", "ranks_per_host", "max_ranks_per_host")
+                 "_local_idx", "ranks_per_host", "max_ranks_per_host",
+                 "rank_devices")
 
-    def __init__(self, rank_hosts: Mapping[int, str]) -> None:
+    def __init__(self, rank_hosts: Mapping[int, str],
+                 rank_devices: Mapping[int, int] | None = None) -> None:
         size = len(rank_hosts)
         if sorted(rank_hosts) != list(range(size)):
             raise ValueError(
@@ -69,6 +71,17 @@ class Topology:
             h: len(ranks) for h, ranks in self.host_ranks.items()}
         self.max_ranks_per_host = max(self.ranks_per_host.values(),
                                       default=0)
+        # Device placement (ISSUE 10): the planner-assigned per-host
+        # chip index of each rank, -1 unknown. None when the placement
+        # carries no device information at all. Identity (__eq__/
+        # __hash__) stays rank→host only — devices are a placement
+        # DETAIL of the same topology, and the MpiWorld cache must not
+        # rebuild over a device re-claim that moved no rank.
+        if rank_devices is None:
+            self.rank_devices: tuple[int, ...] | None = None
+        else:
+            self.rank_devices = tuple(
+                int(rank_devices.get(r, -1)) for r in range(size))
 
     # -- constructors ---------------------------------------------------
     @classmethod
@@ -86,7 +99,10 @@ class Topology:
         idxs = list(decision.group_idxs)
         if sorted(idxs) != list(range(len(idxs))):
             idxs = list(range(len(decision.hosts)))
-        return cls(dict(zip(idxs, decision.hosts)))
+        devices = None
+        if any(d >= 0 for d in decision.device_ids):
+            devices = dict(zip(idxs, decision.device_ids))
+        return cls(dict(zip(idxs, decision.hosts)), rank_devices=devices)
 
     # -- structure queries ----------------------------------------------
     def host_of(self, rank: int) -> str:
@@ -135,6 +151,35 @@ class Topology:
         return all(ranks[-1] - ranks[0] + 1 == len(ranks)
                    for ranks in self.host_ranks.values())
 
+    def device_of(self, rank: int) -> int:
+        """Planner-assigned per-host chip index of ``rank`` (-1 when the
+        placement carries no device information)."""
+        if self.rank_devices is None:
+            return -1
+        return self.rank_devices[rank]
+
+    def devices_on_host(self, host: str) -> tuple[int, ...]:
+        """Chip indexes claimed by ``host``'s ranks, in rank order."""
+        if self.rank_devices is None:
+            return ()
+        return tuple(self.rank_devices[r] for r in self.ranks_on_host(host))
+
+    def mesh_contiguous(self) -> bool:
+        """True when the placement can light up a device mesh cleanly:
+        gang-contiguous rank runs per host AND every co-located rank on
+        its own chip (distinct, known device ids). This is the layout
+        the gang scheduler prefers for device-eligible worlds — a host
+        double-booking a chip (or a scattered rank run) forces the
+        device plane's eligibility check to fall back to the host
+        ladder."""
+        if self.rank_devices is None or not self.hosts_contiguous():
+            return False
+        for ranks in self.host_ranks.values():
+            devs = [self.rank_devices[r] for r in ranks]
+            if any(d < 0 for d in devs) or len(set(devs)) != len(devs):
+                return False
+        return True
+
     def cross_host_pairs(self) -> int:
         """Rank pairs that would hit the wire in a fully-connected
         traffic pattern (reference BinPackScheduler.cpp:97-148) — the
@@ -148,7 +193,7 @@ class Topology:
     # -- export ----------------------------------------------------------
     def to_dict(self) -> dict:
         """JSON-safe summary (planner telemetry / debugging)."""
-        return {
+        out = {
             "size": self.size,
             "n_hosts": self.n_hosts,
             "hosts": {h: list(r) for h, r in self.host_ranks.items()},
@@ -156,6 +201,10 @@ class Topology:
             "max_ranks_per_host": self.max_ranks_per_host,
             "hierarchical": self.hierarchical,
         }
+        if self.rank_devices is not None:
+            out["devices"] = list(self.rank_devices)
+            out["mesh_contiguous"] = self.mesh_contiguous()
+        return out
 
     def __repr__(self) -> str:
         per_host = ",".join(str(n) for n in self.ranks_per_host.values())

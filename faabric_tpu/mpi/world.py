@@ -43,6 +43,7 @@ from faabric_tpu.mpi.types import (
     unpack_mpi_payload,
 )
 from faabric_tpu.faults import fault_point, faults_enabled
+from faabric_tpu.mpi.quant import ALLREDUCE_QUANT, leader_ring_codec
 from faabric_tpu.telemetry import (
     NULL_SPAN,
     get_metrics,
@@ -95,6 +96,15 @@ RING_CHUNK_BYTES = int(os.environ.get("FAABRIC_RING_CHUNK_BYTES",
 _hier_env = os.environ.get("FAABRIC_HIER_COLLECTIVES", "1").lower()
 HIER_COLLECTIVES = ("force" if _hier_env == "force"
                     else _hier_env not in ("0", "false", "off"))
+
+# Device collective plane (ISSUE 10, faabric_tpu/device_plane/): the
+# rung ABOVE the whole host ladder. Routing is opt-in per world — a
+# world only has the rung after every rank ran the
+# activate_device_plane handshake — so this knob exists for A/B runs
+# and emergency disable: "0"/"off" makes activation refuse everywhere
+# (must agree across the world's processes like the knobs above).
+DEVICE_PLANE_ENABLED = os.environ.get(
+    "FAABRIC_DEVICE_PLANE", "1").lower() not in ("0", "false", "off")
 
 _metrics = get_metrics()
 _coll_total: dict = {}
@@ -220,12 +230,14 @@ class MpiWorld:
         "_requests": "_lock",
         "_next_request_id": "_lock",
         "_rank_hosts": "_lock",
+        "_rank_devices": "_lock",
         "_topology_cache": "_lock",
         "_same_machine_cache": "_lock",
         "_topology_gen": "_lock",
         "_msg_count_to_rank": "_lock",
         "_msg_type_count": "_lock",
         "_device_collectives": "_lock",
+        "_device_plane": "_lock",
     }
 
     def __init__(self, broker, world_id: int, size: int, group_id: int,
@@ -246,6 +258,7 @@ class MpiWorld:
         # and the immutable Topology derived from it (mpi/topology.py);
         # the cache object itself is lock-free to read once handed out
         self._rank_hosts: dict[int, str] = {}
+        self._rank_devices: dict[int, int] = {}
         self._topology_cache = None
         self._same_machine_cache: bool | None = None
         self._topology_gen = 0  # bumped by refresh_rank_hosts
@@ -253,6 +266,10 @@ class MpiWorld:
         # Hierarchical collective composition (module knob; tests/bench
         # override per world — identically on every process of the world)
         self.hier_enabled = HIER_COLLECTIVES
+        # Leader-ring wire quantization (mpi/quant.py): "" or "int8".
+        # World-level override of FAABRIC_ALLREDUCE_QUANT — like
+        # hier_enabled it must agree across every process of the world
+        self.allreduce_quant = ALLREDUCE_QUANT
 
         # Exec-graph accounting (MpiWorld.h:13-18)
         self._msg_count_to_rank: dict[int, int] = {}
@@ -260,6 +277,10 @@ class MpiWorld:
         self.record_exec_graph = False
 
         self._device_collectives = None
+        # The device collective plane (faabric_tpu/device_plane/):
+        # None until activate_device_plane's handshake resolves the
+        # world onto one mesh; cleared on migration remaps
+        self._device_plane = None
         self._send_workers: dict[int, _SendWorker] = {}
         self._in_send_pool = threading.local()
         self._split_seq = 0  # split-generation draws (see _split_draw)
@@ -285,11 +306,17 @@ class MpiWorld:
     # ------------------------------------------------------------------
     def refresh_rank_hosts(self) -> None:
         self.broker.wait_for_mappings(self.group_id)
+        # Stub brokers in unit tests may not expose device mappings
+        get_dev = getattr(self.broker, "get_device_for_idx", None)
         with self._lock:
             self._rank_hosts = {
                 idx: self.broker.get_host_for_receiver(self.group_id, idx)
                 for idx in range(self.size)
             }
+            self._rank_devices = (
+                {idx: get_dev(self.group_id, idx)
+                 for idx in range(self.size)}
+                if get_dev is not None else {})
             self._topology_cache = None
             self._same_machine_cache = None
             self._topology_gen += 1
@@ -313,7 +340,12 @@ class MpiWorld:
                 if self._topology_cache is not None:
                     return self._topology_cache
                 if len(self._rank_hosts) == self.size:
-                    self._topology_cache = Topology(dict(self._rank_hosts))
+                    devices = (dict(self._rank_devices)
+                               if any(d >= 0 for d in
+                                      self._rank_devices.values())
+                               else None)
+                    self._topology_cache = Topology(dict(self._rank_hosts),
+                                                    rank_devices=devices)
                     return self._topology_cache
             # Broker RPCs — must not run under _lock
             self.refresh_rank_hosts()
@@ -364,6 +396,84 @@ class MpiWorld:
         chip in one compiled ICI transfer (others zero) — the device twin
         of the host send/recv below."""
         return self.device_collectives().send_recv(x, src_rank, dst_rank)
+
+    # ------------------------------------------------------------------
+    # Device collective plane (ISSUE 10, faabric_tpu/device_plane/)
+    # ------------------------------------------------------------------
+    def activate_device_plane(self, rank: int, device=None) -> bool:
+        """Collective registration handshake: every rank calls this once
+        (after the world forms, or again after a migration remap) with
+        its device — default: the planner-assigned chip riding the PTP
+        mappings. One host-path allgather exchanges the registrations;
+        every rank then derives the SAME activate/fall-back verdict from
+        the full row set (device_plane/registry.py), so the dispatch
+        ladder can never desync. Returns True when the plane activated:
+        from then on eligible allreduce/allgather/reduce_scatter run as
+        compiled donated-buffer programs over the resolved mesh and put
+        ZERO collective-payload bytes on the host shm/tcp planes."""
+        import jax
+
+        from faabric_tpu.device_plane import (
+            DevicePlane,
+            MeshMismatch,
+            registration_row,
+            resolve_local_device,
+            resolve_mesh,
+        )
+
+        if not DEVICE_PLANE_ENABLED:
+            return False
+        if device is None:
+            device = resolve_local_device(self, rank)
+        # The handshake is the ONLY wire exchange; it must ride the
+        # host ladder even if a previous activation is still live
+        # (re-activation after migration), so clear the rung first
+        with self._lock:
+            gen = self._topology_gen
+            plane = self._device_plane
+            if plane is not None and plane.topology_gen != gen:
+                self._device_plane = None
+        rows = self.allgather(rank, registration_row(rank, device))
+        with self._lock:
+            plane = self._device_plane
+            if (plane is not None and plane.topology_gen == gen
+                    and plane.disabled_reason is None):
+                return True  # a sibling local rank already resolved it
+        try:
+            devices = resolve_mesh(
+                rows, self.size,
+                local_ranks=self.ranks_on_host(self.broker.host),
+                process_index=jax.process_index())
+        except MeshMismatch as e:
+            logger.info("Device plane for world %s not activated: %s",
+                        self.id, e)
+            return False
+        plane = DevicePlane(
+            self.id, devices,
+            local_ranks=self.ranks_on_host(self.broker.host),
+            topology_gen=gen)
+        with self._lock:
+            # First resolver publishes (a re-handshake REPLACES a
+            # disabled plane — the collective activation call is the
+            # recovery path after a backend error); a topology remap
+            # racing the handshake leaves the rung down and reports so
+            if self._topology_gen != gen:
+                return False  # remap raced the handshake; re-activate
+            cur = self._device_plane
+            if (cur is None or cur.topology_gen != gen
+                    or cur.disabled_reason is not None):
+                self._device_plane = plane
+        return True
+
+    def device_plane(self):
+        """The active DevicePlane rung, or None (host ladder only).
+        Stale planes (migration remap bumped the topology generation)
+        read as None — mesh mismatch falls back, never desyncs."""
+        with self._lock:
+            plane = self._device_plane
+            if plane is not None and plane.topology_gen != self._topology_gen:
+                return None
+            return plane
 
     # ------------------------------------------------------------------
     # Point-to-point
@@ -688,6 +798,32 @@ class MpiWorld:
             group = self.broker.get_group(self.group_id)
             group.barrier(rank)
 
+    def _try_device(self, kind: str, dplane, rank: int, arr: np.ndarray,
+                    op=None):
+        """The ``plane=device`` rung (ISSUE 10): run the collective as a
+        compiled program over the activated mesh. Returns the result, or
+        None after a clean fallback — a backend error disabled the plane
+        (symmetrically: the compiled collective is synchronous across
+        processes) and the caller re-runs on the host ladder. The
+        fallback re-run counts the collective a second time; that is the
+        truthful reading (two attempts were made) and only occurs on the
+        plane's terminal failure."""
+        from faabric_tpu.device_plane import DevicePlaneFallback
+
+        _count_collective(kind, int(arr.nbytes))
+        with span("mpi", kind, rank=rank, size=self.size,
+                  bytes=int(arr.nbytes), algo="device"):
+            try:
+                if kind == "allreduce":
+                    return dplane.allreduce(rank, arr, op)
+                if kind == "allgather":
+                    return dplane.allgather(rank, arr)
+                return dplane.reduce_scatter(rank, arr, op)
+            except DevicePlaneFallback as e:
+                logger.warning("Device %s (world %s) fell back to the "
+                               "host ladder: %s", kind, self.id, e)
+                return None
+
     # Above this, collectives stream in chunks so tree stages overlap:
     # while a leader reduces chunk k, chunk k+1 is on the wire and chunk
     # k-1 is being folded at the root — the host-path analog of a
@@ -967,6 +1103,14 @@ class MpiWorld:
         # Multi-host worlds keep the leader tree: it sends exactly one
         # message per remote host over the wire, which the ring does not.
         arr = np.asarray(data)
+        # Rung 0 — the device plane (shm → tcp → DEVICE): an activated
+        # world's eligible payloads run as one compiled program over the
+        # mesh; everything below is the host ladder it falls back to
+        dplane = self.device_plane()
+        if dplane is not None and dplane.eligible("allreduce", arr, op):
+            out = self._try_device("allreduce", dplane, rank, arr, op)
+            if out is not None:
+                return out
         use_hier = self._hier_eligible(arr, op)
         use_ring = (not use_hier and arr.size >= self.size
                     and self._ring_eligible(arr, op))
@@ -1149,9 +1293,14 @@ class MpiWorld:
             restore()
             return out
 
-        result = self._allreduce_ring(rank, host_acc, op,
-                                      ring=list(topo.leaders),
-                                      phase="leader")
+        # Opt-in int8 wire quantization on the leader ring's fold leg
+        # only (mpi/quant.py) — the cross-machine links are the
+        # bandwidth-bound segment EQuARX targets; intra-host phases
+        # stay exact fp32
+        result = self._allreduce_ring(
+            rank, host_acc, op, ring=list(topo.leaders), phase="leader",
+            codec=leader_ring_codec(self.allreduce_quant,
+                                    host_acc.dtype, op))
         with span("mpi.phase", "broadcast", rank=rank,
                   phase="redistribute"):
             if len(locals_) > 1:
@@ -1168,7 +1317,8 @@ class MpiWorld:
 
     def _allreduce_ring(self, rank: int, data: np.ndarray,
                         op: MpiOp, ring: list[int] | None = None,
-                        phase: str | None = None) -> np.ndarray:
+                        phase: str | None = None,
+                        codec=None) -> np.ndarray:
         """Zero-copy CHUNK-PIPELINED ring allreduce over the rank
         threads: np-1 reduce-scatter steps (each rank folds 1/np of the
         data per step) then np-1 allgather steps that pass chunk
@@ -1206,7 +1356,8 @@ class MpiWorld:
         lvl = {"phase": phase} if phase else {}
         with span("mpi.phase", "reduce_scatter", rank=rank, **lvl):
             held, restore = self._ring_reduce_scatter(rank, data, op,
-                                                      ring=ring)
+                                                      ring=ring,
+                                                      codec=codec)
         out = np.empty(flat.size,
                        dtype=held[0].dtype if held else flat.dtype)
         with span("mpi.phase", "allgather", rank=rank, **lvl):
@@ -1260,7 +1411,8 @@ class MpiWorld:
 
     def _ring_reduce_scatter(self, rank: int, data: np.ndarray,
                              op: MpiOp, ring: list[int] | None = None,
-                             seg: list[tuple[int, int]] | None = None):
+                             seg: list[tuple[int, int]] | None = None,
+                             codec=None):
         """The ring's fold phase: n-1 steps, each participant folding
         1/n of the data into the partials it receives, one pipeline
         chunk at a time (ownership rides the payload — folding based on
@@ -1277,7 +1429,14 @@ class MpiWorld:
         partition (len(ring) (lo, hi) spans covering the flat array) —
         any partition works as long as every participant passes the
         same one; the hierarchical reduce_scatter uses per-HOST spans
-        so each leader ends up holding exactly its own host's output."""
+        so each leader ends up holding exactly its own host's output.
+
+        ``codec`` (mpi/quant.py) switches the ring's wire format: every
+        chunk travels encoded (int8 + per-chunk scale), decoded into a
+        receiver-private buffer before the fold and re-encoded for the
+        next hop. Encoding copies, so the caller's buffer is never
+        shared with a peer and restore() is a no-op; every participant
+        must agree on the codec (world-level knob) or framing desyncs."""
         flat = data.reshape(-1)
         if ring is None:
             ring = list(range(self.size))
@@ -1291,10 +1450,17 @@ class MpiWorld:
         lo, hi = seg[pos]
         first = flat[lo:hi]
         was_writeable = first.flags.writeable
-        first.flags.writeable = False
+        if codec is None:
+            first.flags.writeable = False
         for clo, chi in self._ring_chunks(lo, hi, flat.itemsize):
-            self.send(rank, nxt, first[clo - lo:chi - lo],
-                      MpiMessageType.REDUCE, _copy=False)
+            if codec is not None:
+                # Encoded chunks are private copies — zero-copy safe
+                # without freezing the caller's views
+                self.send(rank, nxt, codec.encode(first[clo - lo:chi - lo]),
+                          MpiMessageType.REDUCE, _copy=False)
+            else:
+                self.send(rank, nxt, first[clo - lo:chi - lo],
+                          MpiMessageType.REDUCE, _copy=False)
         held: list[np.ndarray] = []
         for step in range(n - 1):
             slo, shi = seg[(pos - step - 1) % n]
@@ -1303,25 +1469,35 @@ class MpiWorld:
                 mine = flat[clo:chi]
                 with span("mpi.detail", "fold", rank=rank, step=step) \
                         if traced else NULL_SPAN:
-                    if owned and arr.flags.writeable \
+                    if codec is not None:
+                        # Decode allocates a private fp32 buffer; the
+                        # fold lands in it in place
+                        folded = apply_op_inplace(op, codec.decode(arr),
+                                                  mine)
+                    elif owned and arr.flags.writeable \
                             and arr.dtype == mine.dtype:
                         folded = apply_op_inplace(op, arr, mine)
                     else:  # step-0 shared view (or dtype-promoting op):
                         # non-inplace apply allocates + folds in ONE pass
                         folded = np.asarray(apply_op(op, arr, mine))
                 if step < n - 2:
-                    # Ownership transfer: the receiver folds into this
-                    # buffer in place; we drop our reference here —
-                    # and the wire leg of chunk k overlaps our fold of
-                    # chunk k+1 (the pipeline the chunking exists for)
-                    self.send(rank, nxt, folded, MpiMessageType.REDUCE,
-                              _transfer=True)
+                    if codec is not None:
+                        self.send(rank, nxt, codec.encode(folded),
+                                  MpiMessageType.REDUCE, _copy=False)
+                    else:
+                        # Ownership transfer: the receiver folds into
+                        # this buffer in place; we drop our reference
+                        # here — and the wire leg of chunk k overlaps
+                        # our fold of chunk k+1 (the pipeline the
+                        # chunking exists for)
+                        self.send(rank, nxt, folded, MpiMessageType.REDUCE,
+                                  _transfer=True)
                     del folded
                 else:
                     held.append(folded)  # segment (rank+1) % n
 
         def restore():
-            if was_writeable:
+            if codec is None and was_writeable:
                 first.flags.writeable = True
 
         return held, restore
@@ -1485,11 +1661,18 @@ class MpiWorld:
             raise ValueError(
                 f"reduce_scatter needs size divisible by {self.size}")
         k = data.size // self.size
-        # Hierarchical needs the gang-contiguous layout: the leader
-        # ring's per-host wire segments must map onto per-rank output
-        # segments (scattered placements fall back to the flat paths)
-        use_hier = (self._hier_eligible(data, op)
-                    and self.topology().hosts_contiguous())
+        dplane = self.device_plane()
+        if dplane is not None and dplane.eligible("reduce_scatter",
+                                                  data, op):
+            out = self._try_device("reduce_scatter", dplane, rank, data,
+                                   op)
+            if out is not None:
+                return out
+        # Scattered (non-gang-contiguous) placements compose too: the
+        # leader ring folds over a PERMUTED span partition derived from
+        # the Topology (see _reduce_scatter_hier), so the
+        # hosts_contiguous() gate PR 9 shipped with is gone
+        use_hier = self._hier_eligible(data, op)
         use_ring = not use_hier and self._ring_eligible(data, op)
         _count_collective("reduce_scatter", int(data.nbytes))
         with span("mpi", "reduce_scatter", rank=rank, size=self.size,
@@ -1549,8 +1732,10 @@ class MpiWorld:
         fold phase over per-HOST segment spans — permuted so each
         leader finishes holding exactly its own host's output span
         ((H−1)/H·payload per wire link, no trailing allgather) — and
-        scatters the per-rank slices back down in process. Requires the
-        gang-contiguous layout (checked by the caller)."""
+        scatters the per-rank slices back down in process. Covers BOTH
+        gang-contiguous and scattered placements: the spans live in a
+        permuted coordinate space derived from the Topology (identity
+        when contiguous; see the order/spans construction below)."""
         topo = self.topology()
         k = data.size // self.size
         locals_ = list(topo.ranks_on_host(topo.host_of(rank)))
@@ -1566,7 +1751,22 @@ class MpiWorld:
             restore()
             return out
 
-        if len(locals_) == 1:
+        # The leader ring folds over per-HOST spans of a PERMUTED
+        # coordinate space: rank order grouped by host (topology host
+        # order, ranks ascending within each host). For gang-contiguous
+        # placements the permutation is the identity; for scattered
+        # placements (the PR 9 headroom this closes) the leader gathers
+        # its host-reduced vector's k-blocks into that order first, so
+        # each host's output is one contiguous span again and the
+        # fold-only ring works unchanged. Every leader derives the same
+        # order from the shared Topology — no exchange.
+        order = [r for h in topo.hosts for r in topo.ranks_on_host(h)]
+        if order != list(range(self.size)):
+            perm = np.empty(host_acc.size, dtype=host_acc.dtype)
+            for j, r in enumerate(order):
+                perm[j * k:(j + 1) * k] = host_acc[r * k:(r + 1) * k]
+            host_acc = perm  # private by construction
+        elif len(locals_) == 1:
             # The fold-only leader ring has no trailing circulation to
             # extend the causal chain, so the caller's buffer must not
             # feed it directly: a peer could still be reading its
@@ -1574,14 +1774,21 @@ class MpiWorld:
             # restores only after its rotation for the same reason)
             host_acc = host_acc.copy()
 
-        # spans[p] = world-output span of ring position p's host; the
+        # spans[p] = permuted-space span of ring position p's host; the
         # fold phase leaves position p holding seg[(p+1) % n], so pass
         # the partition rotated one position back
         spans = []
+        off = 0
         for lead in leaders:
-            ranks = topo.ranks_on_host(topo.host_of(lead))
-            spans.append((ranks[0] * k, (ranks[-1] + 1) * k))
+            m_host = len(topo.ranks_on_host(topo.host_of(lead)))
+            spans.append((off, off + m_host * k))
+            off += m_host * k
         seg = [spans[(q - 1) % n_hosts] for q in range(n_hosts)]
+        # No codec here: FAABRIC_ALLREDUCE_QUANT scopes to ALLREDUCE —
+        # reduce_scatter hands each rank a slice nothing re-replicates,
+        # and silently lossy slices under an allreduce-named knob would
+        # surprise (quantize it deliberately under its own knob if
+        # ROADMAP 4 wants it)
         with span("mpi.phase", "reduce_scatter", rank=rank,
                   phase="leader"):
             held, _noop_restore = self._ring_reduce_scatter(
@@ -1597,10 +1804,12 @@ class MpiWorld:
                 hostseg[write:write + part.size] = part
                 write += part.size
             del held
-            for r in locals_[1:]:
-                self.send(rank, r, hostseg[r * k - slo:(r + 1) * k - slo],
+            # hostseg holds this host's per-rank outputs in LOCAL rank
+            # order (ascending), whatever the global layout
+            for i, r in enumerate(locals_[1:], start=1):
+                self.send(rank, r, hostseg[i * k:(i + 1) * k],
                           MpiMessageType.SCATTER)
-            out = hostseg[rank * k - slo:(rank + 1) * k - slo].copy()
+            out = hostseg[:k].copy()  # leader is local position 0
         restore()
         return out
 
@@ -1611,6 +1820,11 @@ class MpiWorld:
         # funnelling through rank 0 twice. Contributions above one bulk
         # frame stream as pipeline chunks (no size cap).
         data = np.asarray(data)
+        dplane = self.device_plane()
+        if dplane is not None and dplane.eligible("allgather", data):
+            out = self._try_device("allgather", dplane, rank, data)
+            if out is not None:
+                return out
         # Hierarchy pays off once the OUTPUT (size × contribution) is
         # pipeline-sized; the per-rank contribution itself can be small
         use_hier = (self.hier_enabled and data.size > 0
@@ -1963,10 +2177,14 @@ class MpiWorld:
             if new_group_id is not None:
                 self.group_id = new_group_id
             self._rank_hosts.clear()
+            self._rank_devices.clear()
             self._topology_cache = None
             self._same_machine_cache = None
             self._topology_gen += 1
             self._device_collectives = None
+            # Post-migration the rank→device map is stale: the rung
+            # drops until every rank re-runs the activation handshake
+            self._device_plane = None
         watch = getattr(self.broker, "watch_group", None)
         if watch is not None:
             watch(self.group_id)  # liveness checking follows the new gid

@@ -2,10 +2,13 @@
 
 Every REMOTE send is attributed to a ``(src rank, dst rank, plane)``
 cell — plane ∈ {``ptp`` (shared RPC plane), ``bulk-tcp`` (dedicated
-tuned-socket data plane), ``shm`` (same-machine ring)} — counting
-messages, payload bytes and a small send-latency histogram. Same-host
-in-process queue delivery is deliberately NOT counted: it is the 6 GiB/s
-hot path and carries no wire to attribute.
+tuned-socket data plane), ``shm`` (same-machine ring), ``device`` (the
+compiled device collective plane: each rank's contribution attributed
+to its mesh ring-neighbour — XLA owns the actual schedule, the row
+records that the payload entered the device plane and NOT the host
+planes)} — counting messages, payload bytes and a small send-latency
+histogram. Same-host in-process queue delivery is deliberately NOT
+counted: it is the 6 GiB/s hot path and carries no wire to attribute.
 
 This is the data HiCCL-style collective tuning needs before any
 optimization: the 0.62-vs-6.01 GiB/s allreduce gap stops being a single
@@ -30,7 +33,7 @@ import threading
 
 from faabric_tpu.telemetry.metrics import metrics_enabled
 
-PLANES = ("ptp", "bulk-tcp", "shm")
+PLANES = ("ptp", "bulk-tcp", "shm", "device")
 
 # Send-latency buckets (seconds): sub-ms ring pushes to multi-second
 # wedged sockets. Coarser than DEFAULT_BUCKETS — per-link histograms

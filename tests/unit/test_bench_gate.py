@@ -87,23 +87,48 @@ def test_gate_lifecycle_keys_promoted_to_gated(tmp_path, capsys):
     assert "thaw_to_first_result_s" not in out.split("REGRESSION", 1)[1]
 
 
-def test_gate_hier_keys_reported_only_first_round(tmp_path, capsys):
-    """ISSUE 9 first-round keys: the hierarchical allreduce rate and
-    the wire-byte ratio (lower-better via the _ratio suffix) are
-    tracked but not gated until a round of spread exists."""
+def test_gate_hier_keys_promoted_to_gated(tmp_path, capsys):
+    """ISSUE 10 satellite: the ISSUE 9 hierarchical keys graduated from
+    REPORTED_ONLY after their first recorded round (the promotion PR 9
+    deferred) — a >20% move in the bad direction now FAILS the gate."""
+    for key in ("host_allreduce_hier_gibs", "cross_host_bytes_ratio"):
+        assert key not in bench_gate.REPORTED_ONLY
     _write_round(tmp_path, "BENCH_r01.json", 0.05,
                  {"host_allreduce_hier_gibs": 3.0,
                   "cross_host_bytes_ratio": 0.27})
     _write_round(tmp_path, "BENCH_r02.json", 0.05,
                  {"host_allreduce_hier_gibs": 1.0,       # -67%
                   "cross_host_bytes_ratio": 0.9})        # +233% (worse)
-    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 1
     out = capsys.readouterr().out
-    assert "host_allreduce_hier_gibs" in out and "reported-only" in out
+    assert "FAILED (2 regression(s))" in out
+    assert "host_allreduce_hier_gibs" in out
     assert "cross_host_bytes_ratio" in out
-    assert "REGRESSION" not in out
     # direction sanity: _ratio classifies lower-is-better
     assert bench_gate.direction("cross_host_bytes_ratio") == -1
+
+
+def test_gate_device_plane_key_reported_only_first_round(tmp_path,
+                                                         capsys):
+    """ISSUE 10 first-round key: the device-plane allreduce rate is
+    tracked but not gated until a round of spread exists (promote next
+    round, as the hier keys above were)."""
+    assert "host_allreduce_device_gibs" in bench_gate.REPORTED_ONLY
+    # the quant error key is visible (the _err suffix classifies
+    # lower-better) but data-dependent, so reported-only too
+    assert bench_gate.direction("allreduce_quant_max_abs_err") == -1
+    assert "allreduce_quant_max_abs_err" in bench_gate.REPORTED_ONLY
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_allreduce_device_gibs": 2.0,
+                  "allreduce_quant_max_abs_err": 45.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_allreduce_device_gibs": 0.5,     # -75%
+                  "allreduce_quant_max_abs_err": 190.0})  # +322% (worse)
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "host_allreduce_device_gibs" in out and "reported-only" in out
+    assert "allreduce_quant_max_abs_err" in out
+    assert "REGRESSION" not in out
 
 
 def test_gate_tolerates_new_and_missing_keys(tmp_path):

@@ -475,6 +475,209 @@ def test_hier_fallbacks_stay_flat(mpi_cluster):
         lambda w, r: w.allreduce(r, data.copy(), MpiOp.SUM)) == {"hier"}
 
 
+@pytest.fixture
+def scattered_cluster():
+    """Interleaved (non-gang-contiguous) placement: rank r on host
+    r % 2 — the PR 9 headroom shape where hier reduce_scatter used to
+    fall back flat."""
+    from tests.conftest import next_port_base
+
+    from faabric_tpu.transport.ptp_remote import PointToPointServer
+
+    base = next_port_base()
+    register_host_alias("scatA", "127.0.0.1", base)
+    register_host_alias("scatB", "127.0.0.1", base + 1000)
+    brokers = {h: PointToPointBroker(h) for h in ("scatA", "scatB")}
+    servers = [PointToPointServer(b) for b in brokers.values()]
+    for s in servers:
+        s.start()
+    decision = SchedulingDecision(app_id=GROUP_ID + 7, group_id=GROUP_ID + 7)
+    for rank in range(6):
+        decision.add_message("scatA" if rank % 2 == 0 else "scatB",
+                             2600 + rank, rank, rank)
+    for b in brokers.values():
+        b.set_up_local_mappings_from_decision(decision)
+    worlds = {h: MpiWorld(b, WORLD_ID + 7, 6, GROUP_ID + 7)
+              for h, b in brokers.items()}
+
+    def world_for_rank(rank):
+        return worlds["scatA"] if rank % 2 == 0 else worlds["scatB"]
+
+    yield world_for_rank
+
+    for s in servers:
+        s.stop()
+    for b in brokers.values():
+        b.clear()
+
+
+def test_hier_reduce_scatter_scattered_placement(scattered_cluster):
+    """ISSUE 10 satellite: scattered placements now take the composed
+    path too — the leader ring folds over PERMUTED per-host spans, so
+    each leader lands holding its own host's (non-contiguous) output.
+    Bitwise vs the flat ring and numpy, and the span must say hier."""
+    from faabric_tpu.telemetry import reset_tracing, set_tracing, trace_events
+
+    topo = scattered_cluster(0).topology()
+    assert topo.hierarchical and not topo.hosts_contiguous()
+
+    rng = np.random.default_rng(21)
+    datas = {r: rng.integers(-9999, 9999, 120_000).astype(np.int64)
+             for r in range(6)}
+    total = sum(datas.values())
+
+    def fn(world, rank):
+        return world.reduce_scatter(rank, datas[rank].copy(), MpiOp.SUM)
+
+    _force_hier(scattered_cluster, enabled=False)
+    flat = run_ranks(scattered_cluster, fn)
+    _force_hier(scattered_cluster, enabled=True)
+    set_tracing(True)
+    reset_tracing()
+    try:
+        hier = run_ranks(scattered_cluster, fn)
+        algos = {e["args"]["algo"] for e in trace_events()
+                 if e.get("ph") == "X" and e["cat"] == "mpi"
+                 and e["name"] == "reduce_scatter"}
+    finally:
+        reset_tracing()
+        set_tracing(False)
+    assert algos == {"hier"}
+    for r in range(6):
+        np.testing.assert_array_equal(hier[r], flat[r])
+        np.testing.assert_array_equal(hier[r],
+                                      total[r * 20_000:(r + 1) * 20_000])
+        assert hier[r].flags.writeable
+
+
+# ---------------------------------------------------------------------------
+# FAABRIC_ALLREDUCE_QUANT (ISSUE 10 satellite, ROADMAP 4 groundwork)
+# ---------------------------------------------------------------------------
+
+def _set_quant(world_for_rank, mode):
+    for world in {id(world_for_rank(r)): world_for_rank(r)
+                  for r in range(6)}.values():
+        world.allreduce_quant = mode
+
+
+def test_quant_codec_roundtrip():
+    from faabric_tpu.mpi.quant import Int8ChunkCodec, leader_ring_codec
+
+    codec = Int8ChunkCodec()
+    rng = np.random.default_rng(5)
+    x = rng.uniform(-37.0, 37.0, 10_000).astype(np.float32)
+    buf = codec.encode(x)
+    assert buf.dtype == np.uint8 and buf.size == x.size + 4
+    back = codec.decode(buf)
+    assert back.dtype == np.float32 and back.flags.writeable
+    scale = float(np.max(np.abs(x))) / 127.0
+    assert float(np.max(np.abs(back - x))) <= scale / 2 + 1e-6
+    # constants and zeros are exact
+    np.testing.assert_array_equal(
+        codec.decode(codec.encode(np.full(64, 3.5, np.float32))),
+        np.full(64, 3.5, np.float32))
+    np.testing.assert_array_equal(
+        codec.decode(codec.encode(np.zeros(64, np.float32))),
+        np.zeros(64, np.float32))
+    # non-finite chunks ride the raw passthrough: NaN must survive
+    # (quantizing would erase it to 0) and one Inf must not flood the
+    # chunk with NaN
+    bad = np.array([1.0, np.nan, 2.0, 3.0], np.float32)
+    back_bad = codec.decode(codec.encode(bad))
+    np.testing.assert_array_equal(back_bad, bad)  # NaN == NaN via equal_nan
+    inf = np.array([1.0, np.inf, 2.0, 3.0], np.float32)
+    np.testing.assert_array_equal(codec.decode(codec.encode(inf)), inf)
+    assert codec.encode(bad).size == bad.nbytes + 4  # raw form, bigger
+    # codec selection: fp32 SUM only, and only when the knob is on
+    assert leader_ring_codec("int8", np.float32, MpiOp.SUM) is not None
+    assert leader_ring_codec("", np.float32, MpiOp.SUM) is None
+    assert leader_ring_codec("int8", np.int64, MpiOp.SUM) is None
+    assert leader_ring_codec("int8", np.float32, MpiOp.MAX) is None
+    from faabric_tpu.mpi import UserOp as _UserOp
+    assert leader_ring_codec("int8", np.float32,
+                             _UserOp(lambda a, b: a + b,
+                                     commute=True)) is None
+
+
+def test_hier_allreduce_quant_int8(mpi_cluster):
+    """Opt-in int8 leader-ring quantization: all ranks agree bitwise on
+    the (lossy) result, the error is bounded by the per-chunk scale
+    model, and exact dtypes / disabled knob keep the exact path."""
+    rng = np.random.default_rng(31)
+    datas = {r: rng.uniform(-1000, 1000, 120_000).astype(np.float32)
+             for r in range(6)}
+    exact = sum(datas.values())
+
+    def fn(world, rank):
+        return world.allreduce(rank, datas[rank].copy(), MpiOp.SUM)
+
+    _force_hier(mpi_cluster, enabled=True)
+    _set_quant(mpi_cluster, "int8")
+    try:
+        quant = run_ranks(mpi_cluster, fn)
+    finally:
+        _set_quant(mpi_cluster, "")
+    # every rank holds the IDENTICAL lossy result (the fold leg is
+    # quantized once; the allgather leg circulates the same buffers)
+    for r in range(1, 6):
+        np.testing.assert_array_equal(quant[r], quant[0])
+    err = float(np.max(np.abs(quant[0] - exact)))
+    assert 0 < err < 100, err  # lossy, but scale-bounded
+    # divergence propagates: a NaN in one rank's contribution reaches
+    # every rank's result (the codec's raw passthrough, not 0-erasure)
+    poisoned = {r: d.copy() for r, d in datas.items()}
+    poisoned[2][12345] = np.nan
+    _set_quant(mpi_cluster, "int8")
+    try:
+        nq = run_ranks(mpi_cluster, lambda w, r: w.allreduce(
+            r, poisoned[r].copy(), MpiOp.SUM))
+    finally:
+        _set_quant(mpi_cluster, "")
+    for r in range(6):
+        assert np.isnan(nq[r][12345]), r
+    # int64 payloads under the same knob stay exact (codec refuses)
+    idatas = {r: rng.integers(-9999, 9999, 120_000).astype(np.int64)
+              for r in range(6)}
+    _set_quant(mpi_cluster, "int8")
+    try:
+        iout = run_ranks(mpi_cluster, lambda w, r: w.allreduce(
+            r, idatas[r].copy(), MpiOp.SUM))
+    finally:
+        _set_quant(mpi_cluster, "")
+    iexact = sum(idatas.values())
+    for r in range(6):
+        np.testing.assert_array_equal(iout[r], iexact)
+    # knob off: fp32 hier matches the flat ring again up to fold-order
+    # rounding (bitwise identity is pinned on exact dtypes above)
+    hier = run_ranks(mpi_cluster, fn)
+    _force_hier(mpi_cluster, enabled=False)
+    flat = run_ranks(mpi_cluster, fn)
+    for r in range(6):
+        np.testing.assert_allclose(hier[r], flat[r], rtol=1e-4,
+                                   atol=1e-2)
+
+
+def test_quant_knob_never_touches_reduce_scatter(mpi_cluster):
+    """The knob is named ALLREDUCE: hierarchical reduce_scatter must
+    stay bitwise-exact with the knob on (same path as knob off)."""
+    rng = np.random.default_rng(33)
+    datas = {r: rng.uniform(-1000, 1000, 120_000).astype(np.float32)
+             for r in range(6)}
+
+    def fn(world, rank):
+        return world.reduce_scatter(rank, datas[rank].copy(), MpiOp.SUM)
+
+    _force_hier(mpi_cluster, enabled=True)
+    exact = run_ranks(mpi_cluster, fn)
+    _set_quant(mpi_cluster, "int8")
+    try:
+        quant = run_ranks(mpi_cluster, fn)
+    finally:
+        _set_quant(mpi_cluster, "")
+    for r in range(6):
+        np.testing.assert_array_equal(quant[r], exact[r])
+
+
 def test_reduce_to_nonzero_root(mpi_cluster):
     expected = sum(per_rank_data(r) for r in range(6))
 

@@ -199,6 +199,117 @@ def test_bin_pack_gang_spills_contiguously():
     assert t.hosts_contiguous() and t.hierarchical
 
 
+def test_topology_device_placement_and_mesh_contiguity():
+    """ISSUE 10: the Topology carries the planner's device placement
+    and the mesh_contiguous predicate the gang scheduler optimizes for
+    (and the device plane's registration resolves against)."""
+    t = Topology({0: "a", 1: "a", 2: "b", 3: "b"},
+                 rank_devices={0: 0, 1: 1, 2: 0, 3: 1})
+    assert t.rank_devices == (0, 1, 0, 1)
+    assert t.device_of(2) == 0 and t.devices_on_host("a") == (0, 1)
+    assert t.mesh_contiguous()
+    d = t.to_dict()
+    assert d["devices"] == [0, 1, 0, 1] and d["mesh_contiguous"]
+
+    # chip aliasing on one host breaks mesh contiguity
+    t2 = Topology({0: "a", 1: "a", 2: "b", 3: "b"},
+                  rank_devices={0: 0, 1: 0, 2: 0, 3: 1})
+    assert not t2.mesh_contiguous()
+    # unknown devices / scattered rank runs break it too
+    t3 = Topology({0: "a", 1: "a", 2: "b", 3: "b"})
+    assert t3.rank_devices is None and not t3.mesh_contiguous()
+    assert t3.device_of(0) == -1 and t3.devices_on_host("a") == ()
+    assert "devices" not in t3.to_dict()
+    t4 = Topology({0: "a", 1: "b", 2: "a", 3: "b"},
+                  rank_devices={0: 0, 1: 0, 2: 1, 3: 1})
+    assert not t4.mesh_contiguous()  # scattered rank runs
+    # identity stays rank→host only: a device re-claim that moved no
+    # rank must not invalidate topology caches
+    assert t == t2 and hash(t) == hash(t2)
+
+
+def test_topology_from_decision_carries_devices():
+    d = SchedulingDecision(app_id=1, group_id=1)
+    for r in range(4):
+        d.add_message("h1" if r < 2 else "h2", 100 + r, r, r,
+                      device_id=r % 2)
+    t = d.topology()
+    assert t.rank_devices == (0, 1, 0, 1)
+    assert t.mesh_contiguous()
+
+
+def test_sort_hosts_gang_prefers_device_covering_hosts():
+    """ISSUE 10: for a device-eligible REQUEST (the caller passes
+    prefer_devices from request_wants_devices — never derived from the
+    host pool), among hosts swallowing the same share of the world the
+    one whose chips cover the ranks it takes ranks first — the
+    placement resolves mesh-contiguous instead of aliasing chips."""
+    hm = {
+        # "zhost" wins the classic ip-desc tie-break; only the device
+        # preference can flip the order toward "chips"
+        "zhost": HostState(ip="zhost", slots=8, used_slots=0),
+        "chips": HostState(ip="chips", slots=8, used_slots=0,
+                           n_devices=8),
+    }
+    order = [h.ip for h in sort_hosts_gang(list(hm.values()), 8,
+                                           prefer_devices=True)]
+    assert order[0] == "chips"
+    # a request WITHOUT device demand keeps the classic tie-break even
+    # when chip hosts exist in the pool — it must not squat them
+    order_nd = [h.ip for h in sort_hosts_gang(list(hm.values()), 8,
+                                              prefer_devices=False)]
+    assert order_nd[0] == "zhost"
+    # and the DEFAULT is off, never derived from the host pool
+    assert [h.ip for h in sort_hosts_gang(list(hm.values()), 8)] \
+        == order_nd
+    # without any devices in the pool the classic tie-break (ip desc)
+    # is unchanged
+    hm0 = hosts(("a", 8, 0), ("b", 8, 0))
+    order0 = [h.ip for h in sort_hosts_gang(list(hm0.values()), 8,
+                                            prefer_devices=True)]
+    assert order0 == ["b", "a"]
+    # a host with too FEW chips for the ranks it would take loses to a
+    # covering host even when the covering fit is looser
+    hm2 = {
+        "few": HostState(ip="few", slots=8, used_slots=0, n_devices=2),
+        "full": HostState(ip="full", slots=12, used_slots=0,
+                          n_devices=12),
+    }
+    order2 = [h.ip for h in sort_hosts_gang(list(hm2.values()), 8,
+                                            prefer_devices=True)]
+    assert order2[0] == "full"
+    # preference never overrides capacity: the most-swallowing host
+    # still wins even chipless
+    hm3 = {
+        "big": HostState(ip="big", slots=10, used_slots=0),
+        "small": HostState(ip="small", slots=2, used_slots=0,
+                           n_devices=8),
+    }
+    order3 = [h.ip for h in sort_hosts_gang(list(hm3.values()), 12,
+                                            prefer_devices=True)]
+    assert order3[0] == "big"
+
+
+def test_bin_pack_gang_passes_request_device_demand():
+    """The scheduler derives prefer_devices from the request (every MPI
+    gang is device-eligible today), so an MPI world lands on the
+    chip-covering host when takes tie."""
+    from faabric_tpu.batch_scheduler.bin_pack import request_wants_devices
+
+    assert request_wants_devices(_mpi_req(4))
+    assert not request_wants_devices(batch_exec_factory("demo", "e", 4))
+    sched = BinPackScheduler()
+    # the chip host loses the classic ip-desc tie-break — only the
+    # request-derived device preference can place the gang on it
+    hm = {
+        "10.0.0.1": HostState(ip="10.0.0.1", slots=8, used_slots=0,
+                              n_devices=8),
+        "10.0.0.9": HostState(ip="10.0.0.9", slots=8, used_slots=0),
+    }
+    d = sched.make_scheduling_decision(hm, {}, _mpi_req(8))
+    assert d.hosts == ["10.0.0.1"] * 8
+
+
 def test_bin_pack_gang_knob_off_restores_larger_first():
     get_system_config().gang_schedule_mpi = False
     sched = BinPackScheduler()

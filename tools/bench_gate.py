@@ -27,7 +27,7 @@ import sys
 # NOTE: _per_s (throughput rates, e.g. invocations_per_s) must be
 # classified BEFORE the trailing-_s latency rule catches them
 HIGHER_BETTER = re.compile(r"(_gibs|_per_s|mfu|_speedup)")
-LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio)$")
+LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio|_err)$")
 
 # Headline figures (ISSUE 5 data plane; ISSUE 8 invocation plane): once
 # a round has recorded one of these, a later round missing it is a
@@ -38,19 +38,26 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
                  "invocations_per_s")
 
 # Invocation-plane reference figures (ISSUE 8) and the first-round
-# ISSUE 9 hierarchical keys: tracked and printed every round but NOT
+# ISSUE 10 device-plane key: tracked and printed every round but NOT
 # hard-gated. The ingress headline (invocations_per_s, best-of-2 runs)
 # IS gated via REQUIRED_KEYS; its serial baseline and p50 exist to make
 # the same-round speedup ratio checkable, not to gate on. The
-# hierarchical allreduce rate gates once a round of spread exists
-# (promote it like the ISSUE 6 lifecycle keys below were).
+# device-plane allreduce rate gates once a round of spread exists
+# (promote it like the keys below were).
 #
 # PROMOTED (ISSUE 9 satellite): migration_pause_ms,
 # thaw_to_first_result_s and partition_heal_s moved out of this list —
 # rounds r05..current showed their spread comfortably inside the 20%
 # threshold, so they now gate like any other latency key.
+# PROMOTED (ISSUE 10 satellite): host_allreduce_hier_gibs and
+# cross_host_bytes_ratio graduated after their first recorded round —
+# the deferred PR 9 promotion — and now gate like any other key.
+# allreduce_quant_max_abs_err: tracked so a codec regression at least
+# prints a tagged note — but data-dependent (payload-magnitude-scaled),
+# so never hard-gated.
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
-                 "host_allreduce_hier_gibs", "cross_host_bytes_ratio")
+                 "host_allreduce_device_gibs",
+                 "allreduce_quant_max_abs_err")
 
 # Round-5 container drift (see ROADMAP "Recent"): ptp dispatch p50 (the
 # headline "value") and delta_apply_reuse_ms read worse in ANY tree on
