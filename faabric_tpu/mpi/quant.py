@@ -59,21 +59,27 @@ class Int8ChunkCodec:
     name = "int8"
     wire_dtype = np.uint8
 
-    def encode(self, chunk: np.ndarray) -> np.ndarray:
+    def encode(self, chunk: np.ndarray,
+               quantize: bool = True) -> np.ndarray:
         """float32 chunk → private uint8 buffer [scale | int8 payload].
         The output is freshly allocated — callers may hand it to the
         transport zero-copy without freezing the source view.
+
+        ``quantize=False`` ships the chunk in the raw-fp32 passthrough
+        form (NaN-scale sentinel): the per-LINK escape the wire-codec
+        governor uses for hops whose bytes are nearly free (same-
+        machine leaders) — lossy compression there is pure error for
+        no bandwidth. Self-describing per chunk, so a ring may mix
+        quantized and raw hops with no side channel.
 
         Non-finite chunks (a diverging training step's NaN/Inf
         gradients) must NOT quantize: a NaN element would decode to 0
         (erasing the divergence signal the exact path propagates) and
         one Inf makes the scale Inf, flooding the whole chunk with
-        0·Inf = NaN. They ship as raw fp32 behind a NaN-scale sentinel
-        — self-describing per chunk, so both wire formats coexist on
-        one ring with no side channel."""
+        0·Inf = NaN. They use the same raw passthrough form."""
         chunk = np.ascontiguousarray(chunk, dtype=np.float32)
         peak = float(np.max(np.abs(chunk))) if chunk.size else 0.0
-        if not np.isfinite(peak):
+        if not quantize or not np.isfinite(peak):
             out = np.empty(_SCALE_BYTES + chunk.nbytes, dtype=np.uint8)
             out[:_SCALE_BYTES] = np.frombuffer(
                 struct.pack(_SCALE_FMT, float("nan")), dtype=np.uint8)
@@ -103,6 +109,18 @@ class Int8ChunkCodec:
 
 
 _INT8 = Int8ChunkCodec()
+
+
+def resolve_quant_mode(world_knob: str) -> str:
+    """The effective quant mode for a world: the explicit knob
+    (``FAABRIC_ALLREDUCE_QUANT`` / ``MpiWorld.allreduce_quant``) wins;
+    otherwise the wire-codec governor's ``quant`` policy token enables
+    it (ISSUE 11: the quant knob becomes one governor policy instead of
+    a global env switch). Deterministic across a world's processes —
+    both inputs are env/world-level configuration."""
+    from faabric_tpu.transport.codec import get_wire_governor
+
+    return get_wire_governor().quant_mode(world_knob)
 
 
 def leader_ring_codec(mode, dtype, op) -> Int8ChunkCodec | None:

@@ -43,7 +43,11 @@ from faabric_tpu.mpi.types import (
     unpack_mpi_payload,
 )
 from faabric_tpu.faults import fault_point, faults_enabled
-from faabric_tpu.mpi.quant import ALLREDUCE_QUANT, leader_ring_codec
+from faabric_tpu.mpi.quant import (
+    ALLREDUCE_QUANT,
+    leader_ring_codec,
+    resolve_quant_mode,
+)
 from faabric_tpu.telemetry import (
     NULL_SPAN,
     get_metrics,
@@ -587,9 +591,30 @@ class MpiWorld:
                     arr = arr.copy()
         else:
             _, arr, _req = self._unpack_wire(raw)
+            if not arr.flags.writeable:
+                # Zero-copy coded-stream delivery (transport/codec.py)
+                # shares the receiver's immutable base cache; the
+                # public recv contract is a caller-owned writable array
+                arr = arr.copy()
         status = MpiStatus(source=send_rank, count=arr.size,
                            dtype=int(mpi_dtype_for(arr.dtype)))
         return arr, status
+
+    def recv_shared(self, send_rank: int, recv_rank: int,
+                    timeout: float | None = None
+                    ) -> tuple[np.ndarray, MpiStatus]:
+        """Zero-copy receive: like ``recv`` but the returned array may
+        be READ-ONLY and shared — with other local receivers of a
+        fan-out, or with the transport's receive-side delta cache
+        (repeated payloads on a coded stream deliver as the SAME
+        immutable buffer, ISSUE 11). The faabric analog of serving
+        state from the mapped shared-memory region instead of copying
+        it out. Safe indefinitely: shared buffers are immutable by
+        construction, and a consumer's reference keeps one alive past
+        cache eviction. Use for read-only consumers (serving weights,
+        assembling into your own destination); call ``recv`` when you
+        need a private writable array."""
+        return self._recv_raw(send_rank, recv_rank, timeout=timeout)
 
     def probe(self, send_rank: int, recv_rank: int,
               timeout: float | None = None) -> MpiStatus:
@@ -1296,11 +1321,16 @@ class MpiWorld:
         # Opt-in int8 wire quantization on the leader ring's fold leg
         # only (mpi/quant.py) — the cross-machine links are the
         # bandwidth-bound segment EQuARX targets; intra-host phases
-        # stay exact fp32
+        # stay exact fp32. The mode resolves through the wire-codec
+        # governor (ISSUE 11): the legacy knob forces every hop, the
+        # governor's `quant` token enables it per-LINK (each sender
+        # decides for its own next-hop, carried in-band via the
+        # NaN-scale raw passthrough form, never inferred).
         result = self._allreduce_ring(
             rank, host_acc, op, ring=list(topo.leaders), phase="leader",
-            codec=leader_ring_codec(self.allreduce_quant,
-                                    host_acc.dtype, op))
+            codec=leader_ring_codec(
+                resolve_quant_mode(self.allreduce_quant),
+                host_acc.dtype, op))
         with span("mpi.phase", "broadcast", rank=rank,
                   phase="redistribute"):
             if len(locals_) > 1:
@@ -1409,6 +1439,26 @@ class MpiWorld:
         elems = max(1, RING_CHUNK_BYTES // max(1, itemsize))
         return [(c, min(c + elems, hi)) for c in range(lo, hi, elems)]
 
+    def _quant_link_ok(self, peer: int) -> bool:
+        """Whether the leader-ring hop to ``peer`` should actually
+        quantize (wire-codec governor, ISSUE 11). The legacy knob
+        forces every hop; governor-token quant skips same-machine hops
+        in auto mode. The verdict is carried in-band per chunk (the
+        NaN-scale raw passthrough form), so peers never need to agree
+        on it — only on the codec FRAMING, which resolves from
+        world-level configuration."""
+        from faabric_tpu.transport.codec import get_wire_governor
+
+        gov = get_wire_governor()
+        host = self.host_for_rank(peer)
+        if host == self.broker.host:
+            local = True
+        else:
+            from faabric_tpu.transport.common import host_is_local
+
+            local = host_is_local(host)
+        return gov.quant_for_link(self.allreduce_quant, host, local)
+
     def _ring_reduce_scatter(self, rank: int, data: np.ndarray,
                              op: MpiOp, ring: list[int] | None = None,
                              seg: list[tuple[int, int]] | None = None,
@@ -1452,11 +1502,20 @@ class MpiWorld:
         was_writeable = first.flags.writeable
         if codec is None:
             first.flags.writeable = False
+        else:
+            # Per-LINK codec selection (ISSUE 11): whether THIS rank's
+            # next-hop actually quantizes is the governor's call — a
+            # same-machine hop's bytes are nearly free, so it ships the
+            # raw-fp32 passthrough form. Self-describing per chunk (NaN
+            # scale), so mixed hops coexist on one ring.
+            quant_link = self._quant_link_ok(nxt)
         for clo, chi in self._ring_chunks(lo, hi, flat.itemsize):
             if codec is not None:
                 # Encoded chunks are private copies — zero-copy safe
                 # without freezing the caller's views
-                self.send(rank, nxt, codec.encode(first[clo - lo:chi - lo]),
+                self.send(rank, nxt,
+                          codec.encode(first[clo - lo:chi - lo],
+                                       quantize=quant_link),
                           MpiMessageType.REDUCE, _copy=False)
             else:
                 self.send(rank, nxt, first[clo - lo:chi - lo],
@@ -1482,7 +1541,9 @@ class MpiWorld:
                         folded = np.asarray(apply_op(op, arr, mine))
                 if step < n - 2:
                     if codec is not None:
-                        self.send(rank, nxt, codec.encode(folded),
+                        self.send(rank, nxt,
+                                  codec.encode(folded,
+                                               quantize=quant_link),
                                   MpiMessageType.REDUCE, _copy=False)
                     else:
                         # Ownership transfer: the receiver folds into

@@ -10,6 +10,13 @@ planes)} — counting messages, payload bytes and a small send-latency
 histogram. Same-host in-process queue delivery is deliberately NOT
 counted: it is the 6 GiB/s hot path and carries no wire to attribute.
 
+Adaptive wire codecs (ISSUE 11): cells additionally key on the wire
+``codec`` (``raw`` / ``delta`` / ``delta-full`` / ``zlib``) and account
+BOTH ``bytes`` (what crossed the wire) and ``bytes_raw`` (the pre-codec
+payload), so compression shows up as a per-link ratio instead of
+silently under-reporting traffic — and the governor's per-link decision
+is asserted straight off the rows (``codec=`` in the dist tests).
+
 This is the data HiCCL-style collective tuning needs before any
 optimization: the 0.62-vs-6.01 GiB/s allreduce gap stops being a single
 mystery number once each (src, dst, plane) link reports its own
@@ -45,21 +52,24 @@ OTHER = "other"
 
 
 class _Cell:
-    __slots__ = ("messages", "bytes", "lat_sum", "lat_count", "lat_counts",
-                 "_lock")
+    __slots__ = ("messages", "bytes", "bytes_raw", "lat_sum", "lat_count",
+                 "lat_counts", "_lock")
 
     def __init__(self) -> None:
         self.messages = 0
-        self.bytes = 0
+        self.bytes = 0       # WIRE bytes: what actually crossed the link
+        self.bytes_raw = 0   # pre-codec payload bytes (== bytes for raw)
         self.lat_sum = 0.0
         self.lat_count = 0
         self.lat_counts = [0] * len(LATENCY_BUCKETS)
         self._lock = threading.Lock()
 
-    def add(self, nbytes: int, seconds: float | None) -> None:
+    def add(self, nbytes: int, seconds: float | None,
+            raw_bytes: int | None = None) -> None:
         with self._lock:
             self.messages += 1
             self.bytes += nbytes
+            self.bytes_raw += nbytes if raw_bytes is None else raw_bytes
             if seconds is not None:
                 self.lat_sum += seconds
                 self.lat_count += 1
@@ -74,7 +84,8 @@ class _NullCommMatrix:
 
     __slots__ = ()
 
-    def record(self, src, dst, plane, nbytes, seconds=None) -> None:
+    def record(self, src, dst, plane, nbytes, seconds=None,
+               raw_bytes=None, codec="raw") -> None:
         pass
 
     def snapshot(self) -> dict:
@@ -120,16 +131,23 @@ class CommMatrix:
         return str(r) if 0 <= r < self.max_ranks else OTHER
 
     def record(self, src, dst, plane: str, nbytes: int,
-               seconds: float | None = None) -> None:
-        raw = (src, dst, plane)
+               seconds: float | None = None,
+               raw_bytes: int | None = None, codec: str = "raw") -> None:
+        """``nbytes`` is what crossed the WIRE; ``raw_bytes`` the
+        pre-codec payload size (compression must never make the matrix
+        under-report traffic — both are accounted). ``codec`` keys the
+        cell, so one link's raw and delta frames land in separate rows
+        and the governor's per-link decision is directly observable."""
+        raw = (src, dst, plane, codec)
         cell = self._fast.get(raw)
         if cell is None:
-            labels = (self._rank_label(src), self._rank_label(dst), plane)
+            labels = (self._rank_label(src), self._rank_label(dst), plane,
+                      codec)
             with self._lock:
                 cell = self._cells.setdefault(labels, _Cell())
                 if labels[0] is not OTHER and labels[1] is not OTHER:
                     self._fast[raw] = cell
-        cell.add(int(nbytes), seconds)
+        cell.add(int(nbytes), seconds, raw_bytes)
 
     # -- export ---------------------------------------------------------
     def snapshot(self) -> dict:
@@ -138,11 +156,13 @@ class CommMatrix:
         with self._lock:
             items = list(self._cells.items())
         cells = []
-        for (src, dst, plane), c in items:
+        for (src, dst, plane, codec), c in items:
             with c._lock:
                 cells.append({
                     "src": src, "dst": dst, "plane": plane,
+                    "codec": codec,
                     "messages": c.messages, "bytes": c.bytes,
+                    "bytes_raw": c.bytes_raw,
                     "lat_sum": round(c.lat_sum, 9),
                     "lat_count": c.lat_count,
                     "lat_buckets": [[b, n] for b, n in
@@ -165,11 +185,14 @@ class CommMatrix:
 def families_from_cells(cells: list[dict]) -> dict:
     """Registry-schema families from a snapshot's cell rows (used both
     process-locally and planner-side on scraped worker snapshots)."""
-    msgs, byts, lat = [], [], []
+    msgs, byts, raws, lat = [], [], [], []
     for c in cells:
-        labels = {"src": c["src"], "dst": c["dst"], "plane": c["plane"]}
+        labels = {"src": c["src"], "dst": c["dst"], "plane": c["plane"],
+                  "codec": c.get("codec", "raw")}
         msgs.append({"labels": labels, "value": c["messages"]})
         byts.append({"labels": labels, "value": c["bytes"]})
+        raws.append({"labels": labels,
+                     "value": c.get("bytes_raw", c["bytes"])})
         lat.append({"labels": labels, "sum": c.get("lat_sum", 0.0),
                     "count": c.get("lat_count", 0),
                     "buckets": c.get("lat_buckets", [])})
@@ -178,15 +201,24 @@ def families_from_cells(cells: list[dict]) -> dict:
     return {
         "faabric_comm_messages_total": {
             "type": "counter",
-            "help": "Remote messages sent per (src, dst, plane) link",
+            "help": "Remote messages sent per (src, dst, plane, codec) "
+                    "link",
             "series": msgs},
         "faabric_comm_bytes_total": {
             "type": "counter",
-            "help": "Remote payload bytes sent per (src, dst, plane) link",
+            "help": "Remote WIRE bytes sent per (src, dst, plane, codec) "
+                    "link",
             "series": byts},
+        "faabric_comm_raw_bytes_total": {
+            "type": "counter",
+            "help": "Pre-codec payload bytes per (src, dst, plane, "
+                    "codec) link — compression never under-reports "
+                    "traffic",
+            "series": raws},
         "faabric_comm_send_seconds": {
             "type": "histogram",
-            "help": "Per-message send latency per (src, dst, plane) link",
+            "help": "Per-message send latency per (src, dst, plane, "
+                    "codec) link",
             "series": lat},
     }
 
@@ -198,15 +230,18 @@ def merge_cell_rows(per_host: dict[str, list[dict]]) -> list[dict]:
     merged: dict[tuple, dict] = {}
     for _host, cells in per_host.items():
         for c in cells:
-            key = (c["src"], c["dst"], c["plane"])
+            codec = c.get("codec", "raw")
+            key = (c["src"], c["dst"], c["plane"], codec)
             m = merged.get(key)
             if m is None:
                 merged[key] = {"src": c["src"], "dst": c["dst"],
-                               "plane": c["plane"], "messages": 0,
-                               "bytes": 0, "lat_sum": 0.0, "lat_count": 0}
+                               "plane": c["plane"], "codec": codec,
+                               "messages": 0, "bytes": 0, "bytes_raw": 0,
+                               "lat_sum": 0.0, "lat_count": 0}
                 m = merged[key]
             m["messages"] += c.get("messages", 0)
             m["bytes"] += c.get("bytes", 0)
+            m["bytes_raw"] += c.get("bytes_raw", c.get("bytes", 0))
             m["lat_sum"] += c.get("lat_sum", 0.0)
             m["lat_count"] += c.get("lat_count", 0)
     out = list(merged.values())

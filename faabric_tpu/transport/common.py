@@ -70,6 +70,18 @@ def resolve_host(host: str, port: int) -> tuple[str, int]:
     return host, port
 
 
+def host_is_local(host: str) -> bool:
+    """Whether a logical host resolves to THIS machine (loopback or
+    the primary interface address) — the link class the shm fast paths
+    key on and the wire-codec governor keeps raw (ISSUE 11): a
+    same-machine "wire" is a memcpy, so compressing it is pure CPU for
+    no bandwidth."""
+    from faabric_tpu.util.network import is_local_ip
+
+    ip, _ = resolve_host(host, 0)
+    return is_local_ip(ip)
+
+
 def get_host_alias_offset(host: str) -> int:
     with _alias_lock:
         _load_env_aliases_locked()

@@ -57,21 +57,78 @@ def _dirty_runs(flags: np.ndarray) -> list[tuple[int, int]]:
     return [(int(s), int(e - s + 1)) for s, e in zip(starts, ends)]
 
 
-def serialize_delta(settings: DeltaSettings, old: "bytes | np.ndarray",
-                    new: "bytes | np.ndarray") -> bytes:
-    """Encode new relative to old (arrays skip the bytes-conversion
-    copy). The dirty scan is one native/vectorized pass and consecutive
-    dirty pages emit as single runs, so sparse deltas over big images
-    cost ~a memcmp, not a Python loop."""
-    # Arrays pass through without the bytes-conversion copy
+def sampled_overlap(old: "bytes | np.ndarray", new: "bytes | np.ndarray",
+                    page_size: int = 4096, samples: int = 8) -> float:
+    """Sampled XOR-density probe: the fraction of ``samples``
+    evenly-spaced pages that are byte-identical between ``old`` and
+    ``new``. O(samples · page_size) — the cheap pre-check the wire
+    delta codec runs before committing to a full page scan, so a
+    same-shape-but-unrelated payload costs a few memcmps instead of a
+    doomed encode. Size-mismatched buffers report 0.0 (a resized
+    payload is a different stream generation, not a mutated round)."""
     old_arr = (old.reshape(-1).view(np.uint8) if isinstance(old, np.ndarray)
                else np.frombuffer(old, dtype=np.uint8))
     new_arr = (new.reshape(-1).view(np.uint8) if isinstance(new, np.ndarray)
                else np.frombuffer(new, dtype=np.uint8))
+    if old_arr.size != new_arr.size or new_arr.size == 0:
+        return 0.0
+    n_pages = (new_arr.size + page_size - 1) // page_size
+    idx = np.unique(np.linspace(0, n_pages - 1,
+                                min(samples, n_pages)).astype(np.int64))
+    hits = 0
+    for p in idx:
+        lo = int(p) * page_size
+        hi = min(lo + page_size, new_arr.size)
+        if np.array_equal(old_arr[lo:hi], new_arr[lo:hi]):
+            hits += 1
+    return hits / idx.size
+
+
+def sampled_overlap_parts(old: "bytes | np.ndarray", parts: list,
+                          page_size: int = 4096,
+                          samples: int = 8) -> float:
+    """``sampled_overlap`` over a SEGMENTED candidate payload (ordered
+    buffers whose concatenation is the logical frame) — no flatten
+    copy. Sampled pages that straddle a segment boundary are skipped;
+    a size mismatch reports 0.0."""
+    old_arr = (old.reshape(-1).view(np.uint8) if isinstance(old, np.ndarray)
+               else np.frombuffer(old, dtype=np.uint8))
+    arrs = [(p.reshape(-1).view(np.uint8) if isinstance(p, np.ndarray)
+             else np.frombuffer(p, dtype=np.uint8)) for p in parts]
+    total = sum(a.size for a in arrs)
+    if total != old_arr.size or total == 0:
+        return 0.0
+    bounds = []
+    off = 0
+    for a in arrs:
+        bounds.append((off, off + a.size, a))
+        off += a.size
+    n_pages = (total + page_size - 1) // page_size
+    idx = np.unique(np.linspace(0, n_pages - 1,
+                                min(samples, n_pages)).astype(np.int64))
+    hits = tried = 0
+    for pg in idx:
+        lo = int(pg) * page_size
+        hi = min(lo + page_size, total)
+        for s_lo, s_hi, a in bounds:
+            if s_lo <= lo and hi <= s_hi:
+                tried += 1
+                if np.array_equal(a[lo - s_lo:hi - s_lo],
+                                  old_arr[lo:hi]):
+                    hits += 1
+                break
+    return hits / tried if tried else 0.0
+
+
+def _append_delta_body(settings: DeltaSettings, old_arr: np.ndarray,
+                       new_arr: np.ndarray, frame_off: int,
+                       body: bytearray) -> None:
+    """Append DELTA_XOR/OVERWRITE commands for ``new_arr`` vs
+    ``old_arr``, with every command offset shifted by ``frame_off``
+    (segmented encoding: the segment lives at that offset of the
+    logical frame)."""
     ps = settings.page_size
     n = new_arr.size
-
-    body = bytearray()
     from faabric_tpu.util.dirty import page_flags
 
     for first_page, n_pages in _dirty_runs(page_flags(old_arr, new_arr,
@@ -83,25 +140,93 @@ def serialize_delta(settings: DeltaSettings, old: "bytes | np.ndarray",
         if settings.use_xor and xor_end > off:
             payload = np.bitwise_xor(new_arr[off:xor_end],
                                      old_arr[off:xor_end]).tobytes()
-            body += struct.pack("<BQQ", CMD_DELTA_XOR, off, len(payload))
+            body += struct.pack("<BQQ", CMD_DELTA_XOR, frame_off + off,
+                                len(payload))
             body += payload
             off = xor_end
         if off < end:
             payload = new_arr[off:end].tobytes()
-            body += struct.pack("<BQQ", CMD_DELTA_OVERWRITE, off,
-                                len(payload))
+            body += struct.pack("<BQQ", CMD_DELTA_OVERWRITE,
+                                frame_off + off, len(payload))
             body += payload
-    body += struct.pack("<B", CMD_END)
 
+
+def _finish_delta(settings: DeltaSettings, total: int,
+                  body: bytearray) -> bytes:
+    body += struct.pack("<B", CMD_END)
     out = bytearray()
-    out += struct.pack("<BQ", CMD_TOTAL_SIZE, n)
-    if settings.zlib_level > 0:
+    out += struct.pack("<BQ", CMD_TOTAL_SIZE, total)
+    use_zlib = settings.zlib_level > 0
+    if use_zlib and len(body) > (1 << 16):
+        # Compressibility probe: a large command body of structured
+        # XOR noise (float mantissa churn) costs zlib ~2.5 ms/MiB to
+        # shrink maybe 30% — a loss against any link the delta itself
+        # already beat. Sample 4 KiB and compress only when the body
+        # is GENUINELY sparse (<~58% of raw), i.e. when zlib pays for
+        # itself even on a fast link. The stream stays self-describing
+        # (no ZLIB_COMMANDS marker → raw body).
+        probe = zlib.compress(bytes(body[:4096]), settings.zlib_level)
+        if len(probe) > 2400:
+            use_zlib = False
+    if use_zlib:
         compressed = zlib.compress(bytes(body), settings.zlib_level)
         out += struct.pack("<BQ", CMD_ZLIB_COMMANDS, len(compressed))
         out += compressed
     else:
         out += body
     return bytes(out)
+
+
+def _as_u8(buf) -> np.ndarray:
+    return (buf.reshape(-1).view(np.uint8) if isinstance(buf, np.ndarray)
+            else np.frombuffer(buf, dtype=np.uint8))
+
+
+def serialize_delta(settings: DeltaSettings, old: "bytes | np.ndarray",
+                    new: "bytes | np.ndarray") -> bytes:
+    """Encode new relative to old (arrays skip the bytes-conversion
+    copy). The dirty scan is one native/vectorized pass and consecutive
+    dirty pages emit as single runs, so sparse deltas over big images
+    cost ~a memcmp, not a Python loop."""
+    old_arr, new_arr = _as_u8(old), _as_u8(new)
+    body = bytearray()
+    _append_delta_body(settings, old_arr, new_arr, 0, body)
+    return _finish_delta(settings, new_arr.size, body)
+
+
+def serialize_delta_parts(settings: DeltaSettings,
+                          old: "bytes | np.ndarray",
+                          parts: list) -> bytes:
+    """Encode a SEGMENTED new payload (``parts``: ordered buffers whose
+    concatenation is the logical frame) against a flat base WITHOUT
+    materializing the concatenation — the wire delta codec's hot path,
+    where a frame arrives as [small header | big body view] and the
+    steady state must cost a memcmp, not a 100 MiB flatten copy. Each
+    part compares against its base slice (page-granular within the
+    part); command offsets are frame offsets, so ``apply_delta`` needs
+    no segment awareness. Parts past the base's end emit as overwrites
+    (frame growth)."""
+    old_arr = _as_u8(old)
+    body = bytearray()
+    off = 0
+    for part in parts:
+        p = _as_u8(part)
+        if p.size == 0:
+            continue
+        if off + p.size <= old_arr.size:
+            _append_delta_body(settings, old_arr[off:off + p.size], p,
+                               off, body)
+        else:
+            covered = max(0, old_arr.size - off)
+            if covered:
+                _append_delta_body(settings, old_arr[off:], p[:covered],
+                                   off, body)
+            payload = p[covered:].tobytes()
+            body += struct.pack("<BQQ", CMD_DELTA_OVERWRITE,
+                                off + covered, len(payload))
+            body += payload
+        off += p.size
+    return _finish_delta(settings, off, body)
 
 
 def apply_delta(delta: bytes, old: "bytes | np.ndarray",

@@ -223,7 +223,7 @@ def test_bulk_server_survives_garbage(bulk_pair):
     import socket
     import time
 
-    from faabric_tpu.transport.bulk import BULK_PORT, _FRAME
+    from faabric_tpu.transport.bulk import BULK_PORT, _pack_raw
     from faabric_tpu.transport.common import resolve_host
 
     ip, port = resolve_host("bulkB", BULK_PORT)
@@ -235,7 +235,7 @@ def test_bulk_server_survives_garbage(bulk_pair):
 
     # 2. A well-formed header with an absurd size claim
     s = socket.create_connection((ip, port), timeout=5)
-    s.sendall(_FRAME.pack(0, 123, -5, 2, 0, 0, 1 << 62))
+    s.sendall(_pack_raw(0, 123, -5, 2, 0, 0, 1 << 62))
     time.sleep(0.2)
     s.close()
 
@@ -426,7 +426,11 @@ def test_duplicate_ring_attach_refused(bulk_pair):
     import threading
     import time
 
-    from faabric_tpu.transport.bulk import BULK_PORT, SHM_ANNOUNCE, _FRAME
+    from faabric_tpu.transport.bulk import (
+        BULK_PORT,
+        SHM_ANNOUNCE,
+        _pack_raw,
+    )
     from faabric_tpu.transport.common import resolve_host
     from faabric_tpu.transport.shm import shm_available
 
@@ -449,7 +453,7 @@ def test_duplicate_ring_attach_refused(bulk_pair):
     ip, port = resolve_host("bulkB", BULK_PORT)
     s = socket.create_connection((ip, port), timeout=5)
     raw = name.encode()
-    s.sendall(_FRAME.pack(0, 0, 0, 0, 0, len(raw), SHM_ANNOUNCE) + raw)
+    s.sendall(_pack_raw(0, 0, 0, 0, 0, len(raw), SHM_ANNOUNCE) + raw)
     time.sleep(0.3)
 
     # Still exactly one drain registered, and traffic still flows on it

@@ -492,12 +492,40 @@ def test_comm_matrix_records_per_link():
     fams = cm.families()
     assert set(fams) == {"faabric_comm_messages_total",
                          "faabric_comm_bytes_total",
+                         "faabric_comm_raw_bytes_total",
                          "faabric_comm_send_seconds"}
     from faabric_tpu.telemetry import render_snapshots
 
     text = render_snapshots({"w1": fams})
-    assert ('faabric_comm_bytes_total{dst="2",host="w1",plane="shm",'
-            'src="0"} 3072') in text
+    assert ('faabric_comm_bytes_total{codec="raw",dst="2",host="w1",'
+            'plane="shm",src="0"} 3072') in text
+
+
+def test_comm_matrix_codec_rows_account_raw_and_wire():
+    """ISSUE 11 truthfulness: coded frames land in their own codec=
+    row, accounting BOTH wire bytes and pre-codec raw bytes — so
+    compression shows as a ratio, never as vanished traffic."""
+    from faabric_tpu.telemetry import CommMatrix
+
+    cm = CommMatrix(max_ranks=16)
+    cm.record(0, 1, "bulk-tcp", 4096, 0.001)  # raw frame
+    cm.record(0, 1, "bulk-tcp", 500, 0.001, raw_bytes=1 << 20,
+              codec="delta")
+    cm.record(0, 1, "bulk-tcp", 700, 0.001, raw_bytes=1 << 20,
+              codec="delta")
+    cells = {(c["src"], c["dst"], c["plane"], c["codec"]): c
+             for c in cm.snapshot()["cells"]}
+    raw = cells[("0", "1", "bulk-tcp", "raw")]
+    assert raw["bytes"] == 4096 and raw["bytes_raw"] == 4096
+    d = cells[("0", "1", "bulk-tcp", "delta")]
+    assert d["bytes"] == 1200           # what crossed the wire
+    assert d["bytes_raw"] == 2 << 20    # what the payloads really were
+    assert d["messages"] == 2
+    # /metrics carries the raw-bytes family with the codec label
+    fams = cm.families()
+    series = fams["faabric_comm_raw_bytes_total"]["series"]
+    dd = [s for s in series if s["labels"]["codec"] == "delta"]
+    assert dd and dd[0]["value"] == 2 << 20
 
 
 def test_comm_matrix_cardinality_guard():

@@ -34,8 +34,11 @@ LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio|_err)$")
 # FAILURE, not a note — the silent way a >20% regression escapes the
 # gate is the bench section crashing and the key simply vanishing from
 # the summary.
+# delta_stream_gibs (ISSUE 11): the iterative repeated-payload stream
+# rate over the adaptive wire-codec plane — required once recorded,
+# with unit tests pinning its higher-is-better direction.
 REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
-                 "invocations_per_s")
+                 "invocations_per_s", "delta_stream_gibs")
 
 # Invocation-plane reference figures (ISSUE 8) and the first-round
 # ISSUE 10 device-plane key: tracked and printed every round but NOT
@@ -55,16 +58,31 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # allreduce_quant_max_abs_err: tracked so a codec regression at least
 # prints a tagged note — but data-dependent (payload-magnitude-scaled),
 # so never hard-gated.
+# ISSUE 11 companions to delta_stream_gibs: reference rates and the
+# wall-clock/wire ratios. Wall-clock speedups saturate near 1 on this
+# container (loopback outruns memcpy — no wire to win back); the wire
+# ratios are the codec-controlled quantity but are workload-shaped, so
+# all ride as reported-only context rather than hard gates.
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "host_allreduce_device_gibs",
-                 "allreduce_quant_max_abs_err")
+                 "allreduce_quant_max_abs_err",
+                 "host_allreduce_procs_raw_gibs",
+                 "host_allreduce_procs_coded_gibs",
+                 "allreduce_governed_speedup",
+                 "allreduce_coded_wire_speedup",
+                 "delta_stream_raw_gibs", "delta_stream_speedup",
+                 "delta_stream_wire_speedup")
 
 # Round-5 container drift (see ROADMAP "Recent"): ptp dispatch p50 (the
 # headline "value") and delta_apply_reuse_ms read worse in ANY tree on
 # the current container, including unmodified older HEADs verified via
 # worktree — a gate failure there reports the container, not the code.
-# Kept out of the HARD gate (still printed as notes) until the
-# environment stabilises; revisit when a round shows them recovered.
+# Re-measured at ISSUE 11 HEAD (2026-08-03): still drifted — p50
+# 0.089 ms vs the r05-recorded 0.039 (~2.3×) and apply_reuse 48 ms vs
+# 15.5 (~3×), while the same run's one-pass memcpy reads 24 GiB/s —
+# i.e. the regression tracks the container's fresh-page/fault behavior,
+# not code. Kept out of the HARD gate (still printed as notes);
+# re-baseline when a round shows them recovered.
 CONTAINER_DRIFT_EXEMPT = ("value", "delta_apply_reuse_ms")
 
 
