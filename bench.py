@@ -1723,6 +1723,41 @@ def bench_concurrency(quick: bool = False) -> dict:
     return out
 
 
+def bench_perf_introspection(quick: bool = False) -> dict:
+    """ISSUE 12: (a) per-sample overhead of the rolling profile store's
+    ``observe()`` — every bulk frame send pays this — measured with the
+    plane enabled AND as the ``FAABRIC_METRICS=0`` no-op object (the
+    contract: disabled must be one no-op method call, nothing more);
+    (b) the cluster doctor end-to-end over the built-in synthetic
+    cluster (ingest → every analyzer → ranked findings)."""
+    from faabric_tpu.runner.doctor import diagnose, selftest_sources
+    from faabric_tpu.telemetry.perfprofile import (
+        NULL_PERF_STORE,
+        PerfProfileStore,
+    )
+
+    n = 20_000 if quick else 200_000
+    store = PerfProfileStore(label="bench-feed", max_links=64)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        store.observe("peer", "bulk-tcp", 1 << 20, 0.001)
+    feed_ns = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n):
+        NULL_PERF_STORE.observe("peer", "bulk-tcp", 1 << 20, 0.001)
+    noop_ns = (time.perf_counter() - t0) / n * 1e9
+    sources = selftest_sources()
+    t0 = time.perf_counter()
+    findings = diagnose(sources)
+    doctor_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "feed_ns": round(feed_ns, 1),
+        "feed_noop_ns": round(noop_ns, 1),
+        "doctor_selftest_ms": round(doctor_ms, 2),
+        "doctor_findings": len(findings),
+    }
+
+
 def bench_robustness(quick: bool = False) -> dict:
     """ISSUE 2 robustness section: recovery latency under worker loss.
 
@@ -3105,6 +3140,8 @@ def main() -> None:
     host_section("concurrency", lambda: bench_concurrency(quick))
     host_section("invocations", lambda: bench_invocations(quick))
     host_section("robustness", lambda: bench_robustness(quick))
+    host_section("perf_introspection",
+                 lambda: bench_perf_introspection(quick))
 
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
         # Device phase: TPU first with per-section watchdogs; CPU tiny
@@ -3227,6 +3264,16 @@ def main() -> None:
                 "partition_heal_s"):
         if rb.get(key) is not None:
             summary[key] = rb[key]
+    # ISSUE 12 perf-introspection keys (REPORTED_ONLY this round): the
+    # per-frame profile feed cost, its FAABRIC_METRICS=0 no-op floor,
+    # and the doctor's end-to-end synthetic-cluster runtime
+    pi = extras.get("perf_introspection") or {}
+    if pi.get("feed_ns") is not None:
+        summary["perf_feed_ns"] = pi["feed_ns"]
+    if pi.get("feed_noop_ns") is not None:
+        summary["perf_feed_noop_ns"] = pi["feed_noop_ns"]
+    if pi.get("doctor_selftest_ms") is not None:
+        summary["doctor_selftest_ms"] = pi["doctor_selftest_ms"]
     result = {
         "metric": "ptp_dispatch_p50_ms",
         "value": round(p50, 4) if p50 else None,

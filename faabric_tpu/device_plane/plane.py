@@ -40,13 +40,20 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
 
 import numpy as np
 
 from faabric_tpu.device_plane.registry import DevicePlaneFallback
 from faabric_tpu.mpi.types import MpiOp, UserOp
-from faabric_tpu.telemetry import get_comm_matrix, get_metrics, span
+from faabric_tpu.telemetry import (
+    get_collective_profiler,
+    get_comm_matrix,
+    get_metrics,
+    get_perf_store,
+    span,
+)
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -77,6 +84,11 @@ _COMPILES = _metrics.counter(
 _FALLBACKS = _metrics.counter(
     "faabric_device_plane_fallbacks_total",
     "Device plane disables (collectives re-routed to the host ladder)")
+# ISSUE 12: compile/execute phases fold into the collective profiler
+# (critical-path decomposition shows compile-storm rounds next to the
+# steady state) and executed payloads feed the device-plane link profile
+_PROFILER = get_collective_profiler()
+_PERF = get_perf_store()
 
 
 class _Round:
@@ -288,23 +300,36 @@ class DevicePlane:
             for r, (_k, buf) in sorted(deposits.items())]
         x = jax.make_array_from_single_device_arrays(
             (self.n, m), self._in_sharding, shards)
+        executor_rank = min(deposits)
         if compiled is None:
             # Rounds are sequential per plane (a rank cannot enter round
             # N+1 before round N released it), so one executor compiles
             # at a time — the lock only orders the publish
             _COMPILES.inc()
+            t0 = time.monotonic()
             with span("mpi.phase", "compile", phase="compile",
                       world=self.world_id, kind=kind, elems=m,
                       dtype=dtype):
                 jfn = self._build(kind, op_code)
                 compiled = jfn.lower(x).compile()
+            _PROFILER.record_phase(self.world_id, kind, executor_rank,
+                                   "compile", time.monotonic() - t0)
             with self._cache_lock:
                 self._cache[key] = compiled
 
+        t0 = time.monotonic()
         with span("mpi.phase", "execute", phase="execute",
                   world=self.world_id, kind=kind, elems=m, dtype=dtype):
             y = compiled(x)
-            return self._distribute(kind, y)
+            out = self._distribute(kind, y)
+        elapsed = time.monotonic() - t0
+        _PROFILER.record_phase(self.world_id, kind, executor_rank,
+                               "execute", elapsed)
+        # The whole mesh's payload moved through the device plane in
+        # this one execute — a per-mesh rate, not a per-point link
+        total_bytes = sum(buf.nbytes for _k, buf in deposits.values())
+        _PERF.observe("mesh", "device", total_bytes, elapsed)
+        return out
 
     def _build(self, kind: str, op_code: int):
         """The jitted program for one (kind, op): a shard_map whose

@@ -102,6 +102,9 @@ class PlannerHttpEndpoint:
                     elif path == "/commmatrix":
                         body = endpoint.commmatrix_json().encode()
                         ctype = "application/json"
+                    elif path == "/perf":
+                        body = endpoint.perf_json().encode()
+                        ctype = "application/json"
                     elif path == "/healthz":
                         body = endpoint.healthz_json().encode()
                         ctype = "application/json"
@@ -182,6 +185,21 @@ class PlannerHttpEndpoint:
             "hosts": per_host,
             "total": merge_cell_rows(per_host),
         })
+
+    def perf_json(self) -> str:
+        """Cluster-wide performance profile (ISSUE 12): every host's
+        rolling link estimators tagged with their source host, merged
+        collective phase series with cross-host critical-path and
+        straggler analysis. Each aggregation is checkpointed to
+        ``FAABRIC_PERF_PROFILE_DIR`` (best-effort) so the doctor — and
+        the next planner — can read the last known cluster profile
+        without a live scrape."""
+        from faabric_tpu.telemetry import aggregate_perf, persist_cluster
+
+        doc = aggregate_perf(self.planner.collect_telemetry())
+        self.planner.note_perf_aggregation(doc)
+        persist_cluster(doc)
+        return json.dumps(doc)
 
     def healthz_json(self) -> str:
         return json.dumps(self.planner.health_summary())

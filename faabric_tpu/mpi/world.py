@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -50,6 +51,7 @@ from faabric_tpu.mpi.quant import (
 )
 from faabric_tpu.telemetry import (
     NULL_SPAN,
+    get_collective_profiler,
     get_metrics,
     span,
     tracing_enabled,
@@ -113,6 +115,12 @@ DEVICE_PLANE_ENABLED = os.environ.get(
 _metrics = get_metrics()
 _coll_total: dict = {}
 _coll_bytes: dict = {}
+
+# Collective phase fold-in (ISSUE 12): every rank records its round
+# entry stamp, per-phase durations and total into the collective
+# profiler — the store behind /perf's critical-path decomposition and
+# the straggler detector. Shared no-op when metrics/profiling are off.
+_PROFILER = get_collective_profiler()
 
 
 def _count_collective(op: str, nbytes: int) -> None:
@@ -1120,6 +1128,25 @@ class MpiWorld:
 
     def allreduce(self, rank: int, data: np.ndarray,
                   op: MpiOp = MpiOp.SUM) -> np.ndarray:
+        arr = np.asarray(data)
+        if not _PROFILER.enabled:
+            return self._allreduce_entry(rank, arr, op)
+        # Collective fold-in (ISSUE 12): the wall-anchored ENTRY stamp
+        # is what straggler analysis compares across ranks — in a
+        # synchronous collective the late arriver inflates everyone's
+        # total equally, so only arrival skew can identify it
+        _PROFILER.record_phase(self.id, "allreduce", rank, "enter_ts",
+                               time.time())
+        t0 = time.monotonic()
+        try:
+            return self._allreduce_entry(rank, arr, op)
+        finally:
+            _PROFILER.record_phase(self.id, "allreduce", rank, "total",
+                                   time.monotonic() - t0,
+                                   int(arr.nbytes))
+
+    def _allreduce_entry(self, rank: int, arr: np.ndarray,
+                         op: MpiOp) -> np.ndarray:
         # Large single-host payloads: ring reduce-scatter + allgather.
         # The root-serialized leader tree bottlenecks on ONE thread doing
         # every add and every fan-out send; the ring splits the fold
@@ -1127,7 +1154,6 @@ class MpiWorld:
         # reason the device plane reduces via psum_scatter+all_gather).
         # Multi-host worlds keep the leader tree: it sends exactly one
         # message per remote host over the wire, which the ring does not.
-        arr = np.asarray(data)
         # Rung 0 — the device plane (shm → tcp → DEVICE): an activated
         # world's eligible payloads run as one compiled program over the
         # mesh; everything below is the host ladder it falls back to
@@ -1308,13 +1334,27 @@ class MpiWorld:
         topo = self.topology()
         locals_ = list(topo.ranks_on_host(topo.host_of(rank)))
         leader = locals_[0]
+        # Per-phase fold-in (ISSUE 12): intra/leader/redistribute wall
+        # durations land in the collective profiler so /perf's critical
+        # path names the slow HIERARCHY LEVEL, not just the slow rank
+        prof = _PROFILER.enabled
+        t_ph = time.monotonic() if prof else 0.0
         host_acc, restore = self._host_reduce(rank, data, op, locals_)
+        if prof:
+            now = time.monotonic()
+            _PROFILER.record_phase(self.id, "allreduce", rank, "intra",
+                                   now - t_ph)
+            t_ph = now
 
         if rank != leader:
             with span("mpi.phase", "broadcast", rank=rank,
                       phase="redistribute"):
                 arr, _ = self._recv_raw(leader, rank)
                 out = self._private_result(arr, data)
+            if prof:
+                _PROFILER.record_phase(self.id, "allreduce", rank,
+                                       "redistribute",
+                                       time.monotonic() - t_ph)
             restore()
             return out
 
@@ -1331,6 +1371,11 @@ class MpiWorld:
             codec=leader_ring_codec(
                 resolve_quant_mode(self.allreduce_quant),
                 host_acc.dtype, op))
+        if prof:
+            now = time.monotonic()
+            _PROFILER.record_phase(self.id, "allreduce", rank, "leader",
+                                   now - t_ph)
+            t_ph = now
         with span("mpi.phase", "broadcast", rank=rank,
                   phase="redistribute"):
             if len(locals_) > 1:
@@ -1342,6 +1387,10 @@ class MpiWorld:
                 # Receivers keep the frozen buffer; the caller gets a
                 # private copy it may mutate immediately
                 result = shared.copy()
+        if prof:
+            _PROFILER.record_phase(self.id, "allreduce", rank,
+                                   "redistribute",
+                                   time.monotonic() - t_ph)
         restore()
         return self._private_result(result, data, private=True)
 
@@ -1718,6 +1767,20 @@ class MpiWorld:
         reduce-scatter phase directly — every rank folds 1/np per step
         and the root never materialises the full reduction."""
         data = np.asarray(data).reshape(-1)
+        if not _PROFILER.enabled:
+            return self._reduce_scatter_entry(rank, data, op)
+        _PROFILER.record_phase(self.id, "reduce_scatter", rank,
+                               "enter_ts", time.time())
+        t0 = time.monotonic()
+        try:
+            return self._reduce_scatter_entry(rank, data, op)
+        finally:
+            _PROFILER.record_phase(self.id, "reduce_scatter", rank,
+                                   "total", time.monotonic() - t0,
+                                   int(data.nbytes))
+
+    def _reduce_scatter_entry(self, rank: int, data: np.ndarray,
+                              op: MpiOp) -> np.ndarray:
         if data.size % self.size:
             raise ValueError(
                 f"reduce_scatter needs size divisible by {self.size}")
@@ -1875,12 +1938,25 @@ class MpiWorld:
         return out
 
     def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data)
+        if not _PROFILER.enabled:
+            return self._allgather_entry(rank, data)
+        _PROFILER.record_phase(self.id, "allgather", rank, "enter_ts",
+                               time.time())
+        t0 = time.monotonic()
+        try:
+            return self._allgather_entry(rank, data)
+        finally:
+            _PROFILER.record_phase(self.id, "allgather", rank, "total",
+                                   time.monotonic() - t0,
+                                   int(data.nbytes))
+
+    def _allgather_entry(self, rank: int, data: np.ndarray) -> np.ndarray:
         # Large same-machine payloads: ring allgather — contributions
         # circulate as read-only chunk references through the in-process
         # queues (n-1 steps, one assembly write per chunk) instead of
         # funnelling through rank 0 twice. Contributions above one bulk
         # frame stream as pipeline chunks (no size cap).
-        data = np.asarray(data)
         dplane = self.device_plane()
         if dplane is not None and dplane.eligible("allgather", data):
             out = self._try_device("allgather", dplane, rank, data)

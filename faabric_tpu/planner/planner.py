@@ -145,7 +145,8 @@ class Planner:
     # after __init__), _telemetry_scrapes (GIL-atomic setdefault/pop by
     # design), _clients/_snapshot_clients/_journal/snapshot_registry/
     # ingress (internally synchronized), _journal_replay_stats/
-    # _reconcile_stats (write-once diagnostics), _reconcile_timer
+    # _reconcile_stats (write-once diagnostics), _perf_agg_stats
+    # (GIL-atomic whole-dict swap), _reconcile_timer
     # (start/stop sequenced by recovery).
     GUARDS = {
         "_hosts": "_lock",
@@ -254,6 +255,10 @@ class Planner:
         self._journal_last_hosts: set[str] = set()
         self._journal_replay_stats: Optional[dict] = None
         self._reconcile_stats: Optional[dict] = None
+        # Last /perf aggregation summary (GIL-atomic whole-dict swap,
+        # same discipline as the write-once diagnostics above): the
+        # healthz perf block and the doctor read staleness off it
+        self._perf_agg_stats: Optional[dict] = None
         self._reconcile_timer: Optional[threading.Timer] = None
         if self._journal.enabled:
             self._recover_from_journal()
@@ -2102,6 +2107,25 @@ class Planner:
             journal["lastReconcile"] = self._reconcile_stats
         from faabric_tpu.batch_scheduler import get_decision_cache
 
+        # ISSUE 12 satellite: the perf block — local profile-store
+        # cardinality, cluster straggler counts from the last /perf
+        # aggregation, and that aggregation's age (None = never ran).
+        # Planner-local state only, like everything else here.
+        from faabric_tpu.telemetry import (
+            get_collective_profiler,
+            get_perf_store,
+        )
+
+        agg = self._perf_agg_stats
+        perf_block = {
+            "profileLinksLocal": get_perf_store().cardinality(),
+            "stragglersLocal": len(get_collective_profiler().detect()),
+            "lastAggregationAgeSeconds": (
+                round(now - agg["at"], 3) if agg else None),
+            "clusterLinks": agg["links"] if agg else None,
+            "clusterStragglers": agg["stragglers"] if agg else None,
+        }
+
         return {
             "status": "ok",
             "hosts": hosts,
@@ -2109,12 +2133,23 @@ class Planner:
             "inFlightMessages": in_flight_messages,
             "resultsTotal": results_total,
             "resultsFailed": results_failed,
+            "perf": perf_block,
             # ISSUE 8 satellite: admission-queue depth/shed, tick
             # occupancy and the decision-cache hit rate, so an operator
             # can see the ingress breathe under load
             "ingress": self.ingress.stats(),
             "decisionCache": get_decision_cache().stats(),
             "journal": journal,
+        }
+
+    def note_perf_aggregation(self, doc: dict) -> None:
+        """Record the summary of a completed ``/perf`` aggregation
+        (endpoint-driven): healthz reports its age and headline counts
+        so the doctor can tell a stale profile from a fresh one."""
+        self._perf_agg_stats = {
+            "at": time.monotonic(),
+            "links": len(doc.get("links") or []),
+            "stragglers": len(doc.get("stragglers") or []),
         }
 
     def collect_telemetry(self, include_trace: bool = False,
@@ -2126,11 +2161,16 @@ class Planner:
         fails — or is wedged past ``timeout`` — is skipped, not fatal; a
         scrape must not go down (or block a Prometheus scrape window)
         with one bad host."""
-        from faabric_tpu.telemetry import get_comm_matrix, trace_events
+        from faabric_tpu.telemetry import (
+            get_comm_matrix,
+            perf_telemetry_block,
+            trace_events,
+        )
 
         out: dict = {"planner": {"metrics": get_metrics().snapshot(),
                                  "commmatrix":
-                                 get_comm_matrix().snapshot()}}
+                                 get_comm_matrix().snapshot(),
+                                 "perf": perf_telemetry_block()}}
         if include_trace:
             out["planner"]["trace"] = trace_events()
 

@@ -1,0 +1,547 @@
+"""Cluster doctor: ranked performance/health diagnosis from the
+planner's scrape surfaces (ISSUE 12).
+
+    python -m faabric_tpu.runner.doctor --url http://127.0.0.1:8080
+    python -m faabric_tpu.runner.doctor --dir /path/to/dumps
+    python -m faabric_tpu.runner.doctor --selftest
+
+Ingests ``/perf`` (the rolling performance-profile aggregation),
+``/metrics`` (Prometheus text), ``/commmatrix``, ``/healthz`` and
+``/topology`` — live over HTTP, or from files dumped earlier
+(``perf.json`` / ``perf-cluster.json``, ``metrics.txt``,
+``commmatrix.json``, ``healthz.json``, ``topology.json``) so a
+post-mortem needs no live cluster — and prints a RANKED diagnosis:
+
+- **slow links** — per-plane, links whose measured bandwidth sits far
+  below the cluster median for that plane (the HiCCL "slow rung");
+- **straggler ranks** — ranks consistently arriving late at their
+  collectives (entry-skew analysis over the merged per-round series,
+  annotated with the rank's host via the topology);
+- **codec escape storms** — full-frame escapes dwarfing coded frames
+  (a link whose delta stream keeps breaking pays for nothing);
+- **admission shedding** — the ingress actively 429ing sources;
+- **journal fsync pressure** — the group-commit journal's write-behind
+  buffer backing up or fsync falling behind its interval;
+- **open circuit breakers / keep-alives at risk** — hosts the planner
+  is about to give up on.
+
+``--selftest`` runs the analyzers over a built-in synthetic cluster
+with one planted slow link, one planted straggler and one escape storm,
+and exits non-zero unless all three rank in the top findings — the
+smoke gate ``tools/check.sh`` runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# One median, shared with the straggler analysis this tool cross-checks
+from faabric_tpu.telemetry.perfprofile import _median
+
+SOURCES = ("perf", "metrics", "commmatrix", "healthz", "topology")
+
+# File-name candidates per source for --dir mode (first hit wins)
+_FILE_CANDIDATES = {
+    "perf": ("perf.json", "perf-cluster.json"),
+    "metrics": ("metrics.txt", "metrics.prom", "metrics"),
+    "commmatrix": ("commmatrix.json",),
+    "healthz": ("healthz.json",),
+    "topology": ("topology.json",),
+}
+
+# A link must carry this many samples before the doctor will call it
+# slow — three frames of noise is not a diagnosis
+MIN_LINK_MESSAGES = 5
+SLOW_LINK_RATIO = 0.5     # below this × plane median → finding
+ESCAPE_STORM_RATIO = 0.05  # escapes / coded frames above this → finding
+
+
+# ---------------------------------------------------------------------------
+# Ingestion
+# ---------------------------------------------------------------------------
+
+def parse_prometheus(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Minimal exposition-format parser: name → [(labels, value)].
+    Histogram series keep their _bucket/_sum/_count suffixed names."""
+    out: dict[str, list[tuple[dict, float]]] = {}
+    label_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            metric, value_s = line.rsplit(" ", 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            labels = {m.group(1): m.group(2).replace('\\"', '"')
+                      for m in label_re.finditer(rest)}
+        else:
+            name, labels = metric, {}
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def fetch_live(base_url: str, timeout: float = 10.0) -> dict:
+    """Scrape every source from a live planner endpoint. A failing
+    source becomes None (the checks degrade, the doctor still runs)."""
+    import urllib.request
+
+    base = base_url.rstrip("/")
+    sources: dict = {}
+    for name in SOURCES:
+        url = f"{base}/{name}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                body = resp.read().decode()
+        except Exception as e:  # noqa: BLE001 — diagnosis must degrade
+            print(f"doctor: {url} unavailable ({e})", file=sys.stderr)
+            sources[name] = None
+            continue
+        sources[name] = (parse_prometheus(body) if name == "metrics"
+                         else json.loads(body))
+    return sources
+
+
+def load_dir(directory: str) -> dict:
+    """Sources from dumped files (missing files → None)."""
+    sources: dict = {}
+    for name in SOURCES:
+        sources[name] = None
+        for candidate in _FILE_CANDIDATES[name]:
+            path = os.path.join(directory, candidate)
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path) as f:
+                    body = f.read()
+            except OSError as e:
+                print(f"doctor: cannot read {path}: {e}", file=sys.stderr)
+                continue
+            try:
+                sources[name] = (parse_prometheus(body)
+                                 if name == "metrics"
+                                 else json.loads(body))
+            except json.JSONDecodeError as e:
+                print(f"doctor: bad JSON in {path}: {e}", file=sys.stderr)
+            break
+    return sources
+
+
+# ---------------------------------------------------------------------------
+# Checks — each returns findings: {"severity", "kind", "subject", "detail"}
+# ---------------------------------------------------------------------------
+
+
+
+def _link_gibs_rows(perf: dict) -> list[dict]:
+    """Per-(src, dst, plane) best bandwidth evidence from the /perf link
+    table: collapse codec/size-class cells onto their link, preferring
+    the bytes-weighted average rate (the comm-matrix-comparable figure),
+    falling back to the EWMA."""
+    links: dict[tuple, dict] = {}
+    for row in perf.get("links") or []:
+        gibs = row.get("gibs_avg") or row.get("gibs_ewma")
+        if gibs is None:
+            continue
+        key = (row.get("src"), row.get("dst"), row.get("plane"))
+        cur = links.get(key)
+        messages = row.get("messages") or 0
+        nbytes = row.get("bytes") or 0
+        if cur is None:
+            links[key] = {"src": key[0], "dst": key[1], "plane": key[2],
+                          "gibs": gibs, "messages": messages,
+                          "bytes": nbytes}
+        else:
+            # Bytes-weighted merge across size classes/codecs
+            tot = cur["bytes"] + nbytes
+            if tot > 0:
+                cur["gibs"] = ((cur["gibs"] * cur["bytes"]
+                                + gibs * nbytes) / tot)
+            cur["messages"] += messages
+            cur["bytes"] = tot
+    return list(links.values())
+
+
+def check_slow_links(perf: dict | None) -> list[dict]:
+    if not perf:
+        return []
+    findings = []
+    rows = [r for r in _link_gibs_rows(perf)
+            if (r["messages"] or 0) >= MIN_LINK_MESSAGES
+            and r["dst"] not in ("mesh",)]
+    by_plane: dict[str, list[dict]] = {}
+    for r in rows:
+        by_plane.setdefault(r["plane"], []).append(r)
+    for plane, plane_rows in by_plane.items():
+        if len(plane_rows) < 2:
+            continue  # nothing to compare against
+        med = _median([r["gibs"] for r in plane_rows])
+        if med <= 0:
+            continue
+        for r in plane_rows:
+            ratio = r["gibs"] / med
+            if ratio < SLOW_LINK_RATIO:
+                findings.append({
+                    "kind": "slow_link",
+                    "severity": min(95.0, 50.0 + 45.0 * (1.0 - ratio)),
+                    "subject": f"{r['src']}→{r['dst']} ({plane})",
+                    "detail": (f"{r['gibs']:.3f} GiB/s vs plane median "
+                               f"{med:.3f} ({ratio:.0%}); "
+                               f"{r['messages']} msgs, "
+                               f"{r['bytes'] >> 20} MiB"),
+                })
+    return findings
+
+
+def _rank_host(topology: dict | None, rank, size=None) -> str | None:
+    """Weak topology fallback when the perf row carries no host: only a
+    world whose size matches disambiguates (the topology's worlds are
+    keyed by app id, every world has ranks 0..n-1, and a bare rank
+    number matches all of them — so without a size hint that matches
+    exactly one world, no attribution is honest)."""
+    candidates = []
+    for world in (topology or {}).get("worlds", {}).values():
+        if size is not None and world.get("size") != size:
+            continue
+        for host, ranks in (world.get("hosts") or {}).items():
+            if int(rank) in [int(r) for r in ranks]:
+                candidates.append(host)
+    return candidates[0] if len(candidates) == 1 else None
+
+
+def check_stragglers(perf: dict | None,
+                     topology: dict | None) -> list[dict]:
+    if not perf:
+        return []
+    findings = []
+    for s in perf.get("stragglers") or []:
+        skew_ms = (s.get("median_skew_s") or 0.0) * 1e3
+        # Exact placement rides the /perf row itself (the merge knows
+        # which host's telemetry carried each rank); topology is only
+        # a weak fallback for older dumps
+        host = s.get("host") or _rank_host(topology, s.get("rank"))
+        where = f" on {host}" if host else ""
+        findings.append({
+            "kind": "straggler",
+            "severity": min(90.0, 40.0 + 10.0 * min(5.0, skew_ms / 10.0)
+                            + 5.0 * min(4, s.get("rounds_flagged", 0))),
+            "subject": (f"rank {s.get('rank')}{where} "
+                        f"(world {s.get('world')}, "
+                        f"{s.get('collective')})"),
+            "detail": (f"arrives {skew_ms:.1f} ms late (median skew) in "
+                       f"{s.get('rounds_flagged')}/"
+                       f"{s.get('rounds_seen')} rounds"),
+        })
+    return findings
+
+
+def check_codec_escapes(metrics: dict | None) -> list[dict]:
+    if not metrics:
+        return []
+    escapes = sum(v for _l, v in
+                  metrics.get("faabric_codec_escapes_total", []))
+    frames = sum(v for _l, v in
+                 metrics.get("faabric_codec_frames_total", []))
+    if frames < 20 or escapes <= 0:
+        return []
+    ratio = escapes / frames
+    if ratio < ESCAPE_STORM_RATIO:
+        return []
+    reasons: dict[str, float] = {}
+    for labels, v in metrics.get("faabric_codec_escapes_total", []):
+        if v > 0:
+            key = labels.get("reason", "?")
+            reasons[key] = reasons.get(key, 0) + v
+    top = sorted(reasons.items(), key=lambda kv: -kv[1])
+    return [{
+        "kind": "codec_escape_storm",
+        "severity": min(85.0, 30.0 + 100.0 * ratio),
+        "subject": "wire-codec plane",
+        "detail": (f"{int(escapes)} full-frame escapes vs {int(frames)} "
+                   f"coded frames ({ratio:.1%}); top reasons: "
+                   + ", ".join(f"{k}={int(v)}" for k, v in top[:3])),
+    }]
+
+
+def check_healthz(healthz: dict | None) -> list[dict]:
+    if not healthz:
+        return []
+    findings = []
+    ingress = healthz.get("ingress") or {}
+    shed = ingress.get("shedTotal") or 0
+    admitted = ingress.get("admittedTotal") or 0
+    if shed > 0:
+        ratio = shed / max(1, shed + admitted)
+        findings.append({
+            "kind": "admission_shed",
+            "severity": min(80.0, 25.0 + 100.0 * ratio),
+            "subject": "ingress admission",
+            "detail": (f"{shed} invocations shed vs {admitted} admitted "
+                       f"({ratio:.1%}); queue "
+                       f"{ingress.get('queueDepth')}/"
+                       f"{ingress.get('queueMax')}"),
+        })
+    journal = healthz.get("journal") or {}
+    if journal.get("enabled"):
+        buffered = journal.get("bufferedRecords") or 0
+        age = journal.get("lastFsyncAgeSeconds")
+        interval = journal.get("fsyncIntervalSeconds") or 0.05
+        pressured = buffered > 256 or (
+            journal.get("dirty") and age is not None
+            and age > max(1.0, 20 * interval))
+        if pressured:
+            findings.append({
+                "kind": "journal_fsync_pressure",
+                "severity": min(75.0, 30.0 + buffered / 32.0),
+                "subject": "planner journal",
+                "detail": (f"{buffered} buffered records, last fsync "
+                           f"{age}s ago (interval {interval}s)"),
+            })
+    for row in healthz.get("hosts") or []:
+        breaker = row.get("breaker") or {}
+        if breaker.get("state") == "open":
+            findings.append({
+                "kind": "breaker_open",
+                "severity": 88.0,
+                "subject": f"host {row.get('host')}",
+                "detail": (f"circuit breaker OPEN after "
+                           f"{breaker.get('consecutiveFailures')} "
+                           "consecutive failures — dispatches to this "
+                           "host fail fast"),
+            })
+        age = row.get("keepAliveAgeSeconds")
+        timeout = row.get("timeoutSeconds")
+        if (age is not None and timeout
+                and age > 0.8 * timeout):
+            findings.append({
+                "kind": "keepalive_at_risk",
+                "severity": 70.0,
+                "subject": f"host {row.get('host')}",
+                "detail": (f"last keep-alive {age:.1f}s ago "
+                           f"(expiry at {timeout}s) — about to be "
+                           "expired and its work requeued"),
+            })
+    perf_block = healthz.get("perf") or {}
+    agg_age = perf_block.get("lastAggregationAgeSeconds")
+    if agg_age is not None and agg_age > 600:
+        findings.append({
+            "kind": "perf_stale",
+            "severity": 20.0,
+            "subject": "performance profiles",
+            "detail": (f"last /perf aggregation {agg_age:.0f}s ago — "
+                       "diagnosis below may be stale"),
+        })
+    return findings
+
+
+def check_profile_matrix_agreement(perf: dict | None,
+                                   commmatrix: dict | None) -> list[dict]:
+    """Cross-check: per source host, the profile store's bytes-weighted
+    bulk rate vs the comm matrix's bytes/latency for the same host's
+    outbound bulk rows. Large disagreement points at a broken feed, not
+    a slow link — surfaced as its own finding."""
+    if not perf or not commmatrix:
+        return []
+    findings = []
+    per_host_rows: dict[str, list[dict]] = {}
+    for r in _link_gibs_rows(perf):
+        if r["plane"] == "bulk-tcp":
+            per_host_rows.setdefault(r["src"], []).append(r)
+    for host, rows in per_host_rows.items():
+        cells = (commmatrix.get("hosts") or {}).get(host) or []
+        # WIRE bytes, not bytes_raw: the profile store observes what
+        # crossed the wire, so a compressed link's honest comparison is
+        # wire/latency on both sides (raw/latency would differ by the
+        # compression ratio and cry wolf on every delta link)
+        m_bytes = sum(c.get("bytes", 0)
+                      for c in cells if c.get("plane") == "bulk-tcp")
+        m_lat = sum(c.get("lat_sum", 0.0) for c in cells
+                    if c.get("plane") == "bulk-tcp")
+        if m_lat <= 0 or m_bytes <= 0:
+            continue
+        matrix_gibs = (m_bytes / m_lat) / (1 << 30)
+        tot_bytes = sum(r["bytes"] for r in rows)
+        if tot_bytes <= 0:
+            continue
+        profile_gibs = sum(r["gibs"] * r["bytes"]
+                           for r in rows) / tot_bytes
+        if matrix_gibs <= 0:
+            continue
+        err = abs(profile_gibs - matrix_gibs) / matrix_gibs
+        if err > 0.5:
+            findings.append({
+                "kind": "profile_matrix_disagreement",
+                "severity": 35.0,
+                "subject": f"host {host} (bulk-tcp)",
+                "detail": (f"profile says {profile_gibs:.2f} GiB/s, "
+                           f"comm matrix {matrix_gibs:.2f} "
+                           f"({err:.0%} apart) — check the feed points"),
+            })
+    return findings
+
+
+def diagnose(sources: dict) -> list[dict]:
+    """Every check over whatever sources are present, ranked most-severe
+    first."""
+    findings: list[dict] = []
+    findings += check_slow_links(sources.get("perf"))
+    findings += check_stragglers(sources.get("perf"),
+                                 sources.get("topology"))
+    findings += check_codec_escapes(sources.get("metrics"))
+    findings += check_healthz(sources.get("healthz"))
+    findings += check_profile_matrix_agreement(sources.get("perf"),
+                                               sources.get("commmatrix"))
+    findings.sort(key=lambda f: -f["severity"])
+    return findings
+
+
+def render(findings: list[dict], top: int = 0) -> str:
+    if not findings:
+        return "doctor: no findings — cluster looks healthy"
+    rows = findings[:top] if top else findings
+    lines = [f"doctor: {len(findings)} finding(s)"
+             + (f", top {len(rows)}:" if top and top < len(findings)
+                else ":")]
+    for i, f in enumerate(rows, 1):
+        lines.append(f"{i:3d}. [{f['severity']:5.1f}] "
+                     f"{f['kind']:<28} {f['subject']}")
+        lines.append(f"      {f['detail']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Selftest fixture
+# ---------------------------------------------------------------------------
+
+def selftest_sources() -> dict:
+    """A synthetic 3-host cluster with one planted slow link (hA→hC at
+    ~1/10 of the plane median), one planted straggler (rank 5 arriving
+    ~40 ms late every round) and a codec escape storm."""
+    def link(src, dst, gibs, messages=200, nbytes=512 << 20):
+        return {"src": src, "dst": dst, "plane": "bulk-tcp",
+                "codec": "raw", "size_class": "1MiB",
+                "messages": messages, "bytes": nbytes,
+                "gibs_avg": gibs, "gibs_ewma": gibs}
+
+    rounds = {}
+    base_ts = 1000.0
+    for i in range(8):
+        rd = {}
+        for rank in range(8):
+            # End-aligned synchronous rounds: rank 5 idles 40 ms before
+            # entering, everyone else's total absorbs the wait
+            late = 0.040 if rank == 5 else 0.0
+            rd[str(rank)] = {"enter_ts": base_ts + i * 0.1 + late,
+                             "total": 0.055 - late}
+        rounds[str(i)] = rd
+    from faabric_tpu.telemetry import find_stragglers
+
+    stragglers = [{"world": 900, "collective": "allreduce",
+                   "rank": int(r), "host": "hC", **st}
+                  for r, st in find_stragglers(rounds).items()]
+    perf = {
+        "links": [link("hA", "hB", 2.2), link("hB", "hA", 2.0),
+                  link("hB", "hC", 2.4), link("hC", "hB", 2.1),
+                  link("hC", "hA", 1.9),
+                  link("hA", "hC", 0.21)],  # the planted slow link
+        "collectives": [{"world": 900, "collective": "allreduce",
+                         "completed": 64, "rounds": rounds,
+                         "stragglers": {"5": stragglers[0]}
+                         if stragglers else {}}],
+        "stragglers": stragglers,
+        "hosts": ["hA", "hB", "hC"],
+    }
+    metrics = {
+        "faabric_codec_frames_total": [({"codec": "delta"}, 900.0)],
+        "faabric_codec_escapes_total": [({"reason": "nack"}, 120.0),
+                                        ({"reason": "crc"}, 30.0)],
+    }
+    healthz = {
+        "status": "ok",
+        "hosts": [{"host": h, "keepAliveAgeSeconds": 1.0,
+                   "timeoutSeconds": 30, "breaker": None}
+                  for h in ("hA", "hB", "hC")],
+        "ingress": {"shedTotal": 0, "admittedTotal": 5000,
+                    "queueDepth": 3, "queueMax": 1024},
+        "journal": {"enabled": True, "bufferedRecords": 2,
+                    "dirty": False, "lastFsyncAgeSeconds": 0.01,
+                    "fsyncIntervalSeconds": 0.05},
+        "perf": {"lastAggregationAgeSeconds": 5.0},
+    }
+    topology = {"hosts": {}, "worlds": {
+        "900": {"size": 8,
+                "hosts": {"hA": [0, 1, 2, 3], "hC": [4, 5, 6, 7]}}}}
+    return {"perf": perf, "metrics": metrics, "commmatrix": None,
+            "healthz": healthz, "topology": topology}
+
+
+def run_selftest() -> int:
+    findings = diagnose(selftest_sources())
+    print(render(findings, top=10))
+    top_kinds = [f["kind"] for f in findings[:5]]
+    problems = []
+    slow = [f for f in findings if f["kind"] == "slow_link"]
+    if not slow or "hA→hC" not in slow[0]["subject"]:
+        problems.append("planted slow link hA→hC not found")
+    stragglers = [f for f in findings if f["kind"] == "straggler"]
+    if not stragglers or "rank 5" not in stragglers[0]["subject"]:
+        problems.append("planted straggler rank 5 not found")
+    if "hC" not in (stragglers[0]["subject"] if stragglers else ""):
+        problems.append("straggler not attributed to its host hC")
+    if "codec_escape_storm" not in [f["kind"] for f in findings]:
+        problems.append("planted escape storm not found")
+    if "slow_link" not in top_kinds or "straggler" not in top_kinds:
+        problems.append(f"planted faults not in top findings: {top_kinds}")
+    if problems:
+        print("doctor selftest FAILED:", "; ".join(problems))
+        return 1
+    print("doctor selftest OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m faabric_tpu.runner.doctor",
+        description="Ranked cluster performance/health diagnosis")
+    parser.add_argument("--url", help="live planner endpoint base URL "
+                        "(e.g. http://127.0.0.1:8080)")
+    parser.add_argument("--dir", help="directory of dumped sources "
+                        "(perf.json, metrics.txt, commmatrix.json, "
+                        "healthz.json, topology.json)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--top", type=int, default=12,
+                        help="show only the top N findings (0 = all)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="run on the built-in synthetic cluster and "
+                        "verify the planted faults are found")
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        return run_selftest()
+    if args.url:
+        sources = fetch_live(args.url)
+    elif args.dir:
+        sources = load_dir(args.dir)
+    else:
+        parser.error("one of --url, --dir or --selftest is required")
+        return 2
+    findings = diagnose(sources)
+    if args.json:
+        print(json.dumps({"findings": findings}, indent=1))
+    else:
+        print(render(findings, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,11 +213,16 @@ class FunctionCallServer(MessageEndpointServer):
             from faabric_tpu.telemetry import (
                 get_comm_matrix,
                 get_metrics,
+                perf_telemetry_block,
                 trace_events,
             )
 
             body: dict = {"metrics": get_metrics().snapshot(),
-                          "commmatrix": get_comm_matrix().snapshot()}
+                          "commmatrix": get_comm_matrix().snapshot(),
+                          # ISSUE 12: this host's rolling link profiles
+                          # + collective phase series, aggregated by the
+                          # planner behind GET /perf
+                          "perf": perf_telemetry_block()}
             if msg.header.get("trace"):
                 body["trace"] = trace_events()
             # Payload, not header: a full trace buffer is bulk data

@@ -26,6 +26,22 @@ from faabric_tpu.telemetry.flight import (
     flight_record,
     get_flight,
 )
+from faabric_tpu.telemetry.perfprofile import (
+    NULL_COLLECTIVE_PROFILER,
+    NULL_PERF_STORE,
+    CollectiveProfiler,
+    PerfProfileStore,
+    aggregate_perf,
+    critical_path,
+    find_stragglers,
+    get_collective_profiler,
+    get_perf_store,
+    merge_collective_series,
+    merge_link_rows,
+    perf_telemetry_block,
+    persist_cluster,
+    reset_perf_profile,
+)
 from faabric_tpu.telemetry.metrics import (
     DEFAULT_BUCKETS,
     NULL_METRIC,
@@ -65,17 +81,31 @@ from faabric_tpu.telemetry.tracer import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "NULL_COLLECTIVE_PROFILER",
     "NULL_COMM_MATRIX",
     "NULL_FLIGHT",
     "NULL_METRIC",
+    "NULL_PERF_STORE",
     "NULL_SPAN",
+    "CollectiveProfiler",
     "CommMatrix",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PerfProfileStore",
     "Tracer",
+    "aggregate_perf",
+    "critical_path",
+    "find_stragglers",
+    "get_collective_profiler",
+    "get_perf_store",
+    "merge_collective_series",
+    "merge_link_rows",
+    "perf_telemetry_block",
+    "persist_cluster",
+    "reset_perf_profile",
     "chrome_trace",
     "chrome_trace_json",
     "current_trace_context",

@@ -80,6 +80,7 @@ from faabric_tpu.telemetry import (
     get_comm_matrix,
     get_flight,
     get_metrics,
+    get_perf_store,
     span,
     tracing_enabled,
 )
@@ -143,6 +144,10 @@ _BULK_RECONNECTS = _metrics.counter(
 # per-frame record must not even build its kwargs dict.
 _COMM = get_comm_matrix()
 _FLIGHT = get_flight()
+# Host-level rolling bandwidth/latency profile (ISSUE 12): each stripe
+# feeds its destination HOST's link estimators alongside the rank-level
+# comm matrix — the governor and schedule compiler read links, not ranks
+_PERF = get_perf_store()
 
 _FAULTS = faults_enabled()
 _FP_BULK = fault_point("transport.bulk")
@@ -839,6 +844,8 @@ class _Stripe:
         _BULK_SEND_SECONDS["tcp"].observe(elapsed)
         _COMM.record(send_idx, recv_idx, "bulk-tcp", wire.nbytes, elapsed,
                      raw_bytes=frame.raw_nbytes, codec=label)
+        _PERF.observe(self.host, "bulk-tcp", wire.nbytes, elapsed,
+                      codec=label)
         if _FLIGHT is not NULL_FLIGHT:
             _FLIGHT.record("send", group=group_id, src=send_idx,
                            dst=recv_idx, plane="bulk-tcp",
@@ -976,6 +983,7 @@ class _Stripe:
                     _BULK_SEND_SECONDS["shm"].observe(elapsed)
                     _COMM.record(send_idx, recv_idx, "shm", nbytes,
                                  elapsed)
+                    _PERF.observe(self.host, "shm", nbytes, elapsed)
                     if _FLIGHT is not NULL_FLIGHT:
                         _FLIGHT.record("send", group=group_id,
                                        src=send_idx, dst=recv_idx,
@@ -1016,6 +1024,7 @@ class _Stripe:
                 _BULK_SEND_SECONDS["tcp"].observe(elapsed)
                 _COMM.record(send_idx, recv_idx, "bulk-tcp", nbytes,
                              elapsed)
+                _PERF.observe(self.host, "bulk-tcp", nbytes, elapsed)
                 if _FLIGHT is not NULL_FLIGHT:
                     _FLIGHT.record("send", group=group_id, src=send_idx,
                                    dst=recv_idx, plane="bulk-tcp",
@@ -1051,6 +1060,7 @@ class _Stripe:
                     _BULK_SEND_SECONDS["tcp"].observe(elapsed)
                     _COMM.record(send_idx, recv_idx, "bulk-tcp", nbytes,
                                  elapsed)
+                    _PERF.observe(self.host, "bulk-tcp", nbytes, elapsed)
                     if _FLIGHT is not NULL_FLIGHT:
                         _FLIGHT.record("send", group=group_id,
                                        src=send_idx, dst=recv_idx,

@@ -25,6 +25,7 @@ from faabric_tpu.faults import DROP, fault_point, faults_enabled
 from faabric_tpu.telemetry import (
     current_trace_context,
     get_metrics,
+    get_perf_store,
     tracing_enabled,
 )
 from faabric_tpu.transport.common import DEFAULT_SOCKET_TIMEOUT, resolve_host
@@ -59,6 +60,10 @@ _TX_BYTES = {
 _RPC_SECONDS = _metrics.histogram(
     "faabric_transport_rpc_seconds",
     "Client-side sync RPC round-trip latency")
+# Host-level RPC-plane profile (ISSUE 12): sync round-trips feed the
+# destination host's latency estimators (and, for bulk-sized payloads,
+# its bandwidth estimators) in the rolling performance-profile store
+_PERF = get_perf_store()
 
 
 class RpcError(Exception):
@@ -204,11 +209,13 @@ class MessageEndpointClient:
                             f"injected drop of sync RPC {code} to "
                             f"{self.host}:{self.sync_port}")
                     sock = self._get_sock("sync")
+                    attempt_t0 = time.monotonic()
                     send_frame(sock, msg)
                     sent = True
                     _TX_FRAMES["sync"].inc()
                     _TX_BYTES["sync"].inc(len(payload))
                     resp = recv_frame(sock)
+                    attempt_elapsed = time.monotonic() - attempt_t0
                     self.breaker.record_success()
                     break
                 except (OSError, TransportError) as e:
@@ -228,6 +235,11 @@ class MessageEndpointClient:
             else:  # pragma: no cover
                 raise RpcError("unreachable")
         _RPC_SECONDS.observe(time.monotonic() - t0)
+        # The profile gets the SUCCESSFUL attempt's round-trip only: a
+        # retry loop's backoff sleeps and failed dials measure this
+        # client's patience, not the link — folding them in would let
+        # one reconnect brand a healthy host as a slow link
+        _PERF.observe(self.host, "ptp", len(payload), attempt_elapsed)
         if resp.response_code != int(MessageResponseCode.SUCCESS):
             raise RpcError(
                 f"RPC {code} to {self.host}:{self.sync_port} failed: "

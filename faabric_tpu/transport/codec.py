@@ -9,10 +9,12 @@ every round — this module is the machinery that notices and stops
 paying full price:
 
 - ``WireCodecGovernor``: picks raw / delta / zlib per (link,
-  payload-class) from the comm matrix's measured per-link GiB/s and a
-  cheap sampled byte-entropy estimate, so slow cross-host links
-  compress while shm/loopback stays raw. Decisions are re-evaluated
-  per comm-matrix window and are carried IN THE FRAME HEADER (codec
+  payload-class) from the rolling performance-profile store's measured
+  per-host GiB/s (ISSUE 12; big-frame evidence only, comm-matrix
+  window as the unmeasured-link fallback) and a cheap sampled
+  byte-entropy estimate, so slow cross-host links compress while
+  shm/loopback stays raw. Decisions are re-evaluated
+  per profile window and are carried IN THE FRAME HEADER (codec
   byte + epochs, transport/bulk.py ``_FRAME``), never inferred by the
   receiver. The leader-ring quant knob (mpi/quant.py) resolves through
   the same governor, so lossy int8 becomes one policy among several
@@ -50,16 +52,18 @@ speed above which compression never pays, default 4).
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 import zlib
 
 import numpy as np
 
-from faabric_tpu.telemetry import get_metrics
+from faabric_tpu.telemetry import flight_record, get_metrics, get_perf_store
 from faabric_tpu.util.delta import (
     DeltaSettings,
     apply_delta,
+    delta_is_xor_only,
     sampled_overlap_parts,
     serialize_delta_parts,
 )
@@ -100,6 +104,15 @@ ZLIB_ENTROPY_MAX = 6.5
 # per chunk position) and the NACK-resend window of recent coded seqs.
 MAX_BASES_PER_STREAM = 48
 SENT_WINDOW = 16
+# Auto-mode bandwidth evidence must come from size classes at least
+# this big: compact steady-state delta frames' wall time is dispatch
+# overhead and reads as a falsely slow link — accepting it would lock
+# a fast link into compression on its own output (profile says slow →
+# keep delta → only small frames → profile keeps saying slow). With
+# only small-frame evidence the store reports None and the comm-matrix
+# raw-rate fallback decides, which is biased the OPPOSITE way (raw
+# bytes over wire time) and lets a genuinely fast link escape.
+GOVERNOR_BW_EVIDENCE_BYTES = 256 * 1024
 
 _metrics = get_metrics()
 _CODEC_TX_FRAMES = {
@@ -121,6 +134,26 @@ _CODEC_ESCAPES = {
     for reason in ("nack", "reconnect", "lost_payload", "crc",
                    "base_missing", "decode_error")
 }
+# Rolling double-buffer base reuse (ISSUE 12 satellite): rounds whose
+# steady-state insert/apply copy was replaced by an O(dirty) in-place
+# patch of the two-rounds-old buffer, and the flatten bytes avoided
+_CODEC_BASE_REUSE = {
+    side: _metrics.counter(
+        "faabric_codec_base_reuse_total",
+        "Rolling base-buffer reuses (flatten/apply copy avoided)",
+        side=side)
+    for side in ("send", "recv")
+}
+_CODEC_BASE_REUSE_BYTES = {
+    side: _metrics.counter(
+        "faabric_codec_base_reuse_bytes_total",
+        "Payload bytes whose full copy the rolling bases avoided",
+        side=side)
+    for side in ("send", "recv")
+}
+_CODEC_VERDICT_FLIPS = _metrics.counter(
+    "faabric_codec_verdict_flips_total",
+    "Governor per-link codec verdict changes (flight-recorded)")
 
 
 def count_escape(reason: str) -> None:
@@ -193,7 +226,7 @@ class _SendStream:
     """Sender-side state for one (group, src, dst, channel) stream."""
 
     __slots__ = ("bases", "order", "sent", "hint", "next_epoch",
-                 "force_full", "by_print")
+                 "force_full", "by_print", "roll", "last_delta", "hist")
 
     def __init__(self) -> None:
         self.bases: dict[int, np.ndarray] = {}   # epoch → payload copy
@@ -208,6 +241,23 @@ class _SendStream:
         # desyncs (measured: per-round cost grew ~25 ms/round at 13
         # shards). A probe still CONFIRMS every hit before use.
         self.by_print: dict[tuple, int] = {}
+        # Rolling double-buffer lineage (ISSUE 12 satellite): the last
+        # two consecutively-inserted epochs, plus the delta command
+        # stream that transformed roll[0]'s content into roll[1]'s.
+        # When round r encodes against roll[1], roll[0]'s buffer can be
+        # patched in place (last_delta then this round's delta — both
+        # O(dirty pages)) to hold round r's content, so the steady
+        # state pays NO full flatten copy and NO allocation.
+        self.roll: list[int] = []
+        self.last_delta: bytes | None = None
+        # Delta history for the NACK-heal window: (self_epoch,
+        # base_epoch, delta_bytes) per delta insert, SENT_WINDOW deep.
+        # Rolling recycles base BUFFERS, but same-size streams emit
+        # pure-XOR deltas — which are self-inverting — so a recycled
+        # epoch's payload is reconstructible by reverse-applying the
+        # chain from any live base (see _reconstruct_locked). The
+        # resend guarantee therefore survives the copy elimination.
+        self.hist: list[tuple[int, int, bytes]] = []
 
 
 # Fingerprint sample geometry: a few fixed 16-byte windows spread over
@@ -261,13 +311,25 @@ class SenderDeltaCache:
         "_streams": "_lock",
         "_bytes": "_lock",
         "_lru": "_lock",
+        "reused": "_lock",
+        "reused_bytes": "_lock",
+        "reconstructed": "_lock",
     }
 
     def __init__(self, budget_bytes: int | None = None) -> None:
         self._lock = threading.Lock()
         self._streams: dict[tuple, _SendStream] = {}
-        self._lru: list[tuple] = []  # (key, epoch) insertion order
+        # (key, epoch) → None, insertion-ordered: dict instead of list
+        # so the per-frame rolled-path removal is O(1), not a scan of
+        # every cached base under the lock
+        self._lru: dict[tuple, None] = {}
         self._bytes = 0
+        # Rolling base-reuse accounting (unit-pinned): rounds that
+        # skipped the flatten copy, the payload bytes not copied, and
+        # NACK heals served by XOR-chain reconstruction
+        self.reused = 0
+        self.reused_bytes = 0
+        self.reconstructed = 0
         self.budget = (_cache_budget_bytes() if budget_bytes is None
                        else budget_bytes)
 
@@ -310,8 +372,8 @@ class SenderDeltaCache:
                 # epoch, no cache copy, steady-state cost ≈ one memcmp
                 self_epoch = base_epoch
             else:
-                self_epoch = self._insert_locked(
-                    key, st, _flatten(parts, total), fp)
+                self_epoch = self._insert_rolled_locked(
+                    key, st, parts, total, fp, base_epoch, delta)
             st.sent[seq] = self_epoch
             self._trim_sent_locked(st)
             _CODEC_TX_FRAMES["delta"].inc()
@@ -325,6 +387,10 @@ class SenderDeltaCache:
         flat = _flatten(parts, total)
         epoch = self._insert_locked(key, st, flat,
                                     _fingerprint([flat], total))
+        # A full frame starts a fresh lineage (no delta transforms the
+        # previous content into this one)
+        st.roll = [epoch]
+        st.last_delta = None
         st.sent[seq] = epoch
         self._trim_sent_locked(st)
         if allow_zlib and payload_entropy(flat) <= ZLIB_ENTROPY_MAX:
@@ -340,6 +406,82 @@ class SenderDeltaCache:
         # send only reads it) — a full frame costs exactly one copy
         return CodedFrame(CODEC_FULL, FLAG_CACHE | flags, 0, epoch, 0,
                           flat, total)
+
+    def _insert_rolled_locked(self, key: tuple, st: _SendStream,
+                              parts: list, total: int, fp: tuple,
+                              base_epoch: int, delta: bytes) -> int:
+        """Register the new payload as a base. Steady state — the frame
+        was encoded against the LATEST base and the lineage's older
+        buffer is idle — patches the two-rounds-old buffer in place:
+        ``last_delta`` rolls it forward to the latest content, this
+        round's delta to the new. Two O(dirty-pages) patches replace the
+        O(total) flatten copy AND its allocation, with net-zero cache
+        byte accounting. Every other shape (cyclic multi-base streams,
+        resized payloads, a buffer still referenced by a NACK resend)
+        falls back to the flatten path and restarts the lineage."""
+        roll = st.roll
+        if (len(roll) == 2 and base_epoch == roll[1]
+                and st.last_delta is not None):
+            buf = st.bases.get(roll[0])
+            # refcount 3 == bases dict + `buf` + getrefcount's argument;
+            # anything higher means an in-flight frame or NACK resend
+            # still reads the buffer — never patch under a reader
+            if (buf is not None and buf.nbytes == total
+                    and sys.getrefcount(buf) <= 3):
+                old = roll[0]
+                try:
+                    buf.flags.writeable = True
+                    apply_delta(st.last_delta, buf, out=buf)
+                    apply_delta(delta, buf, out=buf)
+                except Exception:  # noqa: BLE001 — corrupt lineage:
+                    # the half-patched buffer is garbage; drop it and
+                    # restart the lineage on the flatten path below
+                    self._drop_locked(key, st, old)
+                    st.roll = []
+                    st.last_delta = None
+                else:
+                    buf.flags.writeable = False
+                    epoch = st.next_epoch
+                    st.next_epoch += 1
+                    # Re-register the same allocation under the new
+                    # epoch: bookkeeping moves, byte accounting constant
+                    del st.bases[old]
+                    try:
+                        st.order.remove(old)
+                    except ValueError:
+                        pass
+                    self._lru.pop((key, old), None)
+                    for k in [k for k, e in st.by_print.items()
+                              if e == old]:
+                        del st.by_print[k]
+                    st.bases[epoch] = buf
+                    st.order.append(epoch)
+                    st.by_print[fp] = epoch
+                    self._lru[(key, epoch)] = None
+                    st.roll = [roll[1], epoch]
+                    st.last_delta = bytes(delta)
+                    self._hist_append_locked(st, epoch, base_epoch,
+                                             st.last_delta)
+                    self.reused += 1
+                    self.reused_bytes += total
+                    _CODEC_BASE_REUSE["send"].inc()
+                    _CODEC_BASE_REUSE_BYTES["send"].inc(total)
+                    return epoch
+        epoch = self._insert_locked(key, st, _flatten(parts, total), fp)
+        # Lineage (re)starts here: valid iff the base we encoded
+        # against survived the insert's eviction pass
+        st.roll = ([base_epoch, epoch] if base_epoch in st.bases
+                   else [epoch])
+        st.last_delta = bytes(delta)
+        self._hist_append_locked(st, epoch, base_epoch, st.last_delta)
+        return epoch
+
+    @staticmethod
+    def _hist_append_locked(st: _SendStream, self_epoch: int,
+                            base_epoch: int, delta: bytes) -> None:
+        st.hist.append((self_epoch, base_epoch, delta))
+        while len(st.hist) > SENT_WINDOW:
+            st.hist.pop(0)
 
     def _pick_base_locked(self, st: _SendStream, parts: list,
                           total: int, fp: tuple) -> int:
@@ -382,7 +524,7 @@ class SenderDeltaCache:
         st.bases[epoch] = flat
         st.order.append(epoch)
         st.by_print[fp] = epoch  # latest content under this print wins
-        self._lru.append((key, epoch))
+        self._lru[(key, epoch)] = None
         self._bytes += flat.nbytes
         while len(st.order) > MAX_BASES_PER_STREAM:
             self._drop_locked(key, st, st.order[0])
@@ -391,6 +533,9 @@ class SenderDeltaCache:
 
     def _drop_locked(self, key: tuple, st: _SendStream,
                      epoch: int) -> None:
+        # LRU entry goes first, unconditionally: an entry surviving an
+        # early return here would wedge _evict_locked's head-pop loop
+        self._lru.pop((key, epoch), None)
         base = st.bases.pop(epoch, None)
         if base is None:
             return
@@ -399,19 +544,18 @@ class SenderDeltaCache:
             st.order.remove(epoch)
         except ValueError:
             pass
-        try:
-            self._lru.remove((key, epoch))
-        except ValueError:
-            pass
         for k in [k for k, e in st.by_print.items() if e == epoch]:
             del st.by_print[k]
+        if epoch in st.roll:  # evicted lineage member: lineage is dead
+            st.roll = []
+            st.last_delta = None
 
     def _evict_locked(self) -> None:
         while self._bytes > self.budget and self._lru:
-            key, epoch = self._lru[0]
+            key, epoch = next(iter(self._lru))
             st = self._streams.get(key)
             if st is None:
-                self._lru.pop(0)
+                self._lru.pop((key, epoch), None)
                 continue
             self._drop_locked(key, st, epoch)
 
@@ -423,10 +567,13 @@ class SenderDeltaCache:
     def take_for_resend(self, key: tuple, seq: int
                         ) -> tuple[np.ndarray, int] | None:
         """The raw payload + epoch for a NACKed seq (None if the resend
-        window or the base cache no longer holds it — the documented
-        unhealable-gap corner, same stance as a bulk RST). Marks the
-        stream so its next regular frame ships full, re-establishing a
-        base the receiver certainly has."""
+        window no longer covers it — the documented unhealable-gap
+        corner, same stance as a bulk RST). An epoch whose BUFFER the
+        rolling double-buffer recycled is reconstructed from the
+        retained XOR delta chain (pure-XOR deltas are self-inverting),
+        so base reuse does not narrow the heal window. Marks the stream
+        so its next regular frame ships full, re-establishing a base
+        the receiver certainly has."""
         with self._lock:
             st = self._streams.get(key)
             if st is None:
@@ -437,15 +584,58 @@ class SenderDeltaCache:
                 return None
             base = st.bases.get(epoch)
             if base is None:
-                return None
+                return self._reconstruct_locked(st, epoch)
             return base, epoch
+
+    def _reconstruct_locked(self, st: _SendStream, epoch: int
+                            ) -> tuple[np.ndarray, int] | None:
+        """Rebuild a recycled epoch's payload by reverse-applying the
+        delta chain from the newest LIVE base down to ``epoch``: each
+        hist entry's delta transformed base→self, and a pure-XOR delta
+        applied to the SELF content yields the BASE content back.
+        Overwrite commands (frame growth) are not invertible — a chain
+        containing one gives up (the pre-existing lost_payload corner).
+        O(total) copy + O(chain × dirty) patches, on the rare NACK path
+        only."""
+        # Walk hist newest-first until we reach the requested epoch,
+        # requiring an unbroken base←self lineage
+        chain: list[bytes] = []
+        need = None  # the self_epoch the next-older entry must provide
+        start = None  # the live epoch reconstruction starts from
+        for self_e, base_e, delta in reversed(st.hist):
+            if need is None:
+                if st.bases.get(self_e) is None:
+                    continue  # not live: keep looking for an anchor
+                need = self_e
+                start = self_e
+            if self_e != need:
+                return None  # lineage gap
+            chain.append(delta)
+            need = base_e
+            if base_e == epoch:
+                break
+        else:
+            return None
+        if start is None:
+            return None
+        buf = st.bases[start].copy()
+        try:
+            for delta in chain:
+                if not delta_is_xor_only(delta):
+                    return None
+                apply_delta(delta, buf, out=buf)
+        except Exception:  # noqa: BLE001 — size drift, corrupt stream
+            return None
+        buf.flags.writeable = False
+        self.reconstructed += 1
+        return buf, epoch
 
     def reset(self) -> None:
         """Forget everything (stripe reconnect: the receiver's per-conn
         cache died with the connection, so every base is stale)."""
         with self._lock:
             self._streams.clear()
-            self._lru.clear()
+            self._lru.clear()  # dict: clears in O(n), no scans after
             self._bytes = 0
 
     # -- observability --------------------------------------------------
@@ -460,11 +650,14 @@ class SenderDeltaCache:
 
 
 class _RecvStream:
-    __slots__ = ("bases", "order")
+    __slots__ = ("bases", "order", "roll", "last_delta")
 
     def __init__(self) -> None:
         self.bases: dict[int, np.ndarray] = {}
         self.order: list[int] = []
+        # Rolling lineage, mirror of the sender's (see _SendStream)
+        self.roll: list[int] = []
+        self.last_delta: bytes | None = None
 
 
 class ReceiverDeltaCache:
@@ -482,7 +675,7 @@ class ReceiverDeltaCache:
     def __init__(self, budget_bytes: int | None = None) -> None:
         self._lock = threading.Lock()
         self._streams: dict[tuple, _RecvStream] = {}
-        self._lru: list[tuple] = []
+        self._lru: dict[tuple, None] = {}  # (key, epoch), insert order
         self._bytes = 0
         self.budget = (_cache_budget_bytes() if budget_bytes is None
                        else budget_bytes)
@@ -529,24 +722,87 @@ class ReceiverDeltaCache:
                 # Identical payload: the cached base IS the message —
                 # deliver it read-only, zero copies on either side
                 return base
+            delta_bytes = wire.tobytes()
+            rolled = self._decode_rolled(key, base_epoch, self_epoch,
+                                         delta_bytes, raw_nbytes)
+            if rolled is not None:
+                return rolled
             try:
-                out = apply_delta(wire.tobytes(), base)
+                out = apply_delta(delta_bytes, base)
             except Exception:  # noqa: BLE001 — any decode blowup → NACK
                 count_escape("decode_error")
                 return None
             if out.size != raw_nbytes:
                 count_escape("decode_error")
                 return None
-            self._store(key, self_epoch, out)
+            self._store(key, self_epoch, out, lineage_base=base_epoch,
+                        delta=delta_bytes)
             return out
         count_escape("decode_error")
         return None
 
-    def _store(self, key: tuple, epoch: int, payload: np.ndarray) -> None:
+    def _decode_rolled(self, key: tuple, base_epoch: int, self_epoch: int,
+                       delta: bytes, raw_nbytes: int) -> np.ndarray | None:
+        """Steady-state delta decode without the per-round apply copy:
+        when the frame extends the stream's rolling lineage and the
+        two-rounds-old buffer has no outside reader (delivered arrays
+        are shared zero-copy with the MPI layer — the refcount check
+        proves every consumer dropped its reference), patch that buffer
+        in place (two O(dirty) passes) instead of allocating a fresh
+        full-size base copy. None → caller takes the allocating path."""
+        with self._lock:
+            st = self._streams.get(key)
+            if (st is None or len(st.roll) != 2
+                    or base_epoch != st.roll[1]
+                    or st.last_delta is None
+                    or self_epoch in st.bases):
+                return None
+            buf = st.bases.get(st.roll[0])
+            # bases dict + `buf` + getrefcount's argument = 3; a live
+            # consumer (or the ordered-recv queue) holding the array it
+            # was delivered pushes the count higher and vetoes reuse
+            if (buf is None or buf.nbytes != raw_nbytes
+                    or sys.getrefcount(buf) > 3):
+                return None
+            old = st.roll[0]
+            try:
+                # May refuse on a buffer backed by an immutable object
+                # (e.g. a frombuffer view of bytes) — that's a veto, not
+                # an error; the allocating path below handles the frame
+                buf.flags.writeable = True
+                apply_delta(st.last_delta, buf, out=buf)
+                apply_delta(delta, buf, out=buf)
+            except Exception:  # noqa: BLE001 — half-patched buffer is
+                # garbage: drop it, kill the lineage, decode normally
+                self._drop_locked(key, st, old)
+                st.roll = []
+                st.last_delta = None
+                return None
+            buf.flags.writeable = False
+            del st.bases[old]
+            try:
+                st.order.remove(old)
+            except ValueError:
+                pass
+            self._lru.pop((key, old), None)
+            st.bases[self_epoch] = buf
+            st.order.append(self_epoch)
+            self._lru[(key, self_epoch)] = None
+            st.roll = [base_epoch, self_epoch]
+            st.last_delta = delta
+            _CODEC_BASE_REUSE["recv"].inc()
+            _CODEC_BASE_REUSE_BYTES["recv"].inc(raw_nbytes)
+            return buf
+
+    def _store(self, key: tuple, epoch: int, payload: np.ndarray,
+               lineage_base: int | None = None,
+               delta: bytes | None = None) -> None:
         """Adopt ``payload`` as the immutable base for ``epoch`` — no
         copy: the caller hands over a buffer it exclusively owns (recv
         buffer, decompress output, apply_delta result) and delivery
-        shares it read-only."""
+        shares it read-only. ``lineage_base``/``delta`` extend the
+        rolling lineage when this store resulted from a delta against
+        the lineage head (see _decode_rolled)."""
         copy = payload
         try:
             copy.flags.writeable = False
@@ -559,22 +815,32 @@ class ReceiverDeltaCache:
                 st = self._streams[key] = _RecvStream()
             if epoch in st.bases:
                 return  # duplicate-seq redelivery: identical content
+            if (lineage_base is not None and delta is not None
+                    and lineage_base in st.bases):
+                st.roll = [lineage_base, epoch]
+                st.last_delta = delta
+            else:
+                st.roll = [epoch]
+                st.last_delta = None
             st.bases[epoch] = copy
             st.order.append(epoch)
-            self._lru.append((key, epoch))
+            self._lru[(key, epoch)] = None
             self._bytes += copy.nbytes
             while len(st.order) > MAX_BASES_PER_STREAM:
                 self._drop_locked(key, st, st.order[0])
             while self._bytes > self.budget and self._lru:
-                k, e = self._lru[0]
+                k, e = next(iter(self._lru))
                 s = self._streams.get(k)
                 if s is None:
-                    self._lru.pop(0)
+                    self._lru.pop((k, e), None)
                     continue
                 self._drop_locked(k, s, e)
 
     def _drop_locked(self, key: tuple, st: _RecvStream,
                      epoch: int) -> None:
+        # LRU entry first, unconditionally — a surviving entry would
+        # wedge the budget-eviction head-pop loop above
+        self._lru.pop((key, epoch), None)
         base = st.bases.pop(epoch, None)
         if base is None:
             return
@@ -583,10 +849,9 @@ class ReceiverDeltaCache:
             st.order.remove(epoch)
         except ValueError:
             pass
-        try:
-            self._lru.remove((key, epoch))
-        except ValueError:
-            pass
+        if epoch in st.roll:  # evicted lineage member: lineage is dead
+            st.roll = []
+            st.last_delta = None
 
     def drop_bases(self) -> None:
         """Test/ops hook: forget every base (simulates a migration remap
@@ -624,9 +889,11 @@ class WireCodecGovernor:
 
     Policy (``auto``): shm-capable / same-machine links stay raw —
     a ring memcpy beats any codec. Cross-machine links compress when
-    their measured comm-matrix bandwidth is below
+    their measured bandwidth — the rolling profile store's big-frame
+    evidence first (which persists across restarts), the comm-matrix
+    window as fallback — is below
     ``FAABRIC_WIRE_CODEC_MIN_GIBS`` (or unmeasured: a fresh WAN link is
-    assumed slow until the matrix says otherwise). Forced tokens
+    assumed slow until a measurement says otherwise). Forced tokens
     (``delta``/``zlib``) override locality so tests and benches can
     exercise the codec plane on loopback; ``raw``/``off`` disables it.
     Decisions are cached per (host, link, size-class) and re-evaluated
@@ -686,13 +953,37 @@ class WireCodecGovernor:
             hit = self._decisions.get(key)
             if hit is not None and now < hit[1]:
                 return hit[0]
-        gibs = self._link_gibs(src, dst)
+        # Primary signal (ISSUE 12, the PR 11 follow-up): the rolling
+        # performance-profile store's decayed per-host bandwidth — which
+        # also survives restarts via FAABRIC_PERF_PROFILE_DIR seeding.
+        # Big-frame evidence only (see GOVERNOR_BW_EVIDENCE_BYTES); the
+        # ad-hoc comm-matrix window remains as the fallback while the
+        # store has no qualifying evidence for this destination.
+        gibs = get_perf_store().link_gibs(
+            host, plane="bulk-tcp",
+            min_bytes=GOVERNOR_BW_EVIDENCE_BYTES)
+        source = "profile"
+        if gibs is None:
+            gibs = self._link_gibs(src, dst)
+            source = "commmatrix"
         choice = "delta" if (gibs is None or gibs < self.min_gibs) \
             else "raw"
         with self._lock:
+            prev = self._decisions.get(key)
             self._decisions[key] = (choice, now + self.WINDOW_SECONDS)
             if len(self._decisions) > 4096:
                 self._decisions.clear()  # cardinality backstop
+        if prev is None or prev[0] != choice:
+            # Post-mortem breadcrumb (ISSUE 12 satellite): WHY a link
+            # changed codec — bounded by the decision cardinality ×
+            # actual verdict changes, so the ring never floods
+            if prev is not None:
+                _CODEC_VERDICT_FLIPS.inc()
+            flight_record("codec_verdict", host=host, src=src, dst=dst,
+                          verdict=choice,
+                          prev=prev[0] if prev else None,
+                          gibs=round(gibs, 3) if gibs is not None
+                          else None, source=source)
         return choice
 
     def _link_gibs(self, src, dst) -> float | None:

@@ -229,6 +229,37 @@ def serialize_delta_parts(settings: DeltaSettings,
     return _finish_delta(settings, off, body)
 
 
+def delta_is_xor_only(delta: bytes) -> bool:
+    """True iff every payload command in the stream is DELTA_XOR — the
+    self-inverting form (``apply_delta`` of the same stream onto the
+    NEW image yields the OLD one back), which the wire codec's
+    NACK-heal reconstruction relies on. An OVERWRITE destroys the old
+    bytes and is not invertible. Lives next to the encoder so a format
+    change cannot drift past it unnoticed."""
+    try:
+        cmd, _total = struct.unpack_from("<BQ", delta, 0)
+        if cmd != CMD_TOTAL_SIZE:
+            return False
+        pos = struct.calcsize("<BQ")
+        if delta[pos] == CMD_ZLIB_COMMANDS:
+            _, comp_len = struct.unpack_from("<BQ", delta, pos)
+            off = pos + struct.calcsize("<BQ")
+            body = zlib.decompress(delta[off:off + comp_len])
+        else:
+            body = delta[pos:]
+        pos = 0
+        while True:
+            cmd = body[pos]
+            if cmd == CMD_END:
+                return True
+            if cmd != CMD_DELTA_XOR:
+                return False
+            _, _off, length = struct.unpack_from("<BQQ", body, pos)
+            pos += struct.calcsize("<BQQ") + length
+    except (IndexError, struct.error, zlib.error):
+        return False
+
+
 def apply_delta(delta: bytes, old: "bytes | np.ndarray",
                 out: "np.ndarray | None" = None) -> np.ndarray:
     """Reconstruct new from old + delta, returning a uint8 array.

@@ -126,6 +126,45 @@ class DistExecutor(Executor):
         fresh remote send/recv flow pairs across the worker processes."""
         return self._allreduce_workload(msg, 7520, 1 << 20, rounds=3)
 
+    def fn_mpi_perf(self, msg, req):
+        """Performance-introspection workload (ISSUE 12): several
+        bulk-sized allreduce rounds on a dedicated world, with ONE
+        planted straggler — the rank named by MPI_PERF_SLOW_RANK sleeps
+        before entering each collective, so every other rank waits on it
+        while only ITS entry stamp reads late. Combined with a planted
+        transport.bulk delay fault on one worker (the slow link), this
+        is the doctor's dist acceptance scenario."""
+        import time as _time
+
+        from faabric_tpu.mpi import MpiOp, get_mpi_context
+
+        slow_rank = int(os.environ.get("MPI_PERF_SLOW_RANK", "-1"))
+        slow_s = float(os.environ.get("MPI_PERF_SLOW_S", "0.08"))
+        rounds = int(os.environ.get("MPI_PERF_ROUNDS", "8"))
+        nbytes = int(os.environ.get("MPI_PERF_NBYTES", str(16 << 20)))
+        ctx = get_mpi_context()
+        if msg.mpi_rank == 0 and not msg.is_mpi:
+            msg.is_mpi = True
+            msg.mpi_world_id = 7600
+            msg.mpi_world_size = 8
+            world = ctx.create_world(msg)
+        else:
+            world = ctx.join_world(msg)
+        rank = msg.mpi_rank
+        world.refresh_rank_hosts()
+        n = nbytes // 4
+        out = None
+        for _ in range(rounds):
+            if rank == slow_rank:
+                _time.sleep(slow_s)
+            out = world.allreduce(rank, np.full(n, rank + 1, np.int32),
+                                  MpiOp.SUM)
+        world.barrier(rank)
+        expected = world.size * (world.size + 1) // 2
+        ok = bool((out == expected).all())
+        msg.output_data = f"r{rank}:{'ok' if ok else int(out[0])}".encode()
+        return int(ReturnValue.SUCCESS if ok else ReturnValue.FAILED)
+
     def fn_mpi_matrix(self, msg, req):
         """Comm-matrix acceptance workload: a 12 MiB-per-rank allreduce
         on its own world id so /commmatrix sees fresh bulk-plane bytes

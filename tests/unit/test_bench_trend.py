@@ -1,0 +1,62 @@
+"""tools/bench_trend.py (ISSUE 12 satellite): per-key trajectory math
+over the COMMITTED bench history plus synthetic direction/status
+pins."""
+
+import os
+import sys
+
+
+def _tools():
+    import importlib
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools"))
+    return importlib.import_module("bench_trend")
+
+
+def test_collect_reads_committed_history():
+    bt = _tools()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    series = bt.collect(repo)
+    assert series, "no committed BENCH_r*.json rounds found"
+    # The headline latency rides as `value` in every committed round
+    assert "value" in series
+    rounds = [r for r, _v in series["value"]]
+    assert rounds == sorted(rounds), "rounds must be oldest → newest"
+    rows = bt.trend_rows(series)
+    by_key = {r["key"]: r for r in rows}
+    # The container-drift-exempt keys never report as regressions
+    assert by_key["value"]["status"] == "exempt"
+    # Rendering never raises on real data and marks gated keys
+    out = bt.render(rows)
+    assert "status" in out and "*" in out
+
+
+def test_trend_rows_directions_and_statuses():
+    bt = _tools()
+    rows = bt.trend_rows({
+        # higher-better key that collapsed >20%: REGRESSED (gated)
+        "host_sendrecv_gibs": [("r01", 1.0), ("r02", 0.5)],
+        # higher-better ungated key, mild drift
+        "allreduce_bus_gibs": [("r01", 10.0), ("r02", 9.0)],
+        # lower-better key that IMPROVED: still OK (best == latest)
+        "step_ms": [("r01", 40.0), ("r02", 30.0)],
+        # lower-better key that got worse by 50%
+        "journal_append_ns": [("r01", 100.0), ("r02", 150.0)],
+        # single round: new
+        "perf_feed_ns": [("r02", 900.0)],
+    })
+    by_key = {r["key"]: r for r in rows}
+    assert by_key["host_sendrecv_gibs"]["status"] == "REGRESSED"
+    assert by_key["host_sendrecv_gibs"]["gated"] is True
+    assert by_key["host_sendrecv_gibs"]["off_best_pct"] == 50.0
+    assert by_key["allreduce_bus_gibs"]["status"] == "drift"
+    assert by_key["step_ms"]["status"] == "OK"
+    assert by_key["step_ms"]["best"] == 30.0
+    assert by_key["step_ms"]["direction"] == "down"
+    assert by_key["journal_append_ns"]["status"] == "regressed"
+    assert by_key["perf_feed_ns"]["status"] == "new"
+    # Gated keys sort first so the gate-relevant drift leads the table
+    assert rows[0]["gated"] is True

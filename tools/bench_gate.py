@@ -63,6 +63,10 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # container (loopback outruns memcpy — no wire to win back); the wire
 # ratios are the codec-controlled quantity but are workload-shaped, so
 # all ride as reported-only context rather than hard gates.
+# ISSUE 12 perf-introspection keys (first recorded round): the profile
+# feed's per-sample cost, its metrics-off no-op floor, and the doctor's
+# synthetic-cluster end-to-end runtime — reported until a round of
+# spread exists, then promote like the ISSUE 9/10 keys were.
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "host_allreduce_device_gibs",
                  "allreduce_quant_max_abs_err",
@@ -71,7 +75,9 @@ REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "allreduce_governed_speedup",
                  "allreduce_coded_wire_speedup",
                  "delta_stream_raw_gibs", "delta_stream_speedup",
-                 "delta_stream_wire_speedup")
+                 "delta_stream_wire_speedup",
+                 "perf_feed_ns", "perf_feed_noop_ns",
+                 "doctor_selftest_ms")
 
 # Round-5 container drift (see ROADMAP "Recent"): ptp dispatch p50 (the
 # headline "value") and delta_apply_reuse_ms read worse in ANY tree on

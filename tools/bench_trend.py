@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Per-key perf trajectory across the committed bench rounds.
+
+    python tools/bench_trend.py [--repo DIR] [--json] [--key SUBSTR]
+
+Renders the ``BENCH_r*.json`` history (oldest → newest) as one table
+per metric key: first/best/latest value, the latest-vs-best delta in
+the key's OWN direction (``_gibs``/``_per_s`` up is good, ``_ms``/
+``_ns``/``_s`` down is good — bench_gate.py's classifier), and a
+status column that highlights gated-key regressions — so perf drift
+across rounds is visible at a glance instead of by hand-diffing JSON.
+
+Status legend: ``OK`` latest within 5% of best, ``drift`` 5–20% off
+best, ``REGRESSED`` >20% off best (upper-cased when the key is in
+bench_gate's REQUIRED set — the ones that fail the gate), ``exempt``
+for the recorded container-drift keys, ``new`` for single-round keys.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_gate import (  # noqa: E402
+    CONTAINER_DRIFT_EXEMPT,
+    REQUIRED_KEYS,
+    direction,
+    find_rounds,
+    load_metrics,
+)
+
+DRIFT_AT = 0.05
+REGRESS_AT = 0.20
+
+
+def collect(repo: str) -> dict[str, list[tuple[str, float]]]:
+    """key → [(round name, value)] oldest → newest, over every
+    committed round."""
+    series: dict[str, list[tuple[str, float]]] = {}
+    for path in find_rounds(repo):
+        name = os.path.basename(path).replace("BENCH_", "").replace(
+            ".json", "")
+        for key, value in load_metrics(path).items():
+            series.setdefault(key, []).append((name, value))
+    return series
+
+
+def trend_rows(series: dict[str, list[tuple[str, float]]]) -> list[dict]:
+    rows = []
+    for key, points in sorted(series.items()):
+        values = [v for _r, v in points]
+        sign = direction(key)
+        best = max(values) if sign > 0 else min(values)
+        best_round = points[values.index(best)][0]
+        latest_round, latest = points[-1]
+        first_round, first = points[0]
+        if best != 0:
+            # Positive = latest is WORSE than best, in the key's own
+            # direction (a regression regardless of which way is up)
+            off_best = (best - latest) / abs(best) * sign
+        else:
+            off_best = 0.0
+        if key in CONTAINER_DRIFT_EXEMPT:
+            status = "exempt"
+        elif len(points) < 2:
+            status = "new"
+        elif off_best <= DRIFT_AT:
+            status = "OK"
+        elif off_best <= REGRESS_AT:
+            status = "drift"
+        else:
+            status = ("REGRESSED" if key in REQUIRED_KEYS
+                      else "regressed")
+        rows.append({
+            "key": key,
+            "direction": "up" if sign > 0 else "down",
+            "rounds": len(points),
+            "first": first, "first_round": first_round,
+            "best": best, "best_round": best_round,
+            "latest": latest, "latest_round": latest_round,
+            "off_best_pct": round(off_best * 100.0, 1),
+            "gated": key in REQUIRED_KEYS,
+            "status": status,
+        })
+    # Worst offenders first within gated, then the rest by drift
+    rows.sort(key=lambda r: (not r["gated"], -r["off_best_pct"]))
+    return rows
+
+
+def _fmt(v: float) -> str:
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    if abs(v) >= 10:
+        return f"{v:.1f}"
+    return f"{v:.3f}"
+
+
+def render(rows: list[dict]) -> str:
+    if not rows:
+        return "bench_trend: no BENCH_r*.json rounds found"
+    lines = [f"{'key':<34} {'dir':<4} {'n':>2} {'best':>10} {'@':>4} "
+             f"{'latest':>10} {'Δbest':>7}  status",
+             "-" * 86]
+    for r in rows:
+        mark = "*" if r["gated"] else " "
+        lines.append(
+            f"{mark}{r['key']:<33} {r['direction']:<4} {r['rounds']:>2} "
+            f"{_fmt(r['best']):>10} {r['best_round'][-3:]:>4} "
+            f"{_fmt(r['latest']):>10} {r['off_best_pct']:>6.1f}%  "
+            f"{r['status']}")
+    lines.append("-" * 86)
+    lines.append("* = hard-gated key (bench_gate REQUIRED); Δbest is "
+                 "how far the latest round sits off the best recorded "
+                 "round, in the key's own direction")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-key perf trajectory over BENCH_r*.json history")
+    parser.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--key", help="only keys containing SUBSTR")
+    args = parser.parse_args(argv)
+
+    series = collect(args.repo)
+    if args.key:
+        series = {k: v for k, v in series.items() if args.key in k}
+    rows = trend_rows(series)
+    if args.json:
+        print(json.dumps({"rows": rows}, indent=1))
+    else:
+        print(render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,11 @@ fi
 echo "== concheck (guarded-by lint + protocol drift) =="
 if ! python tools/concheck.py; then rc=1; fi
 
+echo "== doctor selftest (perf introspection smoke) =="
+if ! JAX_PLATFORMS=cpu python -m faabric_tpu.runner.doctor --selftest; then
+    rc=1
+fi
+
 if [ "${1:-}" = "--with-tests" ]; then
     echo "== tier-1 suite =="
     rm -f /tmp/_t1.log
