@@ -1025,6 +1025,170 @@ def bench_host_allreduce_hier(n_hosts: int = 4, ranks_per_host: int = 2,
         clear_host_aliases()
 
 
+def _alltoall_modes(world, my_ranks, block_elems, rounds):
+    """Run the alltoall workload once per mode — naive all-pairs vs the
+    compiled ``alltoall.hier`` schedule (ISSUE 13) — barrier-fenced so
+    every process flips ``sched_enabled`` at a quiesced point. Returns
+    (per-mode elapsed, per-mode comm-matrix (bytes, messages) deltas
+    for THIS process, ok)."""
+    import numpy as np
+
+    from faabric_tpu.telemetry import get_comm_matrix
+
+    n = world.size
+
+    def cm_wire():
+        cells = (get_comm_matrix().snapshot() or {}).get("cells", [])
+        b = sum(c["bytes"] for c in cells
+                if c["plane"] in ("shm", "bulk-tcp"))
+        m = sum(c["messages"] for c in cells
+                if c["plane"] in ("shm", "bulk-tcp"))
+        return b, m
+
+    datas = {r: (np.arange(n * block_elems, dtype=np.int64)
+                 + (r + 1) * 10_000_000) for r in my_ranks}
+    elapsed, cross, oks = {}, {}, []
+    # "force": the simulated hosts all resolve to loopback, and plain
+    # "on" selects the flat schedule for fast/local links
+    for mode, sched in (("naive", False), ("sched", "force")):
+        world.sched_enabled = sched
+        results = {}
+
+        def rank_fn(rank):
+            world.barrier(rank)
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(rounds):
+                out = world.alltoall(rank, datas[rank])
+            world.barrier(rank)
+            results[rank] = (time.perf_counter() - t0, out)
+
+        b0, m0 = cm_wire()
+        threads = [threading.Thread(target=rank_fn, args=(r,))
+                   for r in my_ranks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        b1, m1 = cm_wire()
+        cross[mode] = (b1 - b0, m1 - m0)
+        elapsed[mode] = max(v[0] for v in results.values())
+        # Spot-check: rank r's output block from src s starts at s's
+        # base + r·block offset — out[0] comes from rank 0 (cross-host
+        # for most ranks), out[r·block] from r itself
+        oks.append(all(
+            int(v[1][0]) == 10_000_000 + rank * block_elems
+            and int(v[1][rank * block_elems])
+            == (rank + 1) * 10_000_000 + rank * block_elems
+            for rank, v in results.items()))
+    return elapsed, cross, all(oks)
+
+
+def _alltoall_worker_main(host_idx: int, n_hosts: int,
+                          ranks_per_host: int, block_elems: int,
+                          rounds: int) -> None:
+    """Child body: one simulated host's ranks (aliases via env)."""
+    broker, server, world, my_ranks = _hier_bench_world(
+        host_idx, n_hosts, ranks_per_host, app_id=13)
+    print("READY", flush=True)
+    try:
+        _, cross, ok = _alltoall_modes(world, my_ranks, block_elems,
+                                       rounds)
+        print(f"WIRE {cross['naive'][0]} {cross['naive'][1]} "
+              f"{cross['sched'][0]} {cross['sched'][1]}", flush=True)
+        print("DONE" if ok else "FAILED bad-alltoall-value", flush=True)
+    except Exception as e:  # noqa: BLE001 — reported to parent
+        print(f"FAILED {e!r}"[:160], flush=True)
+    finally:
+        server.stop()
+        broker.clear()
+
+
+def bench_host_alltoall(n_hosts: int = 4, ranks_per_host: int = 3,
+                        block_elems: int = 150_000,
+                        rounds: int = 2) -> dict:
+    """ISSUE 13 acceptance bench: schedule-compiled alltoall over
+    ``n_hosts`` simulated hosts (one OS process each) with the
+    topology-blind interleaved placement. Reports the compiled and
+    naive rates plus the comm-matrix cross-host accounting. Model:
+    alltoall is a permutation, so cross-host BYTES are invariant
+    (ratio ≈ 1.0 — the parity is the accounting correctness signal);
+    the composition cuts cross-host MESSAGES to H·(H−1) vs naive's
+    N·(N−m) ≈ 1/ranks-per-host², the per-message cost the schedule
+    selector's slow-link verdict targets."""
+    import subprocess
+
+    from faabric_tpu.transport.common import (
+        clear_host_aliases,
+        register_host_alias,
+    )
+
+    base = random.randint(10, 50) * 100 + 61
+    clear_host_aliases()
+    aliases = []
+    for i in range(n_hosts):
+        register_host_alias(f"xhier{i}", "127.0.0.1", base + i * 5000)
+        aliases.append(f"xhier{i}=127.0.0.1+{base + i * 5000}")
+    env = {**os.environ, "FAABRIC_HOST_ALIASES": ",".join(aliases)}
+
+    broker, server, world, my_ranks = _hier_bench_world(
+        0, n_hosts, ranks_per_host, app_id=13)
+    children = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--alltoall-worker",
+         str(i), str(n_hosts), str(ranks_per_host), str(block_elems),
+         str(rounds)],
+        stdout=subprocess.PIPE, text=True, env=env)
+        for i in range(1, n_hosts)]
+    try:
+        for c in children:
+            line = c.stdout.readline().strip()
+            assert line == "READY", f"alltoall worker said {line!r}"
+        elapsed, cross, ok = _alltoall_modes(world, my_ranks,
+                                             block_elems, rounds)
+        assert ok, "parent ranks saw a bad alltoall value"
+        naive_bytes, naive_msgs = cross["naive"]
+        sched_bytes, sched_msgs = cross["sched"]
+        for c in children:
+            wline = c.stdout.readline().split()
+            assert wline and wline[0] == "WIRE", wline
+            naive_bytes += int(wline[1])
+            naive_msgs += int(wline[2])
+            sched_bytes += int(wline[3])
+            sched_msgs += int(wline[4])
+            status = c.stdout.readline().strip()
+            assert status == "DONE", f"alltoall worker said {status!r}"
+
+        n = n_hosts * ranks_per_host
+        payload_bytes = n * block_elems * 8  # per-rank payload
+        moved = n * payload_bytes * rounds
+        return {
+            "effective_gibs": moved / elapsed["sched"] / (1 << 30),
+            "naive_effective_gibs": moved / elapsed["naive"] / (1 << 30),
+            "np": n, "n_hosts": n_hosts,
+            "ranks_per_host": ranks_per_host,
+            "payload_mib": payload_bytes / (1 << 20), "rounds": rounds,
+            "placement": "interleaved",
+            "cross_host": {
+                "naive_bytes": naive_bytes, "sched_bytes": sched_bytes,
+                "bytes_ratio": round(sched_bytes / naive_bytes, 4)
+                if naive_bytes else None,
+                "naive_msgs": naive_msgs, "sched_msgs": sched_msgs,
+                "msgs_ratio": round(sched_msgs / naive_msgs, 4)
+                if naive_msgs else None,
+                "model_msgs_ratio": round(1 / ranks_per_host ** 2, 4),
+            },
+        }
+    finally:
+        server.stop()
+        broker.clear()
+        for c in children:
+            try:
+                c.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                c.kill()
+        clear_host_aliases()
+
+
 def _device_plane_worker_main(elems: int, rounds: int) -> None:
     """Child body (ISSUE 10 bench): ONE process, 4 rank threads × 4
     virtual CPU devices. The same payload runs through the host flat
@@ -3133,6 +3297,9 @@ def main() -> None:
                      # reads a meaningless ~1.0
                      elems=2_500_000 if quick else 6_000_000,
                      rounds=1 if quick else 2))
+    host_section("host_alltoall", lambda: bench_host_alltoall(
+        block_elems=60_000 if quick else 150_000,
+        rounds=1 if quick else 2))
     host_section("host_allreduce_device",
                  lambda: bench_host_allreduce_device(
                      elems=1_500_000 if quick else 6_000_000,
@@ -3231,6 +3398,20 @@ def main() -> None:
     if (hr.get("quant") or {}).get("max_abs_err") is not None:
         summary["allreduce_quant_max_abs_err"] = round(
             hr["quant"]["max_abs_err"], 4)
+    # ISSUE 13 schedule-compiler keys (REPORTED_ONLY this first round,
+    # per the PR 9/10 promotion precedent): the compiled alltoall rate
+    # over 4 simulated hosts, the cross-host BYTE parity ratio (model
+    # ≈ 1.0 — alltoall is a permutation; parity proves the accounting)
+    # and the cross-host MESSAGE collapse (model ≈ 1/ranks-per-host²)
+    a2a = extras.get("host_alltoall") or {}
+    if a2a.get("effective_gibs"):
+        summary["host_alltoall_gibs"] = round(a2a["effective_gibs"], 2)
+    if (a2a.get("cross_host") or {}).get("bytes_ratio") is not None:
+        summary["alltoall_cross_host_bytes_ratio"] = \
+            a2a["cross_host"]["bytes_ratio"]
+    if (a2a.get("cross_host") or {}).get("msgs_ratio") is not None:
+        summary["alltoall_cross_host_msgs_ratio"] = \
+            a2a["cross_host"]["msgs_ratio"]
     # ISSUE 10 device collective plane (REPORTED_ONLY first round): the
     # compiled-mesh allreduce rate on the CPU backend, vs the host flat
     # ring on the identical payload/process shape
@@ -3306,6 +3487,10 @@ if __name__ == "__main__":
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         i = sys.argv.index("--hier-worker")
         _hier_worker_main(*(int(a) for a in sys.argv[i + 1:i + 6]))
+    elif "--alltoall-worker" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        i = sys.argv.index("--alltoall-worker")
+        _alltoall_worker_main(*(int(a) for a in sys.argv[i + 1:i + 6]))
     elif "--device-plane-worker" in sys.argv:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         i = sys.argv.index("--device-plane-worker")

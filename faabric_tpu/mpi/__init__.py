@@ -10,6 +10,13 @@ from faabric_tpu.mpi.types import (
     mpi_dtype_for,
     np_dtype_for,
 )
+from faabric_tpu.mpi.schedule import (
+    Schedule,
+    ScheduleCache,
+    ScheduleError,
+    ScheduleVerificationError,
+    verify_schedule,
+)
 from faabric_tpu.mpi.topology import Topology
 from faabric_tpu.mpi.window import MpiWindow
 from faabric_tpu.mpi.world import MAIN_RANK, MpiWorld, MpiWorldAborted
@@ -26,7 +33,12 @@ __all__ = [
     "MpiWorld",
     "MpiWorldAborted",
     "MpiWorldRegistry",
+    "Schedule",
+    "ScheduleCache",
+    "ScheduleError",
+    "ScheduleVerificationError",
     "Topology",
+    "verify_schedule",
     "UserOp",
     "apply_op",
     "get_mpi_context",

@@ -103,6 +103,21 @@ _hier_env = os.environ.get("FAABRIC_HIER_COLLECTIVES", "1").lower()
 HIER_COLLECTIVES = ("force" if _hier_env == "force"
                     else _hier_env not in ("0", "false", "off"))
 
+# Collective schedule compiler (ISSUE 13, mpi/schedule.py): lower
+# alltoall/scatter/scatterv/scan into verified step programs executed
+# by the generic runner, selected per topology + measured link
+# bandwidth. Values: on (default), off (the seed-era hand-written
+# paths; the A/B baseline), "force" (compose hierarchically even when
+# every host resolves to this machine — the simulated-host dist
+# tests/benches). Like FAABRIC_HIER_COLLECTIVES it must agree across
+# every process of a world: a desynced schedule choice mismatches the
+# message pattern and hangs the collective. The world-level attribute
+# ``sched_enabled`` overrides per world (tests set it identically on
+# all sides).
+_sched_env = os.environ.get("FAABRIC_SCHED_COLLECTIVES", "1").lower()
+SCHED_COLLECTIVES = ("force" if _sched_env == "force"
+                     else _sched_env not in ("0", "false", "off"))
+
 # Device collective plane (ISSUE 10, faabric_tpu/device_plane/): the
 # rung ABOVE the whole host ladder. Routing is opt-in per world — a
 # world only has the rung after every rank ran the
@@ -250,6 +265,7 @@ class MpiWorld:
         "_msg_type_count": "_lock",
         "_device_collectives": "_lock",
         "_device_plane": "_lock",
+        "_sched_seen": "_lock",
     }
 
     def __init__(self, broker, world_id: int, size: int, group_id: int,
@@ -282,6 +298,25 @@ class MpiWorld:
         # World-level override of FAABRIC_ALLREDUCE_QUANT — like
         # hier_enabled it must agree across every process of the world
         self.allreduce_quant = ALLREDUCE_QUANT
+
+        # Collective schedule compiler (ISSUE 13): the per-world
+        # verified-schedule cache (keys carry the topology generation,
+        # so migration remaps invalidate naturally) and the per-RANK
+        # selection-round ledger — a rank joins the world-wide
+        # selection broadcast exactly when ITS call sequence first
+        # meets a key, which is identical on every rank because every
+        # rank executes the same collective sequence (see
+        # _sched_family). sched_reductions opts the hierarchical
+        # reduction LOWERINGS in (with sched_enabled == "force"): the
+        # hand-written zero-copy paths stay the tuned default
+        # executors; the lowerings exist to prove IR coverage and are
+        # bitwise-pinned against them in tests.
+        from faabric_tpu.mpi.schedule import ScheduleCache
+
+        self.sched_enabled = SCHED_COLLECTIVES
+        self.sched_reductions = False
+        self._sched_cache = ScheduleCache()
+        self._sched_seen: dict[int, set] = {}
 
         # Exec-graph accounting (MpiWorld.h:13-18)
         self._msg_count_to_rank: dict[int, int] = {}
@@ -820,6 +855,168 @@ class MpiWorld:
             _time.sleep(0.0005)
 
     # ------------------------------------------------------------------
+    # Collective schedule compiler (ISSUE 13, mpi/schedule.py)
+    # ------------------------------------------------------------------
+    def _sched_key(self, collective: str, op=None, dtype=None,
+                   nbytes=None, root: int = 0) -> tuple:
+        """Cache key: (topology-generation, collective, root, op-class,
+        dtype-class, size-class) — the device-plane executable-cache
+        discipline. Every component is identical on every rank of a
+        call (MPI requires matching payload shapes; scatterv receivers,
+        which know nothing of the payload, key class-less), so
+        per-process caches stay in lockstep and migration remaps
+        (generation bumps) invalidate world-wide."""
+        from faabric_tpu.telemetry.perfprofile import size_class
+
+        self.topology()  # ensure the generation matches a built topology
+        with self._lock:
+            gen = self._topology_gen
+        opc = ("-" if op is None
+               else "u" if isinstance(op, UserOp) else f"b{int(op)}")
+        dtc = "-" if dtype is None else np.dtype(dtype).str
+        szc = "-" if nbytes is None else size_class(int(nbytes))
+        return (gen, collective, root, opc, dtc, szc)
+
+    def _sched_family(self, rank: int, key: tuple, collective: str,
+                      nbytes: int | None) -> str:
+        """World-agreed schedule family for ``key``. Selection reads
+        THIS process's perf-profile store — which measures different
+        links on every process — so the verdict is computed on rank 0
+        only and distributed by a one-shot broadcast (the selection
+        sync round); a locally-derived choice could desync the world's
+        message pattern and hang the collective. A rank joins the round
+        exactly when its OWN call sequence first meets ``key`` — that
+        predicate is identical on every rank (same call sequence, same
+        keys), unlike the process-shared cache a sibling rank thread
+        may already have filled."""
+        from faabric_tpu.mpi.schedule_compile import (
+            FAMILIES,
+            FAMILY_IDS,
+            choose_family,
+        )
+
+        with self._lock:
+            seen = self._sched_seen.setdefault(rank, set())
+            need_round = key not in seen
+        if not need_round:
+            fam = self._sched_cache.family_of(key)
+            assert fam is not None, f"selection ran but {key} uncached"
+            return fam
+        if rank == MAIN_RANK:
+            fam = self._sched_cache.family_of(key)
+            if fam is None:
+                fam = choose_family(collective, self.topology(),
+                                    nbytes or 0, self.sched_enabled)
+            self._broadcast_impl(
+                MAIN_RANK, rank,
+                np.array([FAMILY_IDS[fam]], dtype=np.int64))
+        else:
+            arr = self._broadcast_impl(MAIN_RANK, rank,
+                                       np.empty(1, dtype=np.int64))
+            fam = FAMILIES[int(arr.reshape(-1)[0])]
+        # Ledger write BEFORE the seen-mark: a rank that will skip all
+        # future rounds for this key must always be able to recover
+        # the agreed verdict, even across schedule-entry eviction or a
+        # compile failure after this point
+        self._sched_cache.note_family(key, fam)
+        with self._lock:
+            seen = self._sched_seen[rank]
+            # Generations are monotonic, so keys of other generations
+            # can never be looked up again — shed them here or a
+            # migration-churned long-lived world leaks one seen-set
+            # entry per (rank, key, generation) forever
+            stale = {k for k in seen if k[0] != key[0]}
+            if stale:
+                seen -= stale
+            seen.add(key)
+        return fam
+
+    def _sched_get(self, rank: int, collective: str, op=None, dtype=None,
+                   nbytes=None, root: int = 0):
+        """(schedule, family) for one collective call: selection sync on
+        first encounter, then compile-verify-cache once per process.
+        Every schedule handed out is verified — get_or_compile runs the
+        verifier before caching and the runner refuses unverified
+        schedules, so nothing executes uncached or unverified."""
+        from faabric_tpu.mpi.schedule_compile import compile_schedule
+
+        key = self._sched_key(collective, op=op, dtype=dtype,
+                              nbytes=nbytes, root=root)
+        family = self._sched_family(rank, key, collective, nbytes)
+        topo = self.topology()
+        sched = self._sched_cache.get_or_compile(
+            key, family,
+            lambda: compile_schedule(family, collective, topo, root=root))
+        return sched, family
+
+    @staticmethod
+    def _sched_phase_groups(steps):
+        groups: list[tuple[str, list]] = []
+        for st in steps:
+            if not groups or groups[-1][0] != st.phase:
+                groups.append((st.phase, []))
+            groups[-1][1].append(st)
+        return groups
+
+    def _run_schedule(self, rank: int, sched, env: dict, op,
+                      resolver, msg_type: MpiMessageType) -> dict:
+        """The generic schedule runner: execute ``rank``'s step program
+        over ``env`` (block key → flat ndarray). Sends concatenate
+        blocks into one message; recvs split by ``resolver``-bound
+        sizes (single-block recvs discover their size from the wire);
+        folds apply ``op`` in the schedule's operand order; copies are
+        reference moves (assembly copies where ownership demands).
+        Per-phase spans ride ``mpi.phase`` like the hand-written
+        hierarchical paths, so /perf's critical path decomposes
+        schedule rounds the same way."""
+        from faabric_tpu.mpi.schedule import (
+            COPY,
+            FOLD,
+            RECV,
+            SEND,
+            ScheduleError,
+        )
+
+        if not sched.verified:
+            raise ScheduleError(
+                f"refusing to execute unverified schedule {sched.name}")
+        steps = sched.steps.get(rank, ())
+        traced = tracing_enabled()
+        for phase, group in self._sched_phase_groups(steps):
+            with span("mpi.phase", phase or "run", rank=rank) \
+                    if traced else NULL_SPAN:
+                for st in group:
+                    if st.op == SEND:
+                        bufs = [np.asarray(env[k]).reshape(-1)
+                                for k in st.keys]
+                        payload = (bufs[0] if len(bufs) == 1
+                                   else np.concatenate(bufs))
+                        self.send(rank, st.peer, payload, msg_type)
+                    elif st.op == RECV:
+                        arr, _ = self._recv_raw(st.peer, rank)
+                        arr = arr.reshape(-1)
+                        if len(st.keys) == 1:
+                            env[st.keys[0]] = arr
+                            continue
+                        pos = 0
+                        for k, sym in zip(st.keys, st.syms):
+                            count = int(resolver(sym, env))
+                            env[k] = arr[pos:pos + count]
+                            pos += count
+                        if pos != arr.size:
+                            raise ScheduleError(
+                                f"{sched.name}: rank {rank} recv from "
+                                f"{st.peer} split {pos} of {arr.size} "
+                                f"elements (framing desync)")
+                    elif st.op == FOLD:
+                        env[st.dst] = np.asarray(
+                            apply_op(op, env[st.a], env[st.b])
+                        ).reshape(-1)
+                    elif st.op == COPY:
+                        env[st.dst] = np.asarray(env[st.src]).reshape(-1)
+        return env
+
+    # ------------------------------------------------------------------
     # Collectives — locality-aware leader trees on the host path
     # ------------------------------------------------------------------
     def barrier(self, rank: int) -> None:
@@ -1162,6 +1359,8 @@ class MpiWorld:
             out = self._try_device("allreduce", dplane, rank, arr, op)
             if out is not None:
                 return out
+        if self._sched_reduction_eligible(op):
+            return self._reduction_sched(rank, "allreduce", arr, op)
         use_hier = self._hier_eligible(arr, op)
         use_ring = (not use_hier and arr.size >= self.size
                     and self._ring_eligible(arr, op))
@@ -1184,6 +1383,70 @@ class MpiWorld:
                 return self._broadcast_impl(
                     MAIN_RANK, rank,
                     reduced if rank == MAIN_RANK else arr)
+
+    def _sched_reduction_eligible(self, op=None) -> bool:
+        """Whether the hierarchical reduction LOWERINGS execute instead
+        of the hand-written paths: explicit double opt-in (knob "force"
+        + world.sched_reductions, set identically on every process) —
+        they exist to prove the IR covers the tuned paths and are
+        bitwise-pinned against them; the zero-copy hand-written rings
+        remain the throughput defaults."""
+        if self.sched_enabled != "force" or not self.sched_reductions:
+            return False
+        if op is not None and isinstance(op, UserOp) and not op.commute:
+            return False
+        return self.size > 1 and self.topology().n_hosts > 1
+
+    def _reduction_sched(self, rank: int, collective: str,
+                         data: np.ndarray, op: MpiOp) -> np.ndarray:
+        """Run allreduce / reduce_scatter / allgather as its verified
+        schedule lowering (mpi/schedule_compile.py): intra-host fold or
+        gather to the leader, leader ring / pairwise host-block
+        exchange, in-process redistribute — the schedule twin of the
+        hand-written hierarchical paths."""
+        flat = np.asarray(data).reshape(-1)
+        op_arg = None if collective == "allgather" else op
+        sched, family = self._sched_get(
+            rank, collective, op=op_arg, dtype=flat.dtype,
+            nbytes=int(flat.nbytes))
+        _count_collective(collective, int(flat.nbytes))
+        with span("mpi", collective, rank=rank, size=self.size,
+                  bytes=int(flat.nbytes),
+                  algo="sched:" + family.split(".", 1)[1]):
+            env: dict = {}
+            if collective == "allreduce":
+                segs = self._ring_segments(flat.size,
+                                           sched.spec["segments"])
+                for s, (lo, hi) in enumerate(segs):
+                    env[("in", s)] = flat[lo:hi]
+
+                def resolver(sym, e, _segs=segs):
+                    return _segs[sym[1]][1] - _segs[sym[1]][0]
+
+                self._run_schedule(rank, sched, env, op, resolver,
+                                   MpiMessageType.ALLREDUCE)
+                out = np.empty(flat.size, dtype=flat.dtype)
+                for s, (lo, hi) in enumerate(segs):
+                    out[lo:hi] = env[("out", s)]
+                return out.reshape(np.asarray(data).shape)
+            if collective == "reduce_scatter":
+                k = flat.size // self.size
+                for j in range(self.size):
+                    env[("in", j)] = flat[j * k:(j + 1) * k]
+                self._run_schedule(rank, sched, env, op,
+                                   lambda sym, e: k,
+                                   MpiMessageType.REDUCE)
+                return np.array(env[("out", 0)])
+            # allgather: contribution is the whole payload, k per rank
+            k = flat.size
+            env[("in", 0)] = flat
+            self._run_schedule(rank, sched, env, None,
+                               lambda sym, e: k,
+                               MpiMessageType.ALLGATHER)
+            out = np.empty(self.size * k, dtype=flat.dtype)
+            for q in range(self.size):
+                out[q * k:(q + 1) * k] = env[("out", q)]
+            return out
 
     def _ring_eligible(self, arr: np.ndarray, op) -> bool:
         """Shared ring-path predicate for allreduce/reduce_scatter: big
@@ -1615,9 +1878,57 @@ class MpiWorld:
     def scatter(self, send_rank: int, recv_rank: int, data: np.ndarray,
                 recv_count: int) -> np.ndarray:
         _count_collective("scatter", int(np.asarray(data).nbytes))
-        with span("mpi", "scatter", rank=recv_rank, root=send_rank):
+        if self.sched_enabled and self.size > 1:
+            sched, family = self._sched_get(rank=recv_rank,
+                                            collective="scatter",
+                                            root=send_rank)
+            with span("mpi", "scatter", rank=recv_rank, root=send_rank,
+                      algo="sched:" + family.split(".", 1)[1]):
+                return self._scatter_sched(send_rank, recv_rank, sched,
+                                           data, recv_count=recv_count)
+        with span("mpi", "scatter", rank=recv_rank, root=send_rank,
+                  algo="direct"):
             return self._scatter_impl(send_rank, recv_rank, data,
                                       recv_count)
+
+    def _scatter_sched(self, root: int, rank: int, sched,
+                       data, recv_count: int | None = None,
+                       counts=None) -> np.ndarray:
+        """Schedule-path scatter/scatterv: the root binds its per-rank
+        input blocks (and, for scatterv trees, the int64 count-vector
+        header the leaders split by); every other rank's blocks arrive
+        sized by the wire or the header."""
+        env: dict = {}
+        if rank == root:
+            flat = np.asarray(data).reshape(-1)
+            if counts is None:
+                chunks = flat.reshape(self.size, recv_count)
+                for j in range(self.size):
+                    env[("in", j)] = chunks[j]
+            else:
+                offsets = np.cumsum([0] + list(counts[:-1]))
+                for j in range(self.size):
+                    env[("in", j)] = flat[offsets[j]:offsets[j]
+                                          + counts[j]]
+                if sched.spec.get("counts_header"):
+                    env[("in", "cnt")] = np.asarray(counts,
+                                                    dtype=np.int64)
+
+        def resolver(sym, e):
+            if sym == ("cnt",):
+                return self.size
+            j = sym[1]
+            if counts is not None and rank == root:
+                return int(counts[j])
+            if recv_count is not None:
+                return int(recv_count)
+            return int(np.asarray(e[("tmp", "cnt")]).reshape(-1)[j])
+
+        self._run_schedule(rank, sched, env, None, resolver,
+                           MpiMessageType.SCATTER)
+        # Out blocks may alias the root's input or a shared receive
+        # buffer; the public contract is a caller-owned writable array
+        return np.array(env[("out", 0)])
 
     def _scatter_impl(self, send_rank: int, recv_rank: int,
                       data: np.ndarray, recv_count: int) -> np.ndarray:
@@ -1711,7 +2022,11 @@ class MpiWorld:
     def scatterv(self, send_rank: int, recv_rank: int,
                  data: Optional[np.ndarray],
                  counts: Optional[list[int]]) -> np.ndarray:
-        """Root splits ``data`` into per-rank pieces of ``counts`` sizes."""
+        """Root splits ``data`` into per-rank pieces of ``counts`` sizes.
+        Schedule-compiled (ISSUE 13): the tree family packs one bundle
+        per remote host behind an int64 count-vector header, so leaders
+        split without a planner round-trip; receivers stay count-blind
+        (sizes bind from the wire/header, exactly once, verified)."""
         if recv_rank == send_rank:
             flat = np.asarray(data).reshape(-1)
             if counts is None or len(counts) != self.size:
@@ -1719,6 +2034,30 @@ class MpiWorld:
             if sum(counts) != flat.size:
                 raise ValueError(
                     f"scatterv counts sum {sum(counts)} != data {flat.size}")
+        # Payload bytes enter at the root only; receivers count the
+        # invocation (the per-participating-rank convention)
+        _count_collective(
+            "scatterv",
+            int(np.asarray(data).nbytes) if recv_rank == send_rank else 0)
+        if self.sched_enabled and self.size > 1:
+            sched, family = self._sched_get(rank=recv_rank,
+                                            collective="scatterv",
+                                            root=send_rank)
+            with span("mpi", "scatterv", rank=recv_rank, root=send_rank,
+                      algo="sched:" + family.split(".", 1)[1]):
+                return self._scatter_sched(send_rank, recv_rank, sched,
+                                           data, counts=counts)
+        with span("mpi", "scatterv", rank=recv_rank, root=send_rank,
+                  algo="direct"):
+            return self._scatterv_direct(send_rank, recv_rank, data,
+                                         counts)
+
+    def _scatterv_direct(self, send_rank: int, recv_rank: int,
+                         data: Optional[np.ndarray],
+                         counts: Optional[list[int]]) -> np.ndarray:
+        """Seed-era direct sends, kept as the knob-off fallback."""
+        if recv_rank == send_rank:
+            flat = np.asarray(data).reshape(-1)
             offsets = np.cumsum([0] + list(counts[:-1]))
             for r in range(self.size):
                 if r != send_rank:
@@ -1792,6 +2131,8 @@ class MpiWorld:
                                    op)
             if out is not None:
                 return out
+        if self._sched_reduction_eligible(op):
+            return self._reduction_sched(rank, "reduce_scatter", data, op)
         # Scattered (non-gang-contiguous) placements compose too: the
         # leader ring folds over a PERMUTED span partition derived from
         # the Topology (see _reduce_scatter_hier), so the
@@ -1962,6 +2303,8 @@ class MpiWorld:
             out = self._try_device("allgather", dplane, rank, data)
             if out is not None:
                 return out
+        if self._sched_reduction_eligible() and data.size > 0:
+            return self._reduction_sched(rank, "allgather", data, None)
         # Hierarchy pays off once the OUTPUT (size × contribution) is
         # pipeline-sized; the per-rank contribution itself can be small
         use_hier = (self.hier_enabled and data.size > 0
@@ -2109,9 +2452,38 @@ class MpiWorld:
 
     def scan(self, rank: int, data: np.ndarray,
              op: MpiOp = MpiOp.SUM) -> np.ndarray:
-        """Linear chain (reference :1390-1431): rank r receives the prefix
-        from r-1, merges, forwards to r+1."""
+        """MPI_Scan. Schedule-compiled (ISSUE 13): ``scan.chain`` is the
+        reference linear chain (:1390-1431) as a verified step program
+        — bit-identical fold order (prefix, mine) — and ``scan.hier``
+        (gang-contiguous placements) runs intra-host chains + a carrier
+        chain between hosts, ≈ ranks/host + hosts serial hops instead
+        of N. Previously the one collective with neither a span nor a
+        _count_collective — the comm-matrix/profiler blind spot ISSUE
+        13's satellite closes."""
         data = np.asarray(data)
+        _count_collective("scan", int(data.nbytes))
+        if not (self.sched_enabled and self.size > 1):
+            with span("mpi", "scan", rank=rank, size=self.size,
+                      bytes=int(data.nbytes), algo="chain"):
+                return self._scan_chain(rank, data, op)
+        sched, family = self._sched_get(
+            rank, "scan", op=op, dtype=data.dtype,
+            nbytes=int(data.nbytes))
+        with span("mpi", "scan", rank=rank, size=self.size,
+                  bytes=int(data.nbytes),
+                  algo="sched:" + family.split(".", 1)[1]):
+            flat = data.reshape(-1)
+            env: dict = {("in", 0): flat}
+            self._run_schedule(rank, sched, env, op,
+                               lambda sym, e: flat.size,
+                               MpiMessageType.SCAN)
+            out = np.array(env[("out", 0)]).reshape(data.shape)
+            return out
+
+    def _scan_chain(self, rank: int, data: np.ndarray,
+                    op: MpiOp) -> np.ndarray:
+        """Seed-era linear chain, kept as the knob-off fallback: rank r
+        receives the prefix from r-1, merges, forwards to r+1."""
         if rank > 0:
             prev, _ = self.recv(rank - 1, rank)
             acc = apply_op(op, prev, data)
@@ -2122,24 +2494,59 @@ class MpiWorld:
         return acc
 
     def alltoall(self, rank: int, data: np.ndarray) -> np.ndarray:
-        """All-pairs exchange of equal chunks (reference :1433-1736 naive
-        variant): data is (size*chunk,), row r goes to rank r."""
+        """All-pairs exchange of equal chunks: data is (size*chunk,),
+        row r goes to rank r. Schedule-compiled (ISSUE 13): the runner
+        executes a verified step program — ``alltoall.hier`` packs host
+        blocks through the local leaders (the reference's
+        disabled-since-2024 locality-aware ALLTOALL_PACKED variant,
+        cutting cross-host messages to ≈1/ranks-per-host² — bytes are
+        invariant, alltoall is a permutation), ``alltoall.flat`` is the
+        naive pairwise pattern as a schedule. FAABRIC_SCHED_COLLECTIVES
+        =off keeps the seed-era hand-written loop."""
         data = np.asarray(data)
         _count_collective("alltoall", int(data.nbytes))
+        if not (self.sched_enabled and self.size > 1):
+            with span("mpi", "alltoall", rank=rank, size=self.size,
+                      bytes=int(data.nbytes), algo="direct"):
+                return self._alltoall_direct(rank, data)
+        sched, family = self._sched_get(
+            rank, "alltoall", dtype=data.dtype, nbytes=int(data.nbytes))
         with span("mpi", "alltoall", rank=rank, size=self.size,
-                  bytes=int(data.nbytes)):
-            chunk = data.size // self.size
-            rows = data.reshape(self.size, chunk)
-            for r in range(self.size):
-                if r != rank:
-                    self.send(rank, r, rows[r], MpiMessageType.ALLTOALL)
-            out = np.empty_like(rows)
-            out[rank] = rows[rank]
-            for r in range(self.size):
-                if r != rank:
-                    arr, _ = self.recv(r, rank)
-                    out[r] = arr
-            return out.reshape(-1)
+                  bytes=int(data.nbytes),
+                  algo="sched:" + family.split(".", 1)[1]):
+            return self._alltoall_sched(rank, data, sched, family)
+
+    def _alltoall_direct(self, rank: int, data: np.ndarray) -> np.ndarray:
+        """Seed-era naive all-pairs loop (reference :1433-1736), kept as
+        the knob-off fallback and the A/B baseline."""
+        chunk = data.size // self.size
+        rows = data.reshape(self.size, chunk)
+        for r in range(self.size):
+            if r != rank:
+                self.send(rank, r, rows[r], MpiMessageType.ALLTOALL)
+        out = np.empty_like(rows)
+        out[rank] = rows[rank]
+        for r in range(self.size):
+            if r != rank:
+                arr, _ = self.recv(r, rank)
+                out[r] = arr
+        return out.reshape(-1)
+
+    def _alltoall_sched(self, rank: int, data: np.ndarray, sched,
+                        family: str) -> np.ndarray:
+        flat = data.reshape(-1)
+        k = flat.size // self.size
+        rows = flat.reshape(self.size, k)
+        env: dict = {("in", j): rows[j] for j in range(self.size)}
+        msg_type = (MpiMessageType.ALLTOALL_PACKED
+                    if family == "alltoall.hier"
+                    else MpiMessageType.ALLTOALL)
+        self._run_schedule(rank, sched, env, None,
+                           lambda sym, e: k, msg_type)
+        out = np.empty(self.size * k, dtype=flat.dtype)
+        for j in range(self.size):
+            out[j * k:(j + 1) * k] = env[("out", j)]
+        return out
 
     # ------------------------------------------------------------------
     # Cartesian topology (reference :369-493 — there fixed 2-D periodic,

@@ -249,7 +249,11 @@ class _NullPerfStore:
                 codec="raw") -> None:
         pass
 
-    def link_gibs(self, dst, plane=None):
+    def link_gibs(self, dst, plane=None, min_bytes: int = 0):
+        # Signature mirrors PerfProfileStore.link_gibs exactly: the
+        # schedule selector passes min_bytes, and a metrics-off
+        # TypeError here would kill rank 0 before its selection
+        # broadcast and hang the world
         return None
 
     def snapshot(self) -> dict:

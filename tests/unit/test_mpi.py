@@ -1323,3 +1323,318 @@ def test_window_bounds_and_free_semantics(mpi_cluster):
             win.put(np.zeros(1, np.uint8), 0, 0)
 
     run_ranks(mpi_cluster, fn)
+
+
+# ---------------------------------------------------------------------------
+# Collective schedule compiler (ISSUE 13): sched-vs-legacy bitwise
+# pinning + numpy references for the neglected collectives
+# ---------------------------------------------------------------------------
+
+def _set_sched(world_for_rank, mode, reductions=False):
+    """Flip the schedule knob identically on every process's world —
+    like the hier knob, a desynced choice would mismatch message
+    patterns (the fixture's two simulated hosts live in one process, so
+    this is one loop over the distinct world objects)."""
+    for world in {id(world_for_rank(r)): world_for_rank(r)
+                  for r in range(6)}.values():
+        world.sched_enabled = mode
+        world.sched_reductions = reductions
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.float32, np.int16])
+def test_alltoall_sched_bitwise_vs_direct(mpi_cluster, dtype):
+    """The compiled leader-composed alltoall is bitwise-identical to
+    the naive path across dtypes (pure data movement: no arithmetic on
+    any path)."""
+    rng = np.random.RandomState(7)
+    mats = {r: (rng.rand(6 * 5) * 100).astype(dtype) for r in range(6)}
+    expected = {r: np.concatenate(
+        [mats[src].reshape(6, 5)[r] for src in range(6)])
+        for r in range(6)}
+
+    def fn(world, rank):
+        return world.alltoall(rank, mats[rank])
+
+    out = {}
+    for mode in (False, "force"):
+        _set_sched(mpi_cluster, mode)
+        out[mode] = run_ranks(mpi_cluster, fn)
+    _set_sched(mpi_cluster, True)
+    for rank in range(6):
+        np.testing.assert_array_equal(out[False][rank], expected[rank])
+        np.testing.assert_array_equal(out["force"][rank],
+                                      expected[rank])
+        assert out[False][rank].dtype == out["force"][rank].dtype
+
+
+def test_alltoall_sched_scattered_placement(scattered_cluster):
+    """Leader composition over a NON-contiguous placement (rank r on
+    host r % 2): host blocks pack/unpack by Topology rank lists, not
+    positional arithmetic."""
+    mats = {r: np.arange(18, dtype=np.int64) + 1000 * r
+            for r in range(6)}
+
+    def fn(world, rank):
+        world.sched_enabled = "force"
+        return world.alltoall(rank, mats[rank])
+
+    results = run_ranks(scattered_cluster, fn)
+    for rank in range(6):
+        expected = np.concatenate(
+            [mats[src].reshape(6, 3)[rank] for src in range(6)])
+        np.testing.assert_array_equal(results[rank], expected)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.int32])
+def test_alltoallv_matches_numpy_across_dtypes(mpi_cluster, dtype):
+    """alltoallv coverage (previously one test, one dtype): asymmetric
+    count matrices against a numpy reference."""
+    counts = {r: [(r + s) % 4 + 1 for s in range(6)] for r in range(6)}
+    datas = {r: (np.arange(sum(counts[r])) * 10 + r).astype(dtype)
+             for r in range(6)}
+
+    def fn(world, rank):
+        return world.alltoallv(rank, datas[rank], counts[rank])
+
+    results = run_ranks(mpi_cluster, fn)
+    for rank in range(6):
+        got, recv_counts = results[rank]
+        assert recv_counts == [counts[src][rank] for src in range(6)]
+        parts = []
+        for src in range(6):
+            off = sum(counts[src][:rank])
+            parts.append(datas[src][off:off + counts[src][rank]])
+        np.testing.assert_array_equal(got, np.concatenate(parts))
+        assert got.dtype == dtype
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.int16])
+def test_scatterv_sched_tree_bitwise_vs_direct(mpi_cluster, dtype):
+    """scatterv through the packed tree schedule (count-vector header →
+    leader splits) vs the direct legacy path, bitwise, plus a non-zero
+    root."""
+    counts = [r + 1 for r in range(6)]
+    flat = (np.arange(sum(counts)) * 3 + 1).astype(dtype)
+    root = 2
+
+    def fn(world, rank):
+        if rank == root:
+            return world.scatterv(root, rank, flat, counts)
+        return world.scatterv(root, rank, None, None)
+
+    from faabric_tpu.telemetry import get_metrics, snapshot_delta
+
+    before = get_metrics().snapshot()
+    out = {}
+    for mode in (False, "force"):
+        _set_sched(mpi_cluster, mode)
+        out[mode] = run_ranks(mpi_cluster, fn)
+    _set_sched(mpi_cluster, True)
+    # scatterv counts on BOTH paths (2 modes x 6 ranks)
+    from faabric_tpu.telemetry.metrics import metrics_enabled
+
+    if metrics_enabled():
+        delta = snapshot_delta(before, get_metrics().snapshot())
+        assert delta.get(
+            'faabric_mpi_collectives_total{op="scatterv"}') == 12
+    offsets = np.cumsum([0] + counts[:-1])
+    for rank in range(6):
+        expected = flat[offsets[rank]:offsets[rank] + counts[rank]]
+        np.testing.assert_array_equal(out[False][rank], expected)
+        np.testing.assert_array_equal(out["force"][rank], expected)
+        assert out["force"][rank].dtype == dtype
+        # Public contract: caller-owned writable result on every path
+        assert out["force"][rank].flags.writeable
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.float64])
+def test_scan_sched_matches_chain_and_numpy(mpi_cluster, dtype):
+    """scan through the schedule runner vs the legacy chain vs numpy
+    cumsum. int64 is bitwise on BOTH families; float64 is bitwise on
+    the chain family by fold-order construction and compared to the
+    legacy path's own result for the hier family (re-association)."""
+    datas = {r: (np.arange(40) % 7 + r).astype(dtype) for r in range(6)}
+    prefixes = np.cumsum(np.stack([datas[r] for r in range(6)]), axis=0)
+
+    def fn(world, rank):
+        return world.scan(rank, datas[rank], MpiOp.SUM)
+
+    out = {}
+    for mode in (False, "force"):
+        _set_sched(mpi_cluster, mode)
+        out[mode] = run_ranks(mpi_cluster, fn)
+    _set_sched(mpi_cluster, True)
+    for rank in range(6):
+        if np.issubdtype(dtype, np.integer):
+            np.testing.assert_array_equal(out["force"][rank],
+                                          prefixes[rank])
+            np.testing.assert_array_equal(out[False][rank],
+                                          prefixes[rank])
+        else:
+            np.testing.assert_allclose(out["force"][rank],
+                                       prefixes[rank], rtol=1e-12)
+
+
+def test_scan_sched_scattered_placement_uses_chain(scattered_cluster):
+    """Non-contiguous placements cannot compose the carrier chain —
+    selection must fall back to scan.chain and stay correct."""
+    datas = {r: np.arange(10, dtype=np.int64) + r for r in range(6)}
+
+    def fn(world, rank):
+        world.sched_enabled = "force"
+        out = world.scan(rank, datas[rank], MpiOp.SUM)
+        key = next(iter(world._sched_cache._entries))
+        return out, world._sched_cache.family_of(key)
+
+    results = run_ranks(scattered_cluster, fn)
+    prefixes = np.cumsum(np.stack([datas[r] for r in range(6)]), axis=0)
+    for rank in range(6):
+        out, family = results[rank]
+        assert family == "scan.chain"
+        np.testing.assert_array_equal(out, prefixes[rank])
+
+
+def test_scan_user_op_through_scheduler(mpi_cluster):
+    """Non-commutative (but associative, as MPI requires) user op — a
+    2×2 matrix product — through the schedule path: the prefix operand
+    order (prefix, mine) must be preserved by both the chain and the
+    hierarchical carrier composition."""
+    from faabric_tpu.mpi.types import UserOp
+
+    def matprod(a, b):
+        return (np.asarray(a).reshape(2, 2)
+                @ np.asarray(b).reshape(2, 2)).reshape(-1)
+
+    op = UserOp(matprod, commute=False)
+    datas = {r: np.array([1, r + 1, 0, 1], dtype=np.int64)
+             for r in range(6)}
+
+    def fn(world, rank):
+        return world.scan(rank, datas[rank], op)
+
+    _set_sched(mpi_cluster, True)
+    results = run_ranks(mpi_cluster, fn)
+    acc = datas[0]
+    expect = {0: acc.copy()}
+    for r in range(1, 6):
+        acc = matprod(acc, datas[r])
+        expect[r] = acc.copy()
+    for rank in range(6):
+        np.testing.assert_array_equal(results[rank].reshape(-1),
+                                      expect[rank])
+
+
+def test_sched_reduction_lowerings_bitwise_vs_handwritten(mpi_cluster):
+    """Acceptance pin: the allreduce / reduce_scatter / allgather
+    schedule lowerings are bitwise-identical to the hand-written
+    hierarchical paths (exact int64 payloads — float reorder tolerance
+    is a non-goal, as in the hier tests)."""
+    _force_hier(mpi_cluster, True)  # hand-written hier on small payloads
+    rng = np.random.RandomState(3)
+    n = 6 * 40_000
+    datas = {r: rng.randint(-10_000, 10_000, n).astype(np.int64)
+             for r in range(6)}
+    small = {r: datas[r][:60_000] for r in range(6)}
+
+    def fn(world, rank):
+        ar = world.allreduce(rank, datas[rank].copy(), MpiOp.SUM)
+        rs = world.reduce_scatter(rank, datas[rank].copy(), MpiOp.SUM)
+        ag = world.allgather(rank, small[rank].copy())
+        return ar, rs, ag
+
+    _set_sched(mpi_cluster, False)
+    legacy = run_ranks(mpi_cluster, fn)
+    _set_sched(mpi_cluster, "force", reductions=True)
+    sched = run_ranks(mpi_cluster, fn)
+    _set_sched(mpi_cluster, True)
+    _force_hier(mpi_cluster, False)
+
+    total = sum(datas.values())
+    k = n // 6
+    for rank in range(6):
+        for i in range(3):
+            np.testing.assert_array_equal(legacy[rank][i],
+                                          sched[rank][i])
+        np.testing.assert_array_equal(sched[rank][0], total)
+        np.testing.assert_array_equal(sched[rank][1],
+                                      total[rank * k:(rank + 1) * k])
+        np.testing.assert_array_equal(
+            sched[rank][2],
+            np.concatenate([small[q] for q in range(6)]))
+
+
+def test_sched_cache_recompiles_after_remap(mpi_cluster):
+    """Acceptance pin: migration/topology regeneration invalidates the
+    schedule cache — the generation in the key stops matching and the
+    next call re-selects and re-compiles."""
+    mats = {r: np.arange(12, dtype=np.int64) + r for r in range(6)}
+
+    def fn(world, rank):
+        return world.alltoall(rank, mats[rank])
+
+    _set_sched(mpi_cluster, "force")
+    run_ranks(mpi_cluster, fn)
+    worlds = {id(mpi_cluster(r)): mpi_cluster(r) for r in range(6)}
+    compiles_before = {wid: w._sched_cache.compiles
+                       for wid, w in worlds.items()}
+    gens_before = {wid: w._topology_gen for wid, w in worlds.items()}
+    for w in worlds.values():
+        assert w._sched_cache.compiles == 1
+
+    # Same-placement remap: the planner re-confirms mappings, the world
+    # must still treat the new generation as a fresh topology
+    for w in worlds.values():
+        w.prepare_migration(0)
+    results = run_ranks(mpi_cluster, fn)
+    _set_sched(mpi_cluster, True)
+    for rank in range(6):
+        expected = np.concatenate(
+            [mats[src].reshape(6, 2)[rank] for src in range(6)])
+        np.testing.assert_array_equal(results[rank], expected)
+    for wid, w in worlds.items():
+        assert w._topology_gen > gens_before[wid]
+        assert w._sched_cache.compiles == compiles_before[wid] + 1
+        gens = {key[0] for key in w._sched_cache._entries}
+        assert len(gens) == 2  # old + new generation entries coexist
+        # The per-rank seen-ledgers shed dead generations (regression:
+        # migration churn must not leak one entry per key forever)
+        for rank_keys in w._sched_seen.values():
+            assert all(k[0] == w._topology_gen for k in rank_keys)
+
+
+def test_scan_emits_span_and_counter(mpi_cluster):
+    """ISSUE 13 satellite: scan — previously the one collective with
+    neither a span nor a _count_collective — now reports both, so
+    comm-matrix/profiler coverage is complete."""
+    from faabric_tpu.telemetry import (
+        get_metrics,
+        reset_tracing,
+        set_tracing,
+        snapshot_delta,
+        trace_events,
+    )
+
+    before = get_metrics().snapshot()
+    set_tracing(True)
+    reset_tracing()
+    try:
+        datas = {r: np.full(1000, r + 1, np.int64) for r in range(6)}
+
+        def fn(world, rank):
+            return world.scan(rank, datas[rank], MpiOp.SUM)
+
+        run_ranks(mpi_cluster, fn)
+        events = [e for e in trace_events() if e.get("ph") == "X"]
+        scans = [e for e in events if e["cat"] == "mpi"
+                 and e["name"] == "scan"]
+        assert len(scans) == 6
+        for e in scans:
+            assert e["args"]["algo"].startswith(("sched:", "chain"))
+            assert e["args"]["bytes"] == 8000
+    finally:
+        reset_tracing()
+        set_tracing(False)
+    delta = snapshot_delta(before, get_metrics().snapshot())
+    assert delta.get('faabric_mpi_collectives_total{op="scan"}') == 6
+    assert delta.get(
+        'faabric_mpi_collective_bytes_total{op="scan"}') == 6 * 8000

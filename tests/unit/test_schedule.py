@@ -1,0 +1,428 @@
+"""Collective schedule IR / verifier / cache / selection (ISSUE 13).
+
+The verifier's acceptance-criteria pins live here: a deliberately
+corrupted schedule (missing element, double delivery, framing desync,
+deadlock, undelivered message) is rejected; nothing unverified reaches
+the cache; selection demonstrably reads the perf-profile store's
+``link_gibs`` and flips families on measured bandwidth.
+"""
+
+import numpy as np
+import pytest
+
+from faabric_tpu.mpi.schedule import (
+    Schedule,
+    ScheduleCache,
+    ScheduleVerificationError,
+    Step,
+    verify_schedule,
+)
+from faabric_tpu.mpi.schedule_compile import (
+    FAMILIES,
+    FAST_LINK_GIBS,
+    choose_family,
+    compile_schedule,
+    measured_cross_gibs,
+    selftest,
+)
+from faabric_tpu.mpi.topology import Topology, interleave_hosts
+
+GANG_2X3 = Topology({r: f"h{r // 3}" for r in range(6)})
+SCATTERED_4X3 = Topology(interleave_hosts([f"h{i}" for i in range(4)], 12))
+SINGLE = Topology({r: "h0" for r in range(4)})
+
+
+# ---------------------------------------------------------------------------
+# Verifier
+# ---------------------------------------------------------------------------
+
+def _pingpong_schedule():
+    """Minimal hand-built valid schedule: 2-rank allgather."""
+    steps = {
+        0: (Step("send", peer=1, keys=(("in", 0),), syms=(("blk", 0),)),
+            Step("copy", dst=("out", 0), src=("in", 0)),
+            Step("recv", peer=1, keys=(("out", 1),), syms=(("blk", 1),))),
+        1: (Step("send", peer=0, keys=(("in", 0),), syms=(("blk", 1),)),
+            Step("copy", dst=("out", 1), src=("in", 0)),
+            Step("recv", peer=0, keys=(("out", 0),), syms=(("blk", 0),))),
+    }
+    return Schedule(name="test.allgather", collective="allgather",
+                    size=2, steps=steps)
+
+
+def test_verifier_accepts_valid_schedule():
+    sched = verify_schedule(_pingpong_schedule())
+    assert sched.verified
+
+
+def test_verifier_rejects_missing_element():
+    sched = _pingpong_schedule()
+    # Rank 1 never sends its contribution: rank 0's output 1 can only
+    # stay unwritten (and its recv deadlocks first)
+    sched.steps[1] = tuple(s for s in sched.steps[1] if s.op != "send")
+    with pytest.raises(ScheduleVerificationError):
+        verify_schedule(sched)
+
+
+def test_verifier_rejects_double_delivery():
+    sched = _pingpong_schedule()
+    sched.steps[0] = sched.steps[0] + (
+        Step("copy", dst=("out", 0), src=("in", 0)),)
+    with pytest.raises(ScheduleVerificationError,
+                       match="double delivery"):
+        verify_schedule(sched)
+
+
+def test_verifier_rejects_double_counted_fold():
+    steps = {
+        0: (Step("copy", dst=("tmp", "a"), src=("in", 0)),
+            Step("fold", dst=("out", 0), a=("tmp", "a"), b=("in", 0)),),
+    }
+    sched = Schedule(name="test.scan", collective="scan", size=1,
+                     steps=steps)
+    with pytest.raises(ScheduleVerificationError,
+                       match="double-counts"):
+        verify_schedule(sched)
+
+
+def test_verifier_rejects_framing_mismatch():
+    sched = _pingpong_schedule()
+    bad = Step("recv", peer=1, keys=(("out", 1),), syms=(("blk", 9),))
+    sched.steps[0] = sched.steps[0][:2] + (bad,)
+    with pytest.raises(ScheduleVerificationError, match="framing"):
+        verify_schedule(sched)
+
+
+def test_verifier_rejects_deadlock_and_undelivered():
+    steps = {
+        0: (Step("recv", peer=1, keys=(("out", 1),),
+                 syms=(("blk", 1),)),
+            Step("copy", dst=("out", 0), src=("in", 0)),),
+        1: (Step("recv", peer=0, keys=(("out", 0),),
+                 syms=(("blk", 0),)),
+            Step("copy", dst=("out", 1), src=("in", 0)),),
+    }
+    sched = Schedule(name="test.allgather", collective="allgather",
+                     size=2, steps=steps)
+    with pytest.raises(ScheduleVerificationError, match="deadlock"):
+        verify_schedule(sched)
+
+    steps = {
+        0: (Step("send", peer=1, keys=(("in", 0),), syms=(("blk", 0),)),
+            Step("send", peer=1, keys=(("in", 0),), syms=(("blk", 0),)),
+            Step("copy", dst=("out", 0), src=("in", 0)),
+            Step("recv", peer=1, keys=(("out", 1),), syms=(("blk", 1),))),
+        1: (Step("send", peer=0, keys=(("in", 0),), syms=(("blk", 1),)),
+            Step("copy", dst=("out", 1), src=("in", 0)),
+            Step("recv", peer=0, keys=(("out", 0),), syms=(("blk", 0),))),
+    }
+    sched = Schedule(name="test.allgather", collective="allgather",
+                     size=2, steps=steps)
+    with pytest.raises(ScheduleVerificationError, match="undelivered"):
+        verify_schedule(sched)
+
+
+def test_verifier_rejects_corrupted_compiled_schedule():
+    """A real lowering, corrupted: dropping one rank's final step loses
+    an output write somewhere downstream — the acceptance-criteria
+    'deliberately corrupted schedule' pin on a production schedule."""
+    sched = compile_schedule("alltoall.hier", "alltoall", SCATTERED_4X3)
+    fresh = Schedule(name=sched.name, collective=sched.collective,
+                     size=sched.size,
+                     steps=dict(sched.steps), spec=dict(sched.spec))
+    fresh.steps[5] = fresh.steps[5][:-1]
+    with pytest.raises(ScheduleVerificationError):
+        verify_schedule(fresh)
+
+
+def test_selftest_covers_matrix():
+    assert selftest() > 50
+
+
+# ---------------------------------------------------------------------------
+# Cache
+# ---------------------------------------------------------------------------
+
+def test_cache_compiles_once_and_verifies():
+    cache = ScheduleCache()
+    key = (1, "alltoall", 0, "-", "<i8", "4KiB")
+    calls = []
+
+    def compile_fn():
+        calls.append(1)
+        return compile_schedule("alltoall.hier", "alltoall", GANG_2X3)
+
+    s1 = cache.get_or_compile(key, "alltoall.hier", compile_fn)
+    s2 = cache.get_or_compile(key, "alltoall.hier", compile_fn)
+    assert s1 is s2 and s1.verified
+    assert len(calls) == 1
+    assert cache.family_of(key) == "alltoall.hier"
+    assert cache.stats() == {"entries": 1, "compiles": 1, "hits": 1}
+
+
+def test_cache_refuses_unverifiable_schedule():
+    cache = ScheduleCache()
+    bad = _pingpong_schedule()
+    bad.steps[1] = tuple(s for s in bad.steps[1] if s.op != "send")
+    with pytest.raises(ScheduleVerificationError):
+        cache.get_or_compile((1, "x", 0, "-", "-", "-"), "f", lambda: bad)
+    assert cache.stats()["entries"] == 0  # nothing cached on failure
+
+
+def test_cache_eviction_preserves_family_ledger():
+    """The cardinality backstop may drop schedule ENTRIES, but the
+    world-agreed family of a live-generation key must survive: ranks
+    that already ran their selection round never run another, so
+    losing the verdict would crash mid-collective (regression)."""
+    cache = ScheduleCache()
+    cache.MAX_ENTRIES = 4
+    compile_fn = lambda: compile_schedule(  # noqa: E731
+        "alltoall.hier", "alltoall", GANG_2X3)
+    keys = [(7, "alltoall", 0, "-", "<i8", f"sz{i}") for i in range(6)]
+    for key in keys:
+        cache.note_family(key, "alltoall.hier")  # selection round
+        cache.get_or_compile(key, "alltoall.hier", compile_fn)
+    # The backstop fired (same-generation clear), entries shrank...
+    assert cache.stats()["entries"] < len(keys)
+    # ...but every key still recovers its agreed family and recompiles
+    for key in keys:
+        assert cache.family_of(key) == "alltoall.hier"
+        assert cache.get_or_compile(key, "alltoall.hier",
+                                    compile_fn).verified
+    # Dead-generation families DO get pruned once a newer gen evicts
+    cache.MAX_ENTRIES = 1
+    new_gen = (8, "alltoall", 0, "-", "<i8", "sz0")
+    cache.note_family(new_gen, "alltoall.hier")
+    cache.get_or_compile(new_gen, "alltoall.hier", compile_fn)
+    assert cache.family_of(keys[0]) is None
+    assert cache.family_of(new_gen) == "alltoall.hier"
+
+
+def test_cache_generation_keys_are_distinct():
+    cache = ScheduleCache()
+    for gen in (1, 2):
+        cache.get_or_compile(
+            (gen, "alltoall", 0, "-", "<i8", "4KiB"), "alltoall.hier",
+            lambda: compile_schedule("alltoall.hier", "alltoall",
+                                     GANG_2X3))
+    assert cache.stats()["compiles"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Selection — perf-store-driven (the acceptance-criteria unit pin)
+# ---------------------------------------------------------------------------
+
+class _StubStore:
+    def __init__(self, gibs):
+        self.gibs = gibs
+        self.calls = []
+
+    def link_gibs(self, dst, plane=None, min_bytes=0):
+        self.calls.append((dst, plane, min_bytes))
+        return self.gibs
+
+
+class _EmptyMatrix:
+    def snapshot(self):
+        return {}
+
+
+def test_selection_reads_link_gibs_and_flips_on_bandwidth():
+    fast = _StubStore(FAST_LINK_GIBS * 4)
+    fam = choose_family("alltoall", SCATTERED_4X3, 1 << 20, True,
+                        store=fast, matrix=_EmptyMatrix())
+    assert fam == "alltoall.flat"
+    # Selection DID consult the measured per-link bandwidth, one query
+    # per remote host of the topology
+    assert len(fast.calls) == 3
+    assert all(plane == "bulk-tcp" for _, plane, _ in fast.calls)
+
+    slow = _StubStore(FAST_LINK_GIBS / 10)
+    assert choose_family("alltoall", SCATTERED_4X3, 1 << 20, True,
+                         store=slow,
+                         matrix=_EmptyMatrix()) == "alltoall.hier"
+    # Unmeasured links assume slow (the governor's convention)
+    unmeasured = _StubStore(None)
+    assert choose_family("alltoall", SCATTERED_4X3, 1 << 20, True,
+                         store=unmeasured,
+                         matrix=_EmptyMatrix()) == "alltoall.hier"
+
+
+def test_selection_default_path_reads_the_global_perf_store(monkeypatch):
+    """The no-argument path resolves get_perf_store() — the ROADMAP item
+    5 contract that selection consumes the PR 12 introspection plane
+    instead of re-deriving bandwidth."""
+    import faabric_tpu.telemetry.perfprofile as perfprofile
+
+    stub = _StubStore(FAST_LINK_GIBS * 4)
+    monkeypatch.setattr(perfprofile, "get_perf_store", lambda: stub)
+    fam = choose_family("alltoall", GANG_2X3, 1 << 20, True,
+                        matrix=_EmptyMatrix())
+    assert fam == "alltoall.flat"
+    assert stub.calls, "selection never read get_perf_store().link_gibs"
+
+
+def test_selection_survives_metrics_off_null_store():
+    """FAABRIC_METRICS=0 hands selection the shared null store — its
+    link_gibs must accept the same signature as the real store, or
+    rank 0 dies before the selection broadcast and the world hangs
+    (regression)."""
+    from faabric_tpu.telemetry.perfprofile import NULL_PERF_STORE
+
+    fam = choose_family("alltoall", GANG_2X3, 1 << 20, True,
+                        store=NULL_PERF_STORE, matrix=_EmptyMatrix())
+    assert fam == "alltoall.hier"  # unmeasured → assume slow → compose
+
+
+def test_selection_comm_matrix_fallback():
+    """Store silent → the comm-matrix window supplies the estimate."""
+
+    class _Matrix:
+        def snapshot(self):
+            # 1 GiB in 0.1 s toward rank 3 (on h1): a 10 GiB/s link
+            return {"cells": [{
+                "src": "0", "dst": "3", "plane": "bulk-tcp",
+                "bytes": 1 << 30, "bytes_raw": 1 << 30, "lat_sum": 0.1,
+            }]}
+
+    gibs = measured_cross_gibs(GANG_2X3, "h0", store=_StubStore(None),
+                               matrix=_Matrix())
+    assert gibs == pytest.approx(10.0, rel=0.01)
+    fam = choose_family("alltoall", GANG_2X3, 1 << 20, True,
+                        store=_StubStore(None), matrix=_Matrix())
+    assert fam == "alltoall.flat"
+
+
+def test_selection_structural_rules():
+    empty = _EmptyMatrix()
+    unmeasured = _StubStore(None)
+    # Single host: always flat, no store consultation needed
+    assert choose_family("alltoall", SINGLE, 1 << 20, True,
+                         store=unmeasured, matrix=empty) \
+        == "alltoall.flat"
+    assert choose_family("scatter", SINGLE, None, True,
+                         store=unmeasured, matrix=empty) == "scatter.flat"
+    # Force composes regardless of measurements
+    fast = _StubStore(FAST_LINK_GIBS * 4)
+    assert choose_family("alltoall", GANG_2X3, 1 << 20, "force",
+                         store=fast, matrix=empty) == "alltoall.hier"
+    assert choose_family("scatterv", GANG_2X3, None, "force",
+                         store=fast, matrix=empty) == "scatter.tree"
+    # scan composes only over gang-contiguous placements
+    assert choose_family("scan", GANG_2X3, 1 << 20, "force",
+                         store=unmeasured, matrix=empty) == "scan.hier"
+    assert choose_family("scan", SCATTERED_4X3, 1 << 20, "force",
+                         store=unmeasured, matrix=empty) == "scan.chain"
+    # Reduction lowerings: hierarchical twins
+    for coll in ("allreduce", "reduce_scatter", "allgather"):
+        assert choose_family(coll, GANG_2X3, 1 << 20, "force",
+                             store=unmeasured,
+                             matrix=empty) == f"{coll}.hier"
+
+
+def test_family_table_is_stable_wire_protocol():
+    """The selection-sync broadcast ships FAMILIES indexes — the tuple
+    is append-only wire protocol between processes of one world."""
+    assert FAMILIES[:9] == (
+        "alltoall.flat", "alltoall.hier", "scatter.flat", "scatter.tree",
+        "scan.chain", "scan.hier", "allreduce.hier",
+        "reduce_scatter.hier", "allgather.hier")
+
+
+# ---------------------------------------------------------------------------
+# Lowering structure pins
+# ---------------------------------------------------------------------------
+
+def test_alltoall_hier_message_count_model():
+    """Cross-host messages collapse to H·(H−1) packed sends while bytes
+    stay invariant (alltoall is a permutation): count the schedule's
+    cross-host sends and the abstract elements they carry."""
+    topo = SCATTERED_4X3
+    sched = compile_schedule("alltoall.hier", "alltoall", topo)
+    flat = compile_schedule("alltoall.flat", "alltoall", topo)
+
+    def cross_sends(s):
+        msgs, blocks = 0, 0
+        for r, steps in s.steps.items():
+            for st in steps:
+                if st.op == "send" \
+                        and topo.host_of(r) != topo.host_of(st.peer):
+                    msgs += 1
+                    blocks += len(st.keys)
+        return msgs, blocks
+
+    hier_msgs, hier_blocks = cross_sends(sched)
+    flat_msgs, flat_blocks = cross_sends(flat)
+    assert hier_msgs == 4 * 3                 # H·(H−1) packed messages
+    assert flat_msgs == 12 * 9                # N·(N−m) naive messages
+    assert hier_blocks == flat_blocks == 108  # bytes invariant
+
+
+def test_scatter_tree_one_wire_message_per_remote_host():
+    topo = GANG_2X3
+    sched = compile_schedule("scatter.tree", "scatter", topo, root=0)
+    wire = [(r, st) for r, steps in sched.steps.items() for st in steps
+            if st.op == "send"
+            and topo.host_of(r) != topo.host_of(st.peer)]
+    assert len(wire) == 1 and wire[0][0] == 0  # root → remote leader
+
+
+def test_scan_hier_serial_depth():
+    """The hier scan's longest dependency chain is ≈ ranks/host + hosts
+    instead of N — count the carrier-chain + intra hops."""
+    topo = Topology({r: f"h{r // 4}" for r in range(16)})  # 4 hosts × 4
+    sched = compile_schedule("scan.hier", "scan", topo)
+    chain = compile_schedule("scan.chain", "scan", topo)
+
+    def wire_depth(s):
+        # Longest per-rank recv count approximates the serial depth
+        return max(sum(1 for st in steps if st.op == "recv")
+                   for steps in s.steps.values())
+
+    assert wire_depth(sched) <= 6   # local chain + carrier + fixup
+    # The flat chain is 1 recv per rank but N sequential hops; pin the
+    # structural property instead: every rank depends on its predecessor
+    assert all(any(st.op == "recv" and st.peer == r - 1
+                   for st in chain.steps[r]) for r in range(1, 16))
+
+
+def test_spec_round_trips_for_scatterv_header():
+    sched = compile_schedule("scatter.tree", "scatterv", GANG_2X3, root=0)
+    assert sched.spec == {"root": 0, "counts_header": True}
+    headers = [st for steps in sched.steps.values() for st in steps
+               if st.op == "send" and ("cnt",) in st.syms]
+    assert len(headers) == 1  # one remote multi-rank host → one header
+
+
+def test_verified_flag_is_the_execution_gate():
+    """MpiWorld._run_schedule refuses an unverified schedule outright."""
+    from faabric_tpu.mpi.schedule import ScheduleError
+    from faabric_tpu.mpi.world import MpiWorld
+
+    sched = _pingpong_schedule()  # never verified
+    world = MpiWorld.__new__(MpiWorld)  # no broker needed: refusal is
+    with pytest.raises(ScheduleError):  # checked before any transport
+        world._run_schedule(0, sched, {}, None,
+                            lambda s, e: 1, 0)
+
+
+def test_runner_split_framing_is_checked():
+    """A resolver that mis-sizes a packed split raises instead of
+    silently mis-slicing payloads."""
+    from faabric_tpu.mpi.schedule import ScheduleError
+    from faabric_tpu.mpi.world import MpiWorld
+
+    steps = {0: (Step("recv", peer=1,
+                      keys=(("out", 0), ("out", 1)),
+                      syms=(("blk", 0), ("blk", 1))),)}
+    sched = Schedule(name="t", collective="allgather", size=2,
+                     steps=steps, verified=True)
+    world = MpiWorld.__new__(MpiWorld)
+    world._recv_raw = lambda src, dst: (np.arange(10), None)
+    world._sched_phase_groups = MpiWorld._sched_phase_groups
+    import faabric_tpu.telemetry as telem
+
+    assert not telem.tracing_enabled()
+    with pytest.raises(ScheduleError, match="framing"):
+        MpiWorld._run_schedule(world, 0, sched, {}, None,
+                               lambda sym, e: 3, 0)

@@ -33,6 +33,12 @@ if ! JAX_PLATFORMS=cpu python -m faabric_tpu.runner.doctor --selftest; then
     rc=1
 fi
 
+echo "== schedule verifier selftest (collective schedule compiler) =="
+if ! JAX_PLATFORMS=cpu python -m faabric_tpu.mpi.schedule_compile \
+        --selftest; then
+    rc=1
+fi
+
 if [ "${1:-}" = "--with-tests" ]; then
     echo "== tier-1 suite =="
     rm -f /tmp/_t1.log
