@@ -108,6 +108,12 @@ class PlannerHttpEndpoint:
                     elif path == "/healthz":
                         body = endpoint.healthz_json().encode()
                         ctype = "application/json"
+                    elif path == "/timeseries":
+                        body = endpoint.timeseries_json().encode()
+                        ctype = "application/json"
+                    elif path == "/flight":
+                        body = endpoint.flight_json().encode()
+                        ctype = "application/json"
                     elif path == "/topology":
                         body = endpoint.topology_json().encode()
                         ctype = "application/json"
@@ -162,7 +168,8 @@ class PlannerHttpEndpoint:
             render_snapshots,
         )
 
-        tel = self.planner.collect_telemetry()
+        tel = self.planner.collect_telemetry(
+            blocks=("metrics", "commmatrix"))
         merged = {}
         for host, t in tel.items():
             snap = dict(t.get("metrics", {}))
@@ -178,7 +185,7 @@ class PlannerHttpEndpoint:
         plain sum)."""
         from faabric_tpu.telemetry import merge_cell_rows
 
-        tel = self.planner.collect_telemetry()
+        tel = self.planner.collect_telemetry(blocks=("commmatrix",))
         per_host = {host: (t.get("commmatrix") or {}).get("cells", [])
                     for host, t in tel.items()}
         return json.dumps({
@@ -196,13 +203,37 @@ class PlannerHttpEndpoint:
         without a live scrape."""
         from faabric_tpu.telemetry import aggregate_perf, persist_cluster
 
-        doc = aggregate_perf(self.planner.collect_telemetry())
+        doc = aggregate_perf(
+            self.planner.collect_telemetry(blocks=("perf",)))
         self.planner.note_perf_aggregation(doc)
         persist_cluster(doc)
         return json.dumps(doc)
 
     def healthz_json(self) -> str:
         return json.dumps(self.planner.health_summary())
+
+    def timeseries_json(self) -> str:
+        """Cluster-merged time-series rings (ISSUE 14): every host's
+        sampled gauge history keyed by host — the trend surface behind
+        the doctor's queue-growth and capacity-exhaustion analyzers."""
+        import time as _time
+
+        # Blocks-narrowed scrape: a trend poll repeats continuously and
+        # must not pay for every host's full metrics/comm-matrix/perf
+        # payload just to discard it
+        tel = self.planner.collect_telemetry(blocks=("timeseries",))
+        hosts = {host: (t.get("timeseries") or {})
+                 for host, t in tel.items()}
+        return json.dumps({"generated_at": _time.time(), "hosts": hosts})
+
+    def flight_json(self) -> str:
+        """The planner process's LIVE flight-recorder ring (ISSUE 14
+        satellite): read the black box without waiting for a crash
+        dump. Workers serve the same path on their own HTTP endpoints;
+        ``flightdump --url`` merges them."""
+        from faabric_tpu.telemetry.flight import live_ring_doc
+
+        return json.dumps(live_ring_doc())
 
     def topology_json(self) -> str:
         """Cluster topology snapshot (ISSUE 9): per-host capacity plus
@@ -217,7 +248,8 @@ class PlannerHttpEndpoint:
         Raw pids are remapped per (host, pid): containerized workers are
         routinely all pid 1, and colliding pids would collapse different
         hosts onto one Perfetto process row."""
-        tel = self.planner.collect_telemetry(include_trace=True)
+        tel = self.planner.collect_telemetry(include_trace=True,
+                                             blocks=())
         events: list = []
         pid_map: dict[tuple[str, int], int] = {}
         for host in sorted(tel):

@@ -2,11 +2,19 @@
 
 Reference analog: src/endpoint/FaabricEndpointHandler.cpp:16-56 — the
 worker's HTTP surface rejects every functional request, directing
-clients to the planner, which owns the REST API. One exception:
-``GET /healthz`` answers locally (liveness must not depend on the
-planner being up), reporting the worker's identity, uptime and executor
-load. Started by the WorkerRuntime when ``WORKER_HTTP_PORT`` (or an
-explicit port) is set.
+clients to the planner, which owns the REST API. Exceptions, all
+answered locally (liveness/diagnosis must not depend on the planner
+being up):
+
+- ``GET /healthz``   — identity, uptime, executor load;
+- ``GET /metrics``   — this process's local registry (Prometheus text,
+  including the ``faabric_process_*`` resource gauges);
+- ``GET /timeseries``— this process's sampled-gauge ring (ISSUE 14);
+- ``GET /flight``    — the LIVE flight-recorder ring (read the black
+  box without waiting for a crash dump; ``flightdump --url`` merges).
+
+Started by the WorkerRuntime when ``WORKER_HTTP_PORT`` (or an explicit
+port) is set.
 """
 
 from __future__ import annotations
@@ -51,6 +59,25 @@ class WorkerHttpEndpoint:
                 body["executors"] = scheduler.get_executor_count()
         return body
 
+    @staticmethod
+    def metrics_text() -> str:
+        from faabric_tpu.telemetry import get_metrics, get_proc_stats
+
+        get_proc_stats().refresh()
+        return get_metrics().render_prometheus()
+
+    @staticmethod
+    def timeseries_json() -> str:
+        from faabric_tpu.telemetry import get_timeseries
+
+        return json.dumps(get_timeseries().snapshot())
+
+    @staticmethod
+    def flight_json() -> str:
+        from faabric_tpu.telemetry.flight import live_ring_doc
+
+        return json.dumps(live_ring_doc())
+
     def start(self) -> None:
         """Best-effort: a health probe must never take the worker down.
         A bind failure (e.g. two aliased workers on one box sharing
@@ -72,11 +99,26 @@ class WorkerHttpEndpoint:
 
             def do_GET(self) -> None:  # noqa: N802 — stdlib API
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
-                if path == "/healthz":
-                    self._respond(200,
-                                  json.dumps(endpoint.healthz()).encode())
-                else:
-                    self._reject()
+                try:
+                    if path == "/healthz":
+                        self._respond(
+                            200, json.dumps(endpoint.healthz()).encode())
+                    elif path == "/metrics":
+                        self._respond(200,
+                                      endpoint.metrics_text().encode())
+                    elif path == "/timeseries":
+                        self._respond(200,
+                                      endpoint.timeseries_json().encode())
+                    elif path == "/flight":
+                        self._respond(200,
+                                      endpoint.flight_json().encode())
+                    else:
+                        self._reject()
+                except Exception as e:  # noqa: BLE001 — a scrape error
+                    # must not kill the handler thread mid-response
+                    logger.exception("worker-http GET %s failed", path)
+                    self._respond(
+                        500, json.dumps({"error": str(e)}).encode())
 
             do_POST = do_PUT = do_DELETE = _reject
 

@@ -31,9 +31,15 @@ from faabric_tpu.proto import (
 )
 from faabric_tpu.telemetry import (
     NULL_SPAN,
+    get_lifecycle,
     get_metrics,
     span,
     tracing_enabled,
+)
+from faabric_tpu.telemetry.lifecycle import (
+    PHASE_EXEC_QUEUE_EXIT,
+    PHASE_RUN_END,
+    PHASE_RUN_START,
 )
 from faabric_tpu.util.config import get_system_config
 from faabric_tpu.util.logging import get_logger
@@ -48,6 +54,8 @@ POOL_SHUTDOWN = -1
 
 _FAULTS = faults_enabled()
 _FP_RUN = fault_point("executor.run")
+
+_LC = get_lifecycle()
 
 _metrics = get_metrics()
 _QUEUE_WAIT_SECONDS = _metrics.histogram(
@@ -274,6 +282,8 @@ class Executor:
         msg = req.messages[task.msg_idx]
         is_threads = req.type == int(BatchExecuteType.THREADS)
         msg.executed_host = self.scheduler.host if self.scheduler else ""
+        # Lifecycle ledger (ISSUE 14): the pool thread has the task
+        _LC.stamp(msg, PHASE_EXEC_QUEUE_EXIT)
         queue_wait = time.monotonic() - task.enqueue_ts
         _QUEUE_WAIT_SECONDS.observe(queue_wait)
 
@@ -286,6 +296,7 @@ class Executor:
                 mem, region_hints=self._batch_hints)
 
         ExecutorContext.set(self, req, task.msg_idx)
+        _LC.stamp(msg, PHASE_RUN_START)
         run_t0 = time.monotonic()
         try:
             if _FAULTS:
@@ -327,6 +338,7 @@ class Executor:
         finally:
             ExecutorContext.unset()
 
+        _LC.stamp(msg, PHASE_RUN_END)
         run_seconds = time.monotonic() - run_t0
         _RUN_SECONDS.observe(run_seconds)
         _TASKS_TOTAL.inc()

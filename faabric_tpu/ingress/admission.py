@@ -148,6 +148,18 @@ class AdmissionController:
         with self._lock:
             self._drain_ewma_s = 0.8 * self._drain_ewma_s + 0.2 * per_msg
 
+    def depth(self) -> int:
+        """Current queue depth (messages holding credits) — the
+        time-series ring's ingress-depth gauge."""
+        with self._lock:
+            return self._depth
+
+    def shed_total(self) -> int:
+        """Cumulative shed count (the ring stores the raw counter; the
+        doctor differentiates to get a shed RATE trend)."""
+        with self._lock:
+            return self._shed_total
+
     # ------------------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:

@@ -46,7 +46,11 @@ from faabric_tpu.ingress.admission import (
     AdmissionController,
     IngressShedError,
 )
-from faabric_tpu.telemetry import get_metrics
+from faabric_tpu.telemetry import get_lifecycle, get_metrics
+from faabric_tpu.telemetry.lifecycle import (
+    PHASE_ADMIT,
+    PHASE_QUEUE_EXIT,
+)
 from faabric_tpu.util.logging import get_logger
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -71,6 +75,10 @@ _BATCHED = _metrics.counter(
 _QUEUE_WAIT = _metrics.histogram(
     "faabric_ingress_queue_wait_seconds",
     "Enqueue to decision latency for tick-batched invocations")
+
+# Lifecycle ledger (ISSUE 14): admission + queue-exit stamps ride the
+# messages themselves (no-op singleton when FAABRIC_METRICS=0)
+_LC = get_lifecycle()
 
 
 class _Pending:
@@ -116,6 +124,7 @@ class IngressCoordinator:
         "_batched_total": "_lock",
         "_ticks": "_lock",
         "_last_tick_batch": "_lock",
+        "_last_tick_s": "_lock",
     }
 
     def __init__(self, planner: "Planner",
@@ -138,6 +147,7 @@ class IngressCoordinator:
         self._batched_total = 0
         self._ticks = 0
         self._last_tick_batch = 0
+        self._last_tick_s = 0.0
 
     # ------------------------------------------------------------------
     def submit(self, req: "BatchExecuteRequest", source: str = "",
@@ -150,6 +160,10 @@ class IngressCoordinator:
         through the normal result plane). Raises ``IngressShedError``
         when admission sheds the invocation."""
         from faabric_tpu.util.config import get_system_config
+
+        # Ledger t0: everything entering the planner through the
+        # ingress — batchable or not — stamps admit here
+        _LC.stamp_many(req.messages, PHASE_ADMIT)
 
         # Shape check only — lock-free. Requests with existing planner
         # state (scale changes, thaws, preloads) that slip through are
@@ -179,6 +193,8 @@ class IngressCoordinator:
                 self._immediate_total += 1
         if idle:
             try:
+                # The queue was never entered: zero-wait queue exit
+                _LC.stamp_many(req.messages, PHASE_QUEUE_EXIT)
                 return self._planner.call_batch(req)
             finally:
                 with self._lock:
@@ -245,6 +261,8 @@ class IngressCoordinator:
         submission takes the classic synchronous path inline."""
         from faabric_tpu.util.config import get_system_config
 
+        for r in reqs:
+            _LC.stamp_many(r.messages, PHASE_ADMIT)
         batchable: list = []
         direct: list = []
         for r in reqs:
@@ -364,6 +382,8 @@ class IngressCoordinator:
                          "scheduling", expired)
         if not batch:
             return
+        for pending in batch:
+            _LC.stamp_many(pending.req.messages, PHASE_QUEUE_EXIT)
         results, deferred = self._planner.call_batch_group(
             [p.req for p in batch])
         backlog: list[_Pending] = []
@@ -417,6 +437,7 @@ class IngressCoordinator:
                 self._queue[:0] = backlog
             self._ticks += 1
             self._last_tick_batch = resolved
+            self._last_tick_s = time.monotonic() - t0
             self._batched_total += resolved
         if stopped:
             # stop() already drained the queue (its 5s join can expire
@@ -499,6 +520,13 @@ class IngressCoordinator:
                                      pending.req.app_id)
             self._resolve(pending, not_enough_slots_decision())
 
+    def last_tick_ms(self) -> float:
+        """Duration of the most recent non-empty tick (time-series
+        gauge: a tick trending toward the tick period is the planner
+        saturating)."""
+        with self._lock:
+            return self._last_tick_s * 1000.0
+
     # ------------------------------------------------------------------
     def stats(self) -> dict:
         out = self.admission.stats()
@@ -507,6 +535,7 @@ class IngressCoordinator:
             out.update({
                 "queuedRequests": len(self._queue),
                 "queuedMessages": queued_msgs,
+                "lastTickMs": round(self._last_tick_s * 1000.0, 3),
                 "immediateTotal": self._immediate_total,
                 "batchedTotal": self._batched_total,
                 "ticks": self._ticks,

@@ -29,7 +29,11 @@ from faabric_tpu.proto import (
     messages_from_wire,
     messages_to_wire,
 )
-from faabric_tpu.telemetry import flight_record, get_metrics
+from faabric_tpu.telemetry import flight_record, get_lifecycle, get_metrics
+from faabric_tpu.telemetry.lifecycle import (
+    PHASE_RESULT_PUSH,
+    PHASE_WAITER_WAKE,
+)
 from faabric_tpu.transport.client import MessageEndpointClient, RpcError
 from faabric_tpu.transport.common import PLANNER_ASYNC_PORT, PLANNER_SYNC_PORT
 from faabric_tpu.util.config import get_system_config
@@ -41,6 +45,8 @@ logger = get_logger(__name__)
 
 _FAULTS = faults_enabled()
 _FP_KEEPALIVE = fault_point("keepalive")
+
+_LC = get_lifecycle()
 
 _metrics = get_metrics()
 _BUFFERED_RESULTS = _metrics.counter(
@@ -405,6 +411,9 @@ class PlannerClient(MessageEndpointClient):
             with _mock_lock:
                 _mock_results.append(msg)
             return
+        # Lifecycle ledger (ISSUE 14): the worker is about to push the
+        # result — last stamp taken on this host's side of the wire
+        _LC.stamp(msg, PHASE_RESULT_PUSH)
         # Earlier buffered results go first so the planner sees results
         # in completion order (first-write-wins makes reordering safe,
         # but ordered delivery keeps forensics sane)
@@ -634,6 +643,7 @@ class PlannerClient(MessageEndpointClient):
     def set_message_result_locally(self, msg: Message) -> None:
         """Resolve a local waiter (called by our FunctionCallServer when the
         planner pushes a result; reference setMessageResultLocally)."""
+        _LC.stamp(msg, PHASE_WAITER_WAKE)
         with self._results_lock:
             if msg.id not in self._local_results:
                 self._local_results_order.append(msg.id)

@@ -50,8 +50,17 @@ from faabric_tpu.faults import DROP, fault_point, faults_enabled
 from faabric_tpu.telemetry import (
     flight_dump,
     flight_record,
+    get_lifecycle,
     get_metrics,
     span,
+)
+from faabric_tpu.telemetry.lifecycle import (
+    PHASE_ADMIT,
+    PHASE_DISPATCH,
+    PHASE_JOURNAL,
+    PHASE_RECORDED,
+    PHASE_REQUEUE,
+    PHASE_SCHED,
 )
 from faabric_tpu.transport.common import MPI_BASE_PORT, MPI_PORTS_PER_HOST
 from faabric_tpu.util.config import get_system_config
@@ -62,6 +71,11 @@ logger = get_logger(__name__)
 
 _FAULTS = faults_enabled()
 _FP_DISPATCH = fault_point("planner.dispatch")
+
+# Invocation lifecycle ledger (ISSUE 14): schedule/journal/dispatch/
+# requeue/record stamps on the messages themselves (shared no-op
+# singleton when FAABRIC_METRICS=0)
+_LC = get_lifecycle()
 
 _metrics = get_metrics()
 _SCHEDULE_SECONDS = _metrics.histogram(
@@ -469,6 +483,11 @@ class Planner:
         # wrong app bucket (reference updateBatchExecAppId)
         update_batch_exec_app_id(req, req.app_id)
 
+        # Ledger t0 fallback for direct call_batch callers (the ingress
+        # already stamped admit for everything that came through it)
+        for m in req.messages:
+            _LC.stamp_first(m, PHASE_ADMIT)
+
         with self._lock:
             scheduler = get_batch_scheduler()
             decision_type = scheduler.get_decision_type(self._in_flight, req)
@@ -554,6 +573,7 @@ class Planner:
             else:
                 decision, mappings, dispatches = self._handle_dist_change_locked(
                     req, decision)
+            _LC.stamp_many(req.messages, PHASE_SCHED)
 
             if thawing:
                 # A thawed app may land anywhere — typically NOT where it
@@ -582,6 +602,7 @@ class Planner:
             _IN_FLIGHT_APPS.set(len(self._in_flight))
             if self._journal.enabled:
                 self._journal_app_update_locked(req.app_id)
+                _LC.stamp_many(req.messages, PHASE_JOURNAL)
         self._send_mappings(mappings)
         self._do_dispatch(dispatches)
         return result
@@ -677,6 +698,7 @@ class Planner:
                             req, list(decision.hosts), 0)
                     decision, mappings, dispatches = \
                         self._handle_new_locked(req, decision)
+                    _LC.stamp_many(req.messages, PHASE_SCHED)
                     free -= decision.n_messages
                     for ip in decision.hosts:
                         h = view.get(ip)
@@ -694,6 +716,9 @@ class Planner:
                         dispatch_groups.setdefault(ip, []).append(sub)
                 if journal_apps and self._journal.enabled:
                     self._journal_group_commit_locked(journal_apps)
+                    for subs in dispatch_groups.values():
+                        for sub in subs:
+                            _LC.stamp_many(sub.messages, PHASE_JOURNAL)
                 _IN_FLIGHT_APPS.set(len(self._in_flight))
             # Network strictly outside the lock, coalesced per host:
             # mappings first (guest code blocks on wait_for_mappings
@@ -739,6 +764,8 @@ class Planner:
                         host=ip, app_id=subs[0].app_id)
                     if verdict is DROP:
                         return
+                for sub in subs:
+                    _LC.stamp_many(sub.messages, PHASE_DISPATCH)
                 self._get_client(ip).execute_functions_many(subs)
             except Exception:  # noqa: BLE001 — a dead host must not
                 # stall the tick's other hosts
@@ -1249,6 +1276,10 @@ class Planner:
         logger.warning("Requeued %d msgs of app %d onto %s after: %s",
                        len(todo), app_id,
                        sorted(set(new_decision.hosts)), reason.decode())
+        # Ledger boundary (ISSUE 14): the requeue stamp splits the dead
+        # first attempt from the re-dispatch — a recovered invocation's
+        # result carries a ledger spanning BOTH attempts
+        _LC.stamp_many(retry_msgs, PHASE_REQUEUE)
         flight_record("planner_requeued", app=app_id, n_messages=len(todo),
                       hosts=sorted(set(new_decision.hosts)))
         self._send_mappings(mappings)
@@ -1396,6 +1427,7 @@ class Planner:
                         # recovers them — the chaos scenario dispatch-
                         # time error handling cannot see
                         continue
+                _LC.stamp_many(sub.messages, PHASE_DISPATCH)
                 self._get_client(ip).execute_functions(sub)
             except Exception:  # noqa: BLE001 — a dead host must not stall others
                 logger.exception("Dispatch of app %d to %s failed",
@@ -1454,6 +1486,7 @@ class Planner:
         pushes: list[tuple] = []  # (client, msg)
         cleanups: dict[str, set[int]] = {}  # host → finished group ids
         redispatches: list[tuple] = []
+        recorded: list[Message] = []  # lifecycle fold targets
         with self._lock:
             for msg in msgs:
                 app_id, msg_id = msg.app_id, msg.id
@@ -1472,6 +1505,8 @@ class Planner:
                 if not migrated and not frozen:
                     if not self._record_result_locked(msg):
                         continue
+                    _LC.stamp(msg, PHASE_RECORDED)
+                    recorded.append(msg)
                     if self._journal.enabled:
                         # Lazy fields: the drain thread runs to_dict.
                         # Safe — a stored result is never mutated
@@ -1489,6 +1524,13 @@ class Planner:
                         gids, hosts = group_cleanup
                         for host in hosts:
                             cleanups.setdefault(host, set()).update(gids)
+
+        # Fold the recorded ledgers into the per-phase digest + SLO
+        # tracker OUTSIDE the lock (a fold is ~10 µs per message)
+        if recorded and _LC.enabled:
+            from faabric_tpu.telemetry import get_lifecycle_stats
+
+            get_lifecycle_stats().fold(recorded)
 
         # Push results + group cleanup outside the lock (network)
         for client, msg in pushes:
@@ -2126,6 +2168,15 @@ class Planner:
             "clusterStragglers": agg["stragglers"] if agg else None,
         }
 
+        # ISSUE 14: the lifecycle digest (per-phase quantiles + the
+        # dominant-phase ranking) and the SLO burn status — what the
+        # doctor and a high-QPS driver read instead of inferring from
+        # point-in-time counters
+        from faabric_tpu.telemetry import (
+            get_lifecycle_stats,
+            get_slo_tracker,
+        )
+
         return {
             "status": "ok",
             "hosts": hosts,
@@ -2133,6 +2184,8 @@ class Planner:
             "inFlightMessages": in_flight_messages,
             "resultsTotal": results_total,
             "resultsFailed": results_failed,
+            "lifecycle": get_lifecycle_stats().snapshot(),
+            "slo": get_slo_tracker().status(),
             "perf": perf_block,
             # ISSUE 8 satellite: admission-queue depth/shed, tick
             # occupancy and the decision-cache hit rate, so an operator
@@ -2141,6 +2194,26 @@ class Planner:
             "decisionCache": get_decision_cache().stats(),
             "journal": journal,
         }
+
+    # -- time-series gauges (ISSUE 14): cheap accessors the sampler
+    # polls at ~1 Hz — each is one lock acquisition over dict sums -----
+    def free_slot_watermark(self) -> int:
+        with self._lock:
+            return sum(max(0, h.state.slots - h.state.used_slots)
+                       for h in self._hosts.values())
+
+    def result_backlog(self) -> int:
+        """Outstanding result waits registered with the planner."""
+        with self._lock:
+            return len(self._waiters)
+
+    def in_flight_message_count(self) -> int:
+        with self._lock:
+            return sum(d.n_messages for _, d in self._in_flight.values())
+
+    def results_total(self) -> int:
+        with self._lock:
+            return self._results_count
 
     def note_perf_aggregation(self, doc: dict) -> None:
         """Record the summary of a completed ``/perf`` aggregation
@@ -2153,24 +2226,38 @@ class Planner:
         }
 
     def collect_telemetry(self, include_trace: bool = False,
-                          timeout: float = 5.0) -> dict:
+                          timeout: float = 5.0,
+                          blocks: tuple[str, ...] | None = None) -> dict:
         """host label → {"metrics": snapshot, "trace": [events]} from this
         (planner) process plus every registered worker's local registry —
         the aggregation behind ``GET /metrics`` and ``GET /trace``.
         Workers are scraped CONCURRENTLY under one deadline: a host that
         fails — or is wedged past ``timeout`` — is skipped, not fatal; a
         scrape must not go down (or block a Prometheus scrape window)
-        with one bad host."""
+        with one bad host. ``blocks`` narrows both the planner's own
+        entry and the worker RPCs to the named blocks (the /timeseries
+        trend poll asks for just its ring, not the full payload)."""
         from faabric_tpu.telemetry import (
             get_comm_matrix,
+            get_lifecycle_stats,
+            get_proc_stats,
+            get_timeseries,
             perf_telemetry_block,
             trace_events,
         )
 
-        out: dict = {"planner": {"metrics": get_metrics().snapshot(),
-                                 "commmatrix":
-                                 get_comm_matrix().snapshot(),
-                                 "perf": perf_telemetry_block()}}
+        # Fresh process gauges on every scrape, sampler or not
+        get_proc_stats().refresh()
+        builders = {
+            "metrics": lambda: get_metrics().snapshot(),
+            "commmatrix": lambda: get_comm_matrix().snapshot(),
+            "perf": perf_telemetry_block,
+            "lifecycle": lambda: get_lifecycle_stats().snapshot(),
+            "timeseries": lambda: get_timeseries().snapshot(),
+        }
+        out: dict = {"planner": {name: build() for name, build in
+                                 builders.items()
+                                 if blocks is None or name in blocks}}
         if include_trace:
             out["planner"]["trace"] = trace_events()
 
@@ -2187,7 +2274,8 @@ class Planner:
 
         def scrape(i: int, ip: str) -> None:
             try:
-                slots[i] = self._get_client(ip).get_telemetry(include_trace)
+                slots[i] = self._get_client(ip).get_telemetry(
+                    include_trace, blocks=blocks)
             except Exception:  # noqa: BLE001
                 logger.warning("Telemetry scrape of %s failed", ip)
             finally:

@@ -114,8 +114,47 @@ class PlannerServer(MessageEndpointServer):
         # well inside one extra keep-alive period
         timeout = get_system_config().planner_host_timeout
         self.expiry_reaper.start(max(0.5, timeout / 4.0))
+        # Time-series ring (ISSUE 14): control-plane gauges sampled
+        # continuously so the doctor sees TRENDS (queue growth,
+        # capacity exhaustion), not instants. Shared sampler thread —
+        # stop() releases this server's share.
+        from faabric_tpu.telemetry import get_timeseries, start_sampler
+
+        ring = get_timeseries()
+        planner = self.planner
+        self._ring_series = {
+            "ingress_depth": lambda: planner.ingress.admission.depth(),
+            "ingress_shed_total":
+                lambda: planner.ingress.admission.shed_total(),
+            "free_slots": planner.free_slot_watermark,
+            "tick_ms": planner.ingress.last_tick_ms,
+            "result_backlog": planner.result_backlog,
+            "in_flight_msgs": planner.in_flight_message_count,
+            "results_total": planner.results_total,
+        }
+        for name, fn in self._ring_series.items():
+            ring.register(name, fn)
+        start_sampler()
+        # Balance marker: stop() must release ONLY the sampler share
+        # this start() took — an unmatched stop (failed start, double
+        # stop) would otherwise drop the refcount under a co-resident
+        # runtime and silently halt its sampling
+        self._sampling = True
 
     def stop(self) -> None:
+        from faabric_tpu.telemetry import get_timeseries, stop_sampler
+
+        if getattr(self, "_sampling", False):
+            self._sampling = False
+            stop_sampler()
+        # Unregister what start() registered: leftover closures would
+        # pin this planner alive and keep a surviving in-process
+        # sampler polling a stopped server's locks. fn-matched, so a
+        # co-resident server that re-registered over us keeps its rows.
+        ring = get_timeseries()
+        for name, fn in getattr(self, "_ring_series", {}).items():
+            ring.unregister(name, fn)
+        self._ring_series = {}
         self.expiry_reaper.stop()
         self.snapshot_server.stop()
         # Stop the ingress tick thread BEFORE the transport: queued

@@ -90,6 +90,12 @@ class Message:
     # Migration
     is_migration: bool = False
 
+    # Invocation lifecycle ledger (ISSUE 14): phase → monotonic ns
+    # stamp, written by telemetry/lifecycle.py at admit/schedule/
+    # dispatch/run/result boundaries. Carried on the wire so the ledger
+    # accumulates ACROSS hosts; empty when FAABRIC_METRICS=0.
+    lc: dict[str, int] = dataclasses.field(default_factory=dict)
+
     def to_dict(self) -> dict[str, Any]:
         """REST/journal form: payloads hex-encoded in place. Built on
         the one hand-rolled field list (to_wire_dict)."""
@@ -133,6 +139,7 @@ class Message:
             "int_exec_graph_details": dict(self.int_exec_graph_details),
             "chained_msg_ids": list(self.chained_msg_ids),
             "is_migration": self.is_migration,
+            "lc": dict(self.lc),
         }
 
     @classmethod

@@ -23,12 +23,20 @@ post-mortem needs no live cluster — and prints a RANKED diagnosis:
 - **journal fsync pressure** — the group-commit journal's write-behind
   buffer backing up or fsync falling behind its interval;
 - **open circuit breakers / keep-alives at risk** — hosts the planner
-  is about to give up on.
+  is about to give up on;
+- **dominant lifecycle phase** (ISSUE 14) — which phase of the
+  invocation ledger the p99 end-to-end latency is made of;
+- **SLO burn** (ISSUE 14) — declared ``FAABRIC_SLO`` targets burning
+  their error budget on every evaluation window;
+- **queue growth / capacity exhaustion** (ISSUE 14) — trends from the
+  ``/timeseries`` ring: an ingress depth that keeps growing, or free
+  slots pinned at zero while a backlog holds.
 
 ``--selftest`` runs the analyzers over a built-in synthetic cluster
-with one planted slow link, one planted straggler and one escape storm,
-and exits non-zero unless all three rank in the top findings — the
-smoke gate ``tools/check.sh`` runs.
+with one planted slow link, one planted straggler, an escape storm, a
+run-dominated lifecycle tail, a burning latency SLO and a growing
+ingress queue, and exits non-zero unless all of them rank in the
+findings — the smoke gate ``tools/check.sh`` runs.
 """
 
 from __future__ import annotations
@@ -42,7 +50,8 @@ import sys
 # One median, shared with the straggler analysis this tool cross-checks
 from faabric_tpu.telemetry.perfprofile import _median
 
-SOURCES = ("perf", "metrics", "commmatrix", "healthz", "topology")
+SOURCES = ("perf", "metrics", "commmatrix", "healthz", "topology",
+           "timeseries")
 
 # File-name candidates per source for --dir mode (first hit wins)
 _FILE_CANDIDATES = {
@@ -51,6 +60,7 @@ _FILE_CANDIDATES = {
     "commmatrix": ("commmatrix.json",),
     "healthz": ("healthz.json",),
     "topology": ("topology.json",),
+    "timeseries": ("timeseries.json",),
 }
 
 # A link must carry this many samples before the doctor will call it
@@ -341,6 +351,120 @@ def check_healthz(healthz: dict | None) -> list[dict]:
     return findings
 
 
+def check_lifecycle(healthz: dict | None) -> list[dict]:
+    """Dominant-phase ranking for the p99 end-to-end tail (ISSUE 14):
+    the invocation ledger's per-phase digests, ranked by their own p99
+    — in the mostly-serial invocation pipeline the phase with the
+    fattest tail is what the e2e p99 is made of. Always reported when
+    enough invocations folded (the attribution IS the diagnosis; the
+    severity scales with how dominant the leader is)."""
+    lifecycle = (healthz or {}).get("lifecycle") or {}
+    if (lifecycle.get("count") or 0) < 20:
+        return []
+    dominant = lifecycle.get("dominant_p99") or []
+    e2e = lifecycle.get("e2e") or {}
+    if not dominant or not e2e:
+        return []
+    top = dominant[0]
+    share = top.get("share_of_e2e_p99") or 0.0
+    runners = ", ".join(
+        f"{d.get('phase')}={d.get('p99_ms')}ms" for d in dominant[1:4])
+    return [{
+        "kind": "dominant_phase",
+        "severity": min(65.0, 25.0 + 40.0 * min(1.0, share)),
+        "subject": f"lifecycle phase '{top.get('phase')}'",
+        "detail": (f"p99 e2e {e2e.get('p99_ms')} ms over "
+                   f"{lifecycle.get('count')} invocations; "
+                   f"'{top.get('phase')}' p99 {top.get('p99_ms')} ms "
+                   f"({share:.0%} of the e2e p99)"
+                   + (f"; then {runners}" if runners else "")),
+    }]
+
+
+def check_slo(healthz: dict | None) -> list[dict]:
+    """Burning SLO targets (ISSUE 14): every declared target whose burn
+    rate exceeds the threshold on ALL evaluation windows."""
+    slo = (healthz or {}).get("slo") or {}
+    findings = []
+    for t in slo.get("targets") or []:
+        windows = t.get("windows") or {}
+        if not t.get("burning"):
+            continue
+        burns = ", ".join(f"{w}×{row.get('burn')}"
+                          for w, row in sorted(windows.items()))
+        findings.append({
+            "kind": "slo_burn",
+            "severity": 92.0,
+            "subject": f"SLO {t.get('name')}",
+            "detail": (f"burning its error budget on every window "
+                       f"(burn rates: {burns}; budget "
+                       f"{t.get('budget')}"
+                       + (f", threshold {t.get('threshold_ms')} ms"
+                          if t.get("threshold_ms") else "") + ")"),
+        })
+    return findings
+
+
+def _series_points(timeseries: dict | None, host: str,
+                   name: str) -> list[list]:
+    hosts = (timeseries or {}).get("hosts") or {}
+    return ((hosts.get(host) or {}).get("series") or {}).get(name) or []
+
+
+def _slope_per_s(points: list[list]) -> float:
+    """Least-squares slope of [[t, v], ...] (0 with <2 points)."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    ts = [p[0] for p in points]
+    vs = [p[1] for p in points]
+    mt, mv = sum(ts) / n, sum(vs) / n
+    var = sum((t - mt) ** 2 for t in ts)
+    if var <= 0:
+        return 0.0
+    return sum((t - mt) * (v - mv) for t, v in points) / var
+
+
+def check_queue_trend(timeseries: dict | None) -> list[dict]:
+    """Trends the point-in-time counters cannot show (ISSUE 14): an
+    ingress queue that keeps GROWING (backlog outrunning the ticks —
+    collapse in progress, not a burst), and free slots pinned at zero
+    while a backlog holds (capacity exhaustion)."""
+    findings = []
+    depth = _series_points(timeseries, "planner", "ingress_depth")
+    if len(depth) >= 5:
+        head = [v for _t, v in depth[:3]]
+        tail = [v for _t, v in depth[-3:]]
+        start = sum(head) / len(head)
+        end = sum(tail) / len(tail)
+        slope = _slope_per_s(depth)
+        if end >= 10 and end >= 2 * max(1.0, start) and slope > 0:
+            findings.append({
+                "kind": "queue_growth",
+                "severity": min(90.0, 45.0 + 5.0 * min(8.0, slope)),
+                "subject": "ingress admission queue",
+                "detail": (f"depth grew {start:.0f} → {end:.0f} over "
+                           f"{depth[-1][0] - depth[0][0]:.0f}s "
+                           f"({slope:+.1f}/s) — backlog is outrunning "
+                           "the scheduling ticks"),
+            })
+    free = _series_points(timeseries, "planner", "free_slots")
+    if len(free) >= 5 and len(depth) >= 1:
+        recent = [v for _t, v in free[-5:]]
+        backlog = depth[-1][1] if depth else 0
+        if max(recent) <= 0 and backlog > 0:
+            findings.append({
+                "kind": "capacity_exhausted",
+                "severity": 78.0,
+                "subject": "cluster capacity",
+                "detail": (f"free-slot watermark pinned at 0 for the "
+                           f"last {len(recent)} samples while "
+                           f"{backlog:.0f} messages queue — add "
+                           "capacity or shed harder"),
+            })
+    return findings
+
+
 def check_profile_matrix_agreement(perf: dict | None,
                                    commmatrix: dict | None) -> list[dict]:
     """Cross-check: per source host, the profile store's bytes-weighted
@@ -396,6 +520,9 @@ def diagnose(sources: dict) -> list[dict]:
                                  sources.get("topology"))
     findings += check_codec_escapes(sources.get("metrics"))
     findings += check_healthz(sources.get("healthz"))
+    findings += check_lifecycle(sources.get("healthz"))
+    findings += check_slo(sources.get("healthz"))
+    findings += check_queue_trend(sources.get("timeseries"))
     findings += check_profile_matrix_agreement(sources.get("perf"),
                                                sources.get("commmatrix"))
     findings.sort(key=lambda f: -f["severity"])
@@ -423,7 +550,9 @@ def render(findings: list[dict], top: int = 0) -> str:
 def selftest_sources() -> dict:
     """A synthetic 3-host cluster with one planted slow link (hA→hC at
     ~1/10 of the plane median), one planted straggler (rank 5 arriving
-    ~40 ms late every round) and a codec escape storm."""
+    ~40 ms late every round), a codec escape storm, a run-dominated
+    lifecycle tail, a burning p99 latency SLO and an ingress queue
+    growing through the time-series window (ISSUE 14)."""
     def link(src, dst, gibs, messages=200, nbytes=512 << 20):
         return {"src": src, "dst": dst, "plane": "bulk-tcp",
                 "codec": "raw", "size_class": "1MiB",
@@ -463,6 +592,22 @@ def selftest_sources() -> dict:
         "faabric_codec_escapes_total": [({"reason": "nack"}, 120.0),
                                         ({"reason": "crc"}, 30.0)],
     }
+    def phase(p50, p99):
+        return {"p50_ms": p50, "p90_ms": p99 * 0.8, "p99_ms": p99,
+                "mean_ms": p50, "count": 4000}
+
+    lifecycle_phases = {
+        "ingress_queue": phase(0.4, 2.0),
+        "schedule": phase(0.3, 1.1),
+        "dispatch": phase(0.2, 0.9),
+        "executor_queue": phase(1.0, 4.0),
+        "run": phase(20.0, 61.0),  # the planted dominant phase
+        "result_push": phase(0.3, 1.5),
+        "record": phase(0.5, 2.5),
+    }
+    e2e = phase(24.0, 68.0)
+    dominant = sorted(lifecycle_phases.items(),
+                      key=lambda kv: -kv[1]["p99_ms"])
     healthz = {
         "status": "ok",
         "hosts": [{"host": h, "keepAliveAgeSeconds": 1.0,
@@ -474,18 +619,54 @@ def selftest_sources() -> dict:
                     "dirty": False, "lastFsyncAgeSeconds": 0.01,
                     "fsyncIntervalSeconds": 0.05},
         "perf": {"lastAggregationAgeSeconds": 5.0},
+        "lifecycle": {
+            "count": 4000, "failed": 0, "e2e": e2e,
+            "phases": lifecycle_phases,
+            "dominant_p99": [
+                {"phase": label, "p99_ms": row["p99_ms"],
+                 "share_of_e2e_p99": round(row["p99_ms"]
+                                           / e2e["p99_ms"], 4)}
+                for label, row in dominant],
+        },
+        "slo": {
+            "spec": "p99_e2e_ms=50,error_rate=0.001",
+            "burnThreshold": 2.0, "windowsSeconds": [60, 600],
+            "ignored": [],
+            "targets": [
+                {"name": "p99_e2e_ms", "kind": "latency",
+                 "budget": 0.01, "threshold_ms": 50.0, "burning": True,
+                 "windows": {"60s": {"total": 800, "bad": 40,
+                                     "burn": 5.0},
+                             "600s": {"total": 4000, "bad": 160,
+                                      "burn": 4.0}}},
+                {"name": "error_rate", "kind": "error", "budget": 0.001,
+                 "threshold_ms": None, "burning": False,
+                 "windows": {"60s": {"total": 800, "bad": 0,
+                                     "burn": 0.0},
+                             "600s": {"total": 4000, "bad": 0,
+                                      "burn": 0.0}}}],
+        },
     }
+    # The planted queue growth: depth ramps 2 → 60 across the window
+    ts0 = 2000.0
+    depth_pts = [[ts0 + i, 2.0 + 2.0 * i] for i in range(30)]
+    timeseries = {"hosts": {"planner": {"series": {
+        "ingress_depth": depth_pts,
+        "free_slots": [[ts0 + i, max(0.0, 8.0 - i)] for i in range(30)],
+    }}}}
     topology = {"hosts": {}, "worlds": {
         "900": {"size": 8,
                 "hosts": {"hA": [0, 1, 2, 3], "hC": [4, 5, 6, 7]}}}}
     return {"perf": perf, "metrics": metrics, "commmatrix": None,
-            "healthz": healthz, "topology": topology}
+            "healthz": healthz, "topology": topology,
+            "timeseries": timeseries}
 
 
 def run_selftest() -> int:
     findings = diagnose(selftest_sources())
-    print(render(findings, top=10))
-    top_kinds = [f["kind"] for f in findings[:5]]
+    print(render(findings, top=14))
+    top_kinds = [f["kind"] for f in findings[:7]]
+    all_kinds = [f["kind"] for f in findings]
     problems = []
     slow = [f for f in findings if f["kind"] == "slow_link"]
     if not slow or "hA→hC" not in slow[0]["subject"]:
@@ -495,10 +676,24 @@ def run_selftest() -> int:
         problems.append("planted straggler rank 5 not found")
     if "hC" not in (stragglers[0]["subject"] if stragglers else ""):
         problems.append("straggler not attributed to its host hC")
-    if "codec_escape_storm" not in [f["kind"] for f in findings]:
+    if "codec_escape_storm" not in all_kinds:
         problems.append("planted escape storm not found")
     if "slow_link" not in top_kinds or "straggler" not in top_kinds:
         problems.append(f"planted faults not in top findings: {top_kinds}")
+    # ISSUE 14 analyzers: the run-dominated lifecycle tail, the burning
+    # latency SLO and the growing ingress queue must all be found
+    dominant = [f for f in findings if f["kind"] == "dominant_phase"]
+    if not dominant or "'run'" not in dominant[0]["subject"]:
+        problems.append("planted dominant phase 'run' not found")
+    slo_burns = [f for f in findings if f["kind"] == "slo_burn"]
+    if not slo_burns or "p99_e2e_ms" not in slo_burns[0]["subject"]:
+        problems.append("planted burning SLO p99_e2e_ms not found")
+    if "slo_burn" not in top_kinds:
+        problems.append(f"slo_burn not in top findings: {top_kinds}")
+    if "queue_growth" not in all_kinds:
+        problems.append("planted ingress queue growth not found")
+    if "capacity_exhausted" not in all_kinds:
+        problems.append("planted capacity exhaustion not found")
     if problems:
         print("doctor selftest FAILED:", "; ".join(problems))
         return 1

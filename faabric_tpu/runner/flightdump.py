@@ -2,6 +2,8 @@
 
     python -m faabric_tpu.runner.flightdump <dir> [--json] [--last N]
                                             [--kind K]
+    python -m faabric_tpu.runner.flightdump --url http://pl:8080 \
+                                            [--url http://w0:8081] ...
 
 Each process that hit a dump trigger (MpiWorldAborted, planner requeue,
 unhandled executor exception, SIGTERM) left one
@@ -9,6 +11,11 @@ unhandled executor exception, SIGTERM) left one
 (telemetry/flight.py). This tool merges their event rings onto one
 wall-clock timeline — the black-box readout after a chaos run or a
 production incident.
+
+``--url`` (repeatable; ISSUE 14 satellite) reads LIVE rings instead:
+every planner/worker HTTP endpoint serves its in-memory ring at
+``GET /flight``, so the black box is readable without waiting for a
+crash dump. Directory and URL sources merge together.
 """
 
 from __future__ import annotations
@@ -37,7 +44,33 @@ def load_dumps(directory: str) -> list[dict]:
     return dumps
 
 
-def merge(directory: str) -> list[dict]:
+def fetch_live_rings(urls: list[str], timeout: float = 10.0) -> list[dict]:
+    """One pseudo-dump per reachable ``GET /flight`` endpoint (live
+    rings have no dump trigger; ``reason`` reads ``live``). Unreachable
+    endpoints are skipped with a note — a half-dead cluster is exactly
+    when this tool runs."""
+    import time
+    import urllib.request
+
+    dumps = []
+    for url in urls:
+        full = url.rstrip("/")
+        if not full.endswith("/flight"):
+            full += "/flight"
+        try:
+            with urllib.request.urlopen(full, timeout=timeout) as resp:
+                body = json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 — degrade, never die
+            print(f"skipping {full}: {e}", file=sys.stderr)
+            continue
+        body.setdefault("reason", "live")
+        body.setdefault("dumped_at", time.time())
+        body["_file"] = full
+        dumps.append(body)
+    return dumps
+
+
+def merge_dumps(dumps: list[dict]) -> list[dict]:
     """All dumps' events on one timeline: each event gains ``process``/
     ``pid``/``dump_reason`` provenance and the list sorts by wall-clock
     timestamp (hosts share the tracer's wall-anchored convention).
@@ -46,9 +79,8 @@ def merge(directory: str) -> list[dict]:
     SIGTERM) left overlapping ring snapshots; events dedupe on
     (process, pid, ring seq), the NEWEST dump's copy winning, so the
     merged black box reports each real event once."""
-    dumps = load_dumps(directory)
     # Newest file last: its copy of a shared (pid, seq) event wins
-    dumps.sort(key=lambda d: d.get("dumped_at", 0.0))
+    dumps = sorted(dumps, key=lambda d: d.get("dumped_at", 0.0))
     by_key: dict[tuple, dict] = {}
     for dump in dumps:
         for e in dump.get("events", []):
@@ -61,6 +93,12 @@ def merge(directory: str) -> list[dict]:
     events = list(by_key.values())
     events.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
     return events
+
+
+def merge(directory: str) -> list[dict]:
+    """Directory-mode merge (the pre-ISSUE-14 entry point, kept for
+    callers and tests)."""
+    return merge_dumps(load_dumps(directory))
 
 
 def _fmt_fields(event: dict) -> str:
@@ -87,8 +125,11 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="faabric_tpu.runner.flightdump",
         description="Merge + pretty-print flight-recorder dumps")
-    parser.add_argument("directory", nargs="?",
-                        default=os.environ.get("FAABRIC_FLIGHT_DIR", "."))
+    parser.add_argument("directory", nargs="?", default=None)
+    parser.add_argument("--url", action="append", default=[],
+                        help="live planner/worker HTTP endpoint(s); "
+                        "reads GET /flight instead of (or merged with) "
+                        "dump files")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable merged event list")
     parser.add_argument("--last", type=int, default=None,
@@ -97,7 +138,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="filter by event kind (e.g. group_abort)")
     args = parser.parse_args(argv)
 
-    events = merge(args.directory)
+    directory = args.directory
+    if directory is None and not args.url:
+        directory = os.environ.get("FAABRIC_FLIGHT_DIR", ".")
+    dumps = load_dumps(directory) if directory else []
+    dumps += fetch_live_rings(args.url)
+    events = merge_dumps(dumps)
     if args.kind:
         events = [e for e in events if e.get("kind") == args.kind]
     if args.json:
@@ -105,9 +151,9 @@ def main(argv: list[str] | None = None) -> int:
             events = events[-args.last:]
         print(json.dumps(events, indent=1))
     else:
-        dumps = load_dumps(args.directory)
-        print(f"{len(dumps)} dump(s), {len(events)} event(s) "
-              f"from {args.directory}")
+        where = " + ".join(filter(None, [directory] + args.url))
+        print(f"{len(dumps)} dump(s)/ring(s), {len(events)} event(s) "
+              f"from {where}")
         print(render(events, last=args.last))
     return 0 if events else 1
 

@@ -129,6 +129,14 @@ class WorkerRuntime:
         self.function_server.start()
         self.scheduler.start()
         self._start_extra_servers()
+        # Time-series ring (ISSUE 14): every worker samples its own
+        # process gauges + executor load; the planner merges the rings
+        # behind GET /timeseries. Shared, refcounted sampler thread.
+        from faabric_tpu.telemetry import get_timeseries, start_sampler
+
+        self._executors_gauge = self.scheduler.get_executor_count
+        get_timeseries().register("executors", self._executors_gauge)
+        start_sampler()
         if register:
             self.planner_client.register_host(
                 self.slots, self.n_devices, overwrite=True,
@@ -179,6 +187,15 @@ class WorkerRuntime:
         if not self._started:
             return
         self._started = False
+        from faabric_tpu.telemetry import get_timeseries, stop_sampler
+
+        stop_sampler()
+        # Drop OUR gauge registration (fn-matched): it would pin this
+        # runtime's scheduler for the rest of the process; a co-resident
+        # runtime that re-registered the name keeps its series
+        get_timeseries().unregister("executors",
+                                    getattr(self, "_executors_gauge",
+                                            None))
         if remove_host:
             # Best-effort by design: remove_host flushes any results
             # buffered during a planner outage, then deregisters; both

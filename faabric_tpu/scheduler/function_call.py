@@ -134,14 +134,20 @@ class FunctionCallClient(MessageEndpointClient):
             return
         self.sync_send(int(FunctionCalls.FLUSH))
 
-    def get_telemetry(self, include_trace: bool = False) -> dict:
+    def get_telemetry(self, include_trace: bool = False,
+                      blocks: tuple[str, ...] | None = None) -> dict:
         """This host's local metrics snapshot (and optionally its trace
         buffer) — the wire the planner aggregates ``GET /metrics`` and
-        ``GET /trace`` from."""
+        ``GET /trace`` from. ``blocks`` narrows the response to the
+        named blocks (e.g. ``("timeseries",)`` for the continuously
+        polled trend surface — a trend poll must not pay for the full
+        metrics + comm-matrix + perf payload per host per tick)."""
         if is_mock_mode():
             return {"metrics": {}, "trace": []}
-        resp = self.sync_send(int(FunctionCalls.GET_TELEMETRY),
-                              {"trace": bool(include_trace)},
+        header: dict = {"trace": bool(include_trace)}
+        if blocks is not None:
+            header["blocks"] = list(blocks)
+        resp = self.sync_send(int(FunctionCalls.GET_TELEMETRY), header,
                               idempotent=True)
         import json as _json
 
@@ -212,17 +218,35 @@ class FunctionCallServer(MessageEndpointServer):
 
             from faabric_tpu.telemetry import (
                 get_comm_matrix,
+                get_lifecycle_stats,
                 get_metrics,
+                get_proc_stats,
+                get_timeseries,
                 perf_telemetry_block,
                 trace_events,
             )
 
-            body: dict = {"metrics": get_metrics().snapshot(),
-                          "commmatrix": get_comm_matrix().snapshot(),
-                          # ISSUE 12: this host's rolling link profiles
-                          # + collective phase series, aggregated by the
-                          # planner behind GET /perf
-                          "perf": perf_telemetry_block()}
+            # Fresh process gauges on every scrape (ISSUE 14 satellite)
+            get_proc_stats().refresh()
+            # Lazy per-block builders: a blocks-narrowed request (the
+            # continuously polled /timeseries trend surface) must not
+            # pay for the full metrics/comm-matrix/perf serialization
+            builders = {
+                "metrics": lambda: get_metrics().snapshot(),
+                "commmatrix": lambda: get_comm_matrix().snapshot(),
+                # ISSUE 12: this host's rolling link profiles +
+                # collective phase series (GET /perf)
+                "perf": perf_telemetry_block,
+                # ISSUE 14: lifecycle digest (mostly planner-side, but
+                # workers fold nothing and ship an empty block) + this
+                # host's time-series ring
+                "lifecycle": lambda: get_lifecycle_stats().snapshot(),
+                "timeseries": lambda: get_timeseries().snapshot(),
+            }
+            wanted = msg.header.get("blocks")
+            body: dict = {name: build() for name, build in
+                          builders.items()
+                          if wanted is None or name in wanted}
             if msg.header.get("trace"):
                 body["trace"] = trace_events()
             # Payload, not header: a full trace buffer is bulk data

@@ -199,6 +199,25 @@ def flight_record(kind: str, **fields) -> None:
     get_flight().record(kind, **fields)
 
 
+def live_ring_doc() -> dict:
+    """This process's LIVE ring as one JSON-safe document — the body
+    both the planner and worker HTTP endpoints serve at ``GET /flight``
+    (one schema, one place; ``flightdump --url`` merges on it)."""
+    try:
+        from faabric_tpu.telemetry.tracer import get_tracer
+
+        label = get_tracer().process_label
+    except Exception:  # noqa: BLE001 — label is cosmetic
+        label = f"pid-{os.getpid()}"
+    ring = get_flight()
+    return {
+        "process": label,
+        "pid": os.getpid(),
+        "ring_size": ring.size,
+        "events": ring.events(),
+    }
+
+
 def flight_dump(reason: str):
     return get_flight().dump(reason)
 

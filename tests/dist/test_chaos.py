@@ -208,6 +208,73 @@ def test_chaos_kill_worker_mid_batch_requeues_and_completes():
 
 
 @pytest.mark.slow
+def test_chaos_requeued_invocations_carry_spanning_ledger():
+    """ISSUE 14 satellite: SIGKILL a worker mid-batch and assert the
+    requeued invocations' lifecycle ledgers span BOTH attempts — admit
+    stamped on the original submission, a ``requeue`` stamp at the
+    recovery boundary, and the second attempt's run/result stamps after
+    it — so a post-mortem can read exactly where the recovery seconds
+    went."""
+    from faabric_tpu.telemetry.lifecycle import (
+        PHASE_ADMIT,
+        PHASE_RECORDED,
+        PHASE_REQUEUE,
+        PHASE_RUN_START,
+        ledger_durations,
+    )
+
+    cluster = ChaosCluster(
+        "ckL", n_workers=2, slots=(8, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "3",
+                   "PLANNER_REQUEUE_BACKOFF": "0.3",
+                   "PLANNER_MAX_REQUEUES": "5"}).start()
+    try:
+        me = cluster.me
+        wa, wb = cluster.workers
+        req = batch_exec_factory("dist", "sleep", 12)
+        for m in req.messages:
+            m.input_data = b"2.5"
+        decision = me.planner_client.call_functions(req)
+        victims = {decision.message_ids[i]
+                   for i, h in enumerate(decision.hosts) if h == wb}
+        assert victims, f"nothing placed on {wb}"
+
+        time.sleep(0.5)  # genuinely mid-flight
+        cluster.kill(wb)
+
+        status = wait_finished(me, req.app_id, timeout=60)
+        assert len(status.message_results) == 12
+        requeued = [m for m in status.message_results
+                    if m.id in victims]
+        assert requeued
+        for m in status.message_results:
+            assert m.return_value == int(ReturnValue.SUCCESS), \
+                m.output_data
+            assert PHASE_ADMIT in m.lc and PHASE_RECORDED in m.lc, \
+                sorted(m.lc)
+        for m in requeued:
+            lc = m.lc
+            # The requeue boundary is visible and ordered: admit
+            # (attempt 1) < requeue < second attempt's run < record
+            assert PHASE_REQUEUE in lc, sorted(lc)
+            assert lc[PHASE_ADMIT] < lc[PHASE_REQUEUE], lc
+            assert lc[PHASE_REQUEUE] < lc[PHASE_RUN_START], lc
+            assert lc[PHASE_RUN_START] < lc[PHASE_RECORDED], lc
+            d = ledger_durations(lc)
+            # Detection (3s expiry) + backoff dominates: the requeue
+            # phase carries real recovery seconds, not noise
+            assert d["requeue"] > 0.5, d
+            assert m.executed_host == wa
+        # Survivors' ledgers carry NO requeue boundary
+        untouched = [m for m in status.message_results
+                     if m.id not in victims]
+        assert untouched
+        assert all(PHASE_REQUEUE not in m.lc for m in untouched)
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
 def test_chaos_mpi_world_abort_is_bounded():
     """SIGKILL a worker hosting half an MPI world mid-collective: the
     surviving ranks raise MpiWorldAborted within the liveness-check

@@ -108,6 +108,53 @@ def test_gate_hier_keys_promoted_to_gated(tmp_path, capsys):
     assert bench_gate.direction("cross_host_bytes_ratio") == -1
 
 
+def test_gate_alltoall_keys_promoted_to_gated(tmp_path, capsys):
+    """ISSUE 14 satellite: the ISSUE 13 schedule-compiler keys
+    graduated from REPORTED_ONLY after their first recorded round (the
+    standard one-round deferral ratchet) — a >20% move in the bad
+    direction now FAILS the gate."""
+    for key in ("host_alltoall_gibs", "alltoall_cross_host_bytes_ratio",
+                "alltoall_cross_host_msgs_ratio"):
+        assert key not in bench_gate.REPORTED_ONLY
+    # directions: rate is higher-better, the ratios lower-better
+    assert bench_gate.direction("host_alltoall_gibs") == 1
+    assert bench_gate.direction("alltoall_cross_host_bytes_ratio") == -1
+    assert bench_gate.direction("alltoall_cross_host_msgs_ratio") == -1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"host_alltoall_gibs": 2.0,
+                  "alltoall_cross_host_bytes_ratio": 1.0,
+                  "alltoall_cross_host_msgs_ratio": 0.14})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"host_alltoall_gibs": 1.2,                    # -40%
+                  "alltoall_cross_host_bytes_ratio": 1.5,       # +50%
+                  "alltoall_cross_host_msgs_ratio": 0.5})       # +257%
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "FAILED (3 regression(s))" in out
+    assert "host_alltoall_gibs" in out
+    assert "alltoall_cross_host_bytes_ratio" in out
+    assert "alltoall_cross_host_msgs_ratio" in out
+
+
+def test_gate_lifecycle_plane_keys_reported_only_first_round(tmp_path,
+                                                             capsys):
+    """ISSUE 14 first-round keys: the ledger stamp cost and the folded
+    e2e p99 are tracked but not gated until a round of spread exists
+    (promote next round, the standard ratchet)."""
+    for key in ("lifecycle_stamp_ns", "invocation_p99_ms"):
+        assert key in bench_gate.REPORTED_ONLY
+        assert bench_gate.direction(key) == -1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"lifecycle_stamp_ns": 110.0,
+                  "invocation_p99_ms": 40.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"lifecycle_stamp_ns": 400.0,    # +264%: reported only
+                  "invocation_p99_ms": 160.0})
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "lifecycle_stamp_ns" in out and "reported-only" in out
+
+
 def test_gate_device_plane_key_reported_only_first_round(tmp_path,
                                                          capsys):
     """ISSUE 10 first-round key: the device-plane allreduce rate is

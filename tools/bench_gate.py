@@ -67,15 +67,18 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # feed's per-sample cost, its metrics-off no-op floor, and the doctor's
 # synthetic-cluster end-to-end runtime — reported until a round of
 # spread exists, then promote like the ISSUE 9/10 keys were.
-# ISSUE 13 schedule-compiler keys (first recorded round, promote next):
-# compiled-alltoall rate over 4 simulated hosts; bytes_ratio models
-# ≈1.0 (alltoall is a permutation — byte PARITY is the accounting
-# check, unlike allreduce's 1/m saving) and msgs_ratio models
-# ≈1/ranks-per-host² (+ the one-shot selection broadcast).
+# PROMOTED (ISSUE 14 satellite): host_alltoall_gibs,
+# alltoall_cross_host_bytes_ratio and alltoall_cross_host_msgs_ratio
+# graduated after their first recorded round (the ISSUE 13 deferral,
+# same one-round ratchet as the ISSUE 9/10 promotions) — they now gate
+# like any other key.
+# ISSUE 14 lifecycle keys (first recorded round, promote next):
+# lifecycle_stamp_ns is the enabled per-stamp ledger cost (~100 ns
+# target) and invocation_p99_ms the planner-folded admit→record e2e
+# p99 under the concurrent QPS workload (log-bucket quantile —
+# coarse by construction, so it rides reported-only first).
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
-                 "host_alltoall_gibs",
-                 "alltoall_cross_host_bytes_ratio",
-                 "alltoall_cross_host_msgs_ratio",
+                 "lifecycle_stamp_ns", "invocation_p99_ms",
                  "host_allreduce_device_gibs",
                  "allreduce_quant_max_abs_err",
                  "host_allreduce_procs_raw_gibs",
