@@ -11,18 +11,36 @@ through the host planes.
   registration, the one-shot allgather exchange, and the deterministic
   mesh-resolution verdict (``MeshMismatch`` → host ladder).
 - :mod:`plane` — :class:`DevicePlane`: the per-world rendezvous
-  executor, the (kind, op, elems, dtype)-keyed compiled-executable
-  cache with input donation, the eligibility/fallback ladder, and the
+  executor, the (kind, op, elems, dtype, resident)-keyed
+  compiled-executable cache, the residency-aware zero-host-copy path
+  for committed ``jax.Array`` deposits (ISSUE 15), the
+  eligibility/fallback ladder, ``ring_permute``, and the
   ``plane=device`` comm-matrix + ``phase=compile|execute`` telemetry.
+- :mod:`copies` — host↔device copy accounting
+  (``faabric_device_copy_*``): the auditable surface behind the
+  "zero host copies for a device-resident collective" invariant.
+- :mod:`pallas_ring` — the ring-permute p2p primitive: a Pallas
+  ``make_async_remote_copy`` kernel on TPU, ``lax.ppermute``
+  elsewhere, plus the ``device-ring`` schedule-runner execution
+  target.
 
 Entry point: ``MpiWorld.activate_device_plane(rank, ...)`` — a
 collective call every rank makes once after the world forms (and after
 any migration remap); see docs/data_plane.md.
 """
 
+import faabric_tpu.device_plane.pallas_ring  # noqa: F401 — registers
+# the device-ring schedule execution target at package import
+from faabric_tpu.device_plane.copies import (
+    count_copy,
+    device_copy_totals,
+    reset_device_copy_totals,
+)
 from faabric_tpu.device_plane.plane import (
     DEVICE_PLANE_TIMEOUT_S,
     DevicePlane,
+    device_planes_summary,
+    is_device_payload,
 )
 from faabric_tpu.device_plane.registry import (
     DevicePlaneFallback,
@@ -37,7 +55,12 @@ __all__ = [
     "DevicePlane",
     "DevicePlaneFallback",
     "MeshMismatch",
+    "count_copy",
+    "device_copy_totals",
+    "device_planes_summary",
+    "is_device_payload",
     "registration_row",
+    "reset_device_copy_totals",
     "resolve_local_device",
     "resolve_mesh",
 ]

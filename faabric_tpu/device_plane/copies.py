@@ -1,0 +1,101 @@
+"""Host↔device copy accounting (ISSUE 15).
+
+"Zero host copies for a device-resident collective" is the tentpole
+invariant of the device-resident array plane — and an invariant nobody
+counts is a claim, not a property. Every byte the device plane (and the
+HBM state/snapshot tier riding on it) moves across the host↔device
+boundary is stamped here, in BOTH directions, tagged with why it moved:
+
+- ``h2d`` / ``input``      — a host contribution placed onto its chip
+  before a compiled collective (the PR 10 path; the cost the
+  device-resident path exists to delete);
+- ``d2h`` / ``readback``   — a collective result pulled back to a host
+  buffer (ditto);
+- ``d2h`` / ``staging``    — the *explicit* fallback copy: a
+  device-resident payload that could not ride the device rung
+  (ineligible op/dtype, inactive plane, mixed-residency round) staged
+  to host exactly once before the host ladder runs;
+- ``h2d`` / ``state``, ``d2h`` / ``state`` — HBM state-handle
+  materialization (state/device_handle.py);
+- ``d2h`` / ``snapshot``, ``h2d`` / ``snapshot`` — device-snapshot page
+  flags/diffs/restores (snapshot/device_snapshot.py).
+
+Two surfaces: the global metrics registry
+(``faabric_device_copy_total`` / ``faabric_device_copy_bytes_total``
+with ``direction``+``reason`` labels, so ``/metrics`` exports them) and
+an always-on process-local totals table read by
+``DevicePlane.summary()``, bench sections and the zero-copy assertions
+— counting must not vanish when ``FAABRIC_METRICS=0`` flips the
+registry handles to no-ops, or the invariant becomes untestable in
+metrics-off runs.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from faabric_tpu.telemetry import get_metrics
+
+H2D = "h2d"
+D2H = "d2h"
+
+_metrics = get_metrics()
+
+# (direction, reason) → (count handle, bytes handle); created lazily so
+# only reasons that actually fire appear in the exposition
+_handles: dict = {}
+_handles_lock = threading.Lock()
+
+# Always-on local totals: (direction, reason) → [count, bytes]
+_totals: dict = {}
+_totals_lock = threading.Lock()
+
+
+def count_copy(direction: str, nbytes: int, reason: str) -> None:
+    """Stamp one host↔device transfer of ``nbytes`` bytes."""
+    key = (direction, reason)
+    pair = _handles.get(key)
+    if pair is None:
+        with _handles_lock:
+            pair = _handles.get(key)
+            if pair is None:
+                pair = (
+                    _metrics.counter(
+                        "faabric_device_copy_total",
+                        "Host<->device transfers performed by the device "
+                        "plane / HBM state tier",
+                        direction=direction, reason=reason),
+                    _metrics.counter(
+                        "faabric_device_copy_bytes_total",
+                        "Bytes moved across the host<->device boundary "
+                        "by the device plane / HBM state tier",
+                        direction=direction, reason=reason),
+                )
+                _handles[key] = pair
+    pair[0].inc()
+    pair[1].inc(int(nbytes))
+    with _totals_lock:
+        t = _totals.get(key)
+        if t is None:
+            t = _totals[key] = [0, 0]
+        t[0] += 1
+        t[1] += int(nbytes)
+
+
+def device_copy_totals() -> dict:
+    """Process-wide snapshot: per-(direction, reason) counts/bytes plus
+    roll-ups — what ``DevicePlane.summary()``, bench sections and the
+    zero-copy tests read."""
+    with _totals_lock:
+        rows = {f"{d}.{r}": {"count": t[0], "bytes": t[1]}
+                for (d, r), t in _totals.items()}
+        count = sum(t[0] for t in _totals.values())
+        nbytes = sum(t[1] for t in _totals.values())
+    return {"count": count, "bytes": nbytes, "by_reason": rows}
+
+
+def reset_device_copy_totals() -> None:
+    """Test hook: zero the local totals (metrics counters are monotonic
+    and stay — tests diff those via snapshots instead)."""
+    with _totals_lock:
+        _totals.clear()

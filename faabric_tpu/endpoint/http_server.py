@@ -239,8 +239,19 @@ class PlannerHttpEndpoint:
         """Cluster topology snapshot (ISSUE 9): per-host capacity plus
         the rank→host Topology of every in-flight gang-scheduled MPI
         world — the scrape surface for dashboards and placement
-        debugging (`Planner.get_cluster_topology`)."""
-        return json.dumps(self.planner.get_cluster_topology())
+        debugging (`Planner.get_cluster_topology`). ISSUE 15: each
+        host's live device-plane summaries ride along under
+        ``device_planes`` — executable-cache stats (entries / hits /
+        compiles / compile ms) and host↔device copy accounting, so the
+        doctor can attribute a first-call latency spike to a device
+        compile instead of guessing."""
+        doc = self.planner.get_cluster_topology()
+        tel = self.planner.collect_telemetry(blocks=("device_planes",))
+        doc["device_planes"] = {
+            host: t.get("device_planes") or []
+            for host, t in tel.items()
+            if t.get("device_planes")}
+        return json.dumps(doc)
 
     def trace_json(self) -> str:
         """Chrome trace_event JSON merging every host's span buffer onto

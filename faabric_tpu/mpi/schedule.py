@@ -61,6 +61,57 @@ class ScheduleError(Exception):
     pass
 
 
+# ---------------------------------------------------------------------------
+# Execution targets (ISSUE 15)
+# ---------------------------------------------------------------------------
+# A compiled schedule may annotate a phase with an execution TARGET
+# (``spec["targets"] = {phase: name}``): an alternative executor the
+# runner offers the phase's step group to before falling back to the
+# per-step host path. The canonical target is ``device-ring``
+# (device_plane/pallas_ring.py) — annotated permute phases run as
+# compiled device mesh steps (Pallas ``make_async_remote_copy`` over
+# ICI on TPU) instead of 2(n−1) host messages. Targets must DECLINE
+# (return None from ``try_run``) on any mismatch, and their verdict
+# must be world-symmetric: a rank-dependent accept/decline would desync
+# the message pattern exactly like a desynced family choice.
+
+_STEP_TARGETS: dict[str, object] = {}
+_STEP_TARGETS_LOCK = threading.Lock()
+
+
+def register_step_target(target) -> None:
+    """Register (or replace) an execution target under ``target.name``.
+    Targets expose ``try_run(world, rank, sched, phase, steps, env,
+    resolver) -> int | None`` — the number of leading steps executed,
+    or None to decline."""
+    with _STEP_TARGETS_LOCK:
+        _STEP_TARGETS[target.name] = target
+
+
+def get_registered_target(name: str):
+    with _STEP_TARGETS_LOCK:
+        return _STEP_TARGETS.get(name)
+
+
+def get_step_target(name: str):
+    """Runner-side lookup; lazily arms the built-in device-ring target
+    so schedules annotated with it work without any import order
+    ceremony (the device plane may not have been touched yet when the
+    first annotated schedule executes)."""
+    t = get_registered_target(name)
+    if t is None and name == "device-ring":
+        try:
+            from faabric_tpu.device_plane.pallas_ring import (
+                ensure_registered,
+            )
+
+            ensure_registered()
+        except Exception:  # noqa: BLE001 — targets are an optimization
+            return None
+        t = get_registered_target(name)
+    return t
+
+
 class ScheduleVerificationError(ScheduleError):
     """The schedule does not prove exactly-once delivery."""
 

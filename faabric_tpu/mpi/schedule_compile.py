@@ -86,6 +86,7 @@ FAMILIES = (
     "allreduce.hier",
     "reduce_scatter.hier",
     "allgather.hier",
+    "allgather.ring",
 )
 FAMILY_IDS = {f: i for i, f in enumerate(FAMILIES)}
 
@@ -458,6 +459,36 @@ def _allgather_hier(topo) -> Schedule:
     return p.build("allgather.hier", "allgather")
 
 
+def _allgather_ring(topo) -> Schedule:
+    """Flat shift-1 ring allgather (ISSUE 15): n−1 rounds of "send the
+    block I most recently hold to my right neighbour, receive the left
+    neighbour's" — the bandwidth-optimal pattern on a ring, and every
+    wire leg a pure uniform-shift permute. The ``ring`` phase is
+    annotated with the ``device-ring`` execution target: on an
+    activated device world the runner executes each round as ONE
+    compiled mesh permute (Pallas ``make_async_remote_copy`` over ICI
+    on TPU, ``lax.ppermute`` elsewhere) instead of 2(n−1) host
+    messages; without a device plane the same verified steps run on the
+    host path unchanged. ``ring_uniform`` records the compile-time
+    guarantee the target relies on: every block resolves to the same
+    element count (allgather contributions are uniform by contract)."""
+    n = topo.size
+    if n < 2:
+        raise ScheduleError("allgather.ring needs at least 2 ranks")
+    p = _Prog(n)
+    for r in range(n):
+        p.copy(r, ("out", r), ("in", 0), "assemble")
+    for step in range(n - 1):
+        for r in range(n):
+            seg = (r - step) % n
+            p.send(r, (r + 1) % n, [("out", seg)], [BLK(seg)], "ring")
+            p.recv(r, (r - 1) % n, [("out", (r - step - 1) % n)],
+                   [BLK((r - step - 1) % n)], "ring")
+    return p.build("allgather.ring", "allgather",
+                   spec={"targets": {"ring": "device-ring"},
+                         "ring_uniform": True})
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -471,6 +502,7 @@ _LOWERINGS = {
     "allreduce.hier": lambda topo, root: _allreduce_hier(topo),
     "reduce_scatter.hier": lambda topo, root: _reduce_scatter_hier(topo),
     "allgather.hier": lambda topo, root: _allgather_hier(topo),
+    "allgather.ring": lambda topo, root: _allgather_ring(topo),
 }
 
 
@@ -580,7 +612,12 @@ def choose_family(collective: str, topo, nbytes: int, mode,
         return "scan.chain"
     if collective in ("allreduce", "reduce_scatter", "allgather"):
         # Only reachable under force + world.sched_reductions; the flat
-        # shapes keep the tuned hand-written executors
+        # shapes keep the tuned hand-written executors. Allgather over
+        # a one-rank-per-host placement (the TPU gang shape: every rank
+        # its own process/chip) lowers to the flat ring whose permute
+        # legs the device-ring target can execute on the mesh.
+        if collective == "allgather" and topo.n_hosts == topo.size:
+            return "allgather.ring"
         return f"{collective}.hier"
     raise ScheduleError(f"No schedule families for {collective!r}")
 

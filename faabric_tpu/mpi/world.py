@@ -968,13 +968,22 @@ class MpiWorld:
         reference moves (assembly copies where ownership demands).
         Per-phase spans ride ``mpi.phase`` like the hand-written
         hierarchical paths, so /perf's critical path decomposes
-        schedule rounds the same way."""
+        schedule rounds the same way.
+
+        Phases annotated with an execution TARGET (``spec["targets"]``,
+        ISSUE 15 — the device-ring permute executor) are offered to the
+        registered target first; a decline (None) or a partial run (the
+        target returns how many leading steps it executed) falls
+        through to the per-step host path for the remainder, so a
+        target can never change the message pattern it does not fully
+        own."""
         from faabric_tpu.mpi.schedule import (
             COPY,
             FOLD,
             RECV,
             SEND,
             ScheduleError,
+            get_step_target,
         )
 
         if not sched.verified:
@@ -982,10 +991,22 @@ class MpiWorld:
                 f"refusing to execute unverified schedule {sched.name}")
         steps = sched.steps.get(rank, ())
         traced = tracing_enabled()
+        phase_targets = sched.spec.get("targets") or {}
         for phase, group in self._sched_phase_groups(steps):
+            done = 0
+            tname = phase_targets.get(phase)
+            if tname:
+                target = get_step_target(tname)
+                if target is not None:
+                    handled = target.try_run(self, rank, sched, phase,
+                                             group, env, resolver)
+                    if handled:
+                        done = handled
+            if done >= len(group):
+                continue
             with span("mpi.phase", phase or "run", rank=rank) \
                     if traced else NULL_SPAN:
-                for st in group:
+                for st in group[done:]:
                     if st.op == SEND:
                         bufs = [np.asarray(env[k]).reshape(-1)
                                 for k in st.keys]
@@ -1323,9 +1344,30 @@ class MpiWorld:
             send_chunk(leader, flat[lo:hi])
         return None
 
-    def allreduce(self, rank: int, data: np.ndarray,
-                  op: MpiOp = MpiOp.SUM) -> np.ndarray:
-        arr = np.asarray(data)
+    def _stage_host(self, arr):
+        """Device-resident payloads that cannot (or did not) ride the
+        device rung take ONE explicit device→host staging copy —
+        counted on the ``faabric_device_copy_*`` surface (reason
+        ``staging``) so the fallback cost is observable, never silent.
+        Host arrays pass through untouched."""
+        from faabric_tpu.device_plane.plane import is_device_payload
+
+        if not is_device_payload(arr):
+            return arr
+        from faabric_tpu.device_plane.copies import D2H, count_copy
+
+        out = np.asarray(arr)
+        count_copy(D2H, int(out.nbytes), "staging")
+        return out
+
+    def allreduce(self, rank: int, data, op: MpiOp = MpiOp.SUM):
+        from faabric_tpu.device_plane.plane import is_device_payload
+
+        # jax.Array payloads stay device-resident through dispatch: the
+        # eligibility question is answered from shape/dtype alone and
+        # the device rung consumes the array in place (ISSUE 15). Only
+        # a host-ladder fallback materializes it (one counted copy).
+        arr = data if is_device_payload(data) else np.asarray(data)
         if not _PROFILER.enabled:
             return self._allreduce_entry(rank, arr, op)
         # Collective fold-in (ISSUE 12): the wall-anchored ENTRY stamp
@@ -1359,6 +1401,7 @@ class MpiWorld:
             out = self._try_device("allreduce", dplane, rank, arr, op)
             if out is not None:
                 return out
+        arr = self._stage_host(arr)
         if self._sched_reduction_eligible(op):
             return self._reduction_sched(rank, "allreduce", arr, op)
         use_hier = self._hier_eligible(arr, op)
@@ -2098,14 +2141,17 @@ class MpiWorld:
                 parts.append(arr)
         return np.concatenate(parts), [int(p.size) for p in parts]
 
-    def reduce_scatter(self, rank: int, data: np.ndarray,
-                       op: MpiOp = MpiOp.SUM) -> np.ndarray:
+    def reduce_scatter(self, rank: int, data,
+                       op: MpiOp = MpiOp.SUM):
         """MPI_Reduce_scatter_block: reduce (size·k,) contributions, rank
         r keeps segment r (reference composes it the same way: reduce to
         root + scatter). Large same-machine payloads take the ring's
         reduce-scatter phase directly — every rank folds 1/np per step
         and the root never materialises the full reduction."""
-        data = np.asarray(data).reshape(-1)
+        from faabric_tpu.device_plane.plane import is_device_payload
+
+        data = (data.reshape(-1) if is_device_payload(data)
+                else np.asarray(data).reshape(-1))
         if not _PROFILER.enabled:
             return self._reduce_scatter_entry(rank, data, op)
         _PROFILER.record_phase(self.id, "reduce_scatter", rank,
@@ -2131,6 +2177,7 @@ class MpiWorld:
                                    op)
             if out is not None:
                 return out
+        data = self._stage_host(data)
         if self._sched_reduction_eligible(op):
             return self._reduction_sched(rank, "reduce_scatter", data, op)
         # Scattered (non-gang-contiguous) placements compose too: the
@@ -2278,8 +2325,10 @@ class MpiWorld:
         restore()
         return out
 
-    def allgather(self, rank: int, data: np.ndarray) -> np.ndarray:
-        data = np.asarray(data)
+    def allgather(self, rank: int, data):
+        from faabric_tpu.device_plane.plane import is_device_payload
+
+        data = data if is_device_payload(data) else np.asarray(data)
         if not _PROFILER.enabled:
             return self._allgather_entry(rank, data)
         _PROFILER.record_phase(self.id, "allgather", rank, "enter_ts",
@@ -2303,6 +2352,7 @@ class MpiWorld:
             out = self._try_device("allgather", dplane, rank, data)
             if out is not None:
                 return out
+        data = self._stage_host(data)
         if self._sched_reduction_eligible() and data.size > 0:
             return self._reduction_sched(rank, "allgather", data, None)
         # Hierarchy pays off once the OUTPUT (size × contribution) is
@@ -2729,6 +2779,14 @@ class MpiWorld:
             # Post-migration the rank→device map is stale: the rung
             # drops until every rank re-runs the activation handshake
             self._device_plane = None
+        # Outstanding device-resident state handles (ISSUE 15) point at
+        # HBM on the PRE-migration chip assignment: drop them all (the
+        # re-handshake path re-pushes, minting fresh-generation
+        # handles) so a migrated rank can never pull a stale reference.
+        # Flight-recorded inside invalidate_world.
+        from faabric_tpu.state.device_handle import invalidate_world
+
+        invalidate_world(self.id)
         watch = getattr(self.broker, "watch_group", None)
         if watch is not None:
             watch(self.group_id)  # liveness checking follows the new gid

@@ -2248,12 +2248,17 @@ class Planner:
 
         # Fresh process gauges on every scrape, sampler or not
         get_proc_stats().refresh()
+        from faabric_tpu.device_plane.plane import device_planes_summary
+
         builders = {
             "metrics": lambda: get_metrics().snapshot(),
             "commmatrix": lambda: get_comm_matrix().snapshot(),
             "perf": perf_telemetry_block,
             "lifecycle": lambda: get_lifecycle_stats().snapshot(),
             "timeseries": lambda: get_timeseries().snapshot(),
+            # ISSUE 15: live device-plane summaries (executable-cache
+            # stats, copy accounting) — GET /topology's device block
+            "device_planes": device_planes_summary,
         }
         out: dict = {"planner": {name: build() for name, build in
                                  builders.items()

@@ -154,6 +154,12 @@ class FunctionCallClient(MessageEndpointClient):
         return _json.loads(resp.payload.decode()) if resp.payload else {}
 
 
+def _device_planes_block() -> list:
+    from faabric_tpu.device_plane.plane import device_planes_summary
+
+    return device_planes_summary()
+
+
 def _message_to_wire(msg: Message) -> tuple[dict, bytes]:
     from faabric_tpu.proto import messages_to_wire
 
@@ -242,6 +248,10 @@ class FunctionCallServer(MessageEndpointServer):
                 # host's time-series ring
                 "lifecycle": lambda: get_lifecycle_stats().snapshot(),
                 "timeseries": lambda: get_timeseries().snapshot(),
+                # ISSUE 15: this host's live device-plane summaries
+                # (executable-cache stats + copy accounting) for the
+                # planner's GET /topology device block
+                "device_planes": _device_planes_block,
             }
             wanted = msg.header.get("blocks")
             body: dict = {name: build() for name, build in

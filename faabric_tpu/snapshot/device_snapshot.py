@@ -36,6 +36,7 @@ import functools
 
 import numpy as np
 
+from faabric_tpu.device_plane.copies import D2H, H2D, count_copy
 from faabric_tpu.snapshot.snapshot import SnapshotData, SnapshotDiff
 
 DEVICE_PAGE_SIZE = 4096
@@ -141,8 +142,13 @@ class DeviceSnapshot:
 
     # ------------------------------------------------------------------
     def _flags_w(self, w) -> np.ndarray:
-        return np.asarray(_flags_fn(self.n_words, self.page_words,
-                                    self._word.name)(self._baseline_w, w))
+        flags = np.asarray(_flags_fn(self.n_words, self.page_words,
+                                     self._word.name)(self._baseline_w, w))
+        # The architectural point of on-device diffing, made auditable
+        # (ISSUE 15): the only device→host traffic of a compare is this
+        # ~n/page_size flag vector, never the image
+        count_copy(D2H, int(flags.nbytes), "snapshot")
+        return flags
 
     def dirty_pages(self, arr) -> np.ndarray:
         """(n_pages,) bool host vector; the only device→host transfer is
@@ -170,6 +176,7 @@ class DeviceSnapshot:
             [idx, np.full(bucket - idx.size, idx[-1], idx.dtype)])
         pages = np.asarray(_gather_fn(self.n_words, self.page_words,
                                       self._word.name)(w, idx_padded))
+        count_copy(D2H, int(pages.nbytes), "snapshot")
         # (bucket, page_words) words → (bucket, page_size) bytes
         pages = pages[:idx.size].view(np.uint8).reshape(idx.size, -1)
         diffs: list[SnapshotDiff] = []
@@ -224,7 +231,9 @@ class DeviceSnapshot:
     def to_host_snapshot(self) -> SnapshotData:
         """The baseline as a host SnapshotData — device diffs queue onto
         it with the exact same byte offsets."""
-        return SnapshotData(np.asarray(self._baseline_w).view(np.uint8))
+        host = np.asarray(self._baseline_w)
+        count_copy(D2H, int(host.nbytes), "snapshot")
+        return SnapshotData(host.view(np.uint8))
 
     def apply_diffs(self, arr, diffs: list[SnapshotDiff]):
         """Apply byte-exact diffs to a device value (the restore
@@ -233,10 +242,12 @@ class DeviceSnapshot:
 
         self._check(arr)
         host = np.asarray(arr)
+        count_copy(D2H, int(host.nbytes), "snapshot")
         u8 = host.reshape(-1).view(np.uint8).copy()
         for d in diffs:
             u8[d.offset:d.offset + len(d.data)] = np.frombuffer(
                 d.data, np.uint8)
+        count_copy(H2D, int(u8.nbytes), "snapshot")
         return jax.device_put(u8.view(host.dtype).reshape(self.shape))
 
     def _check(self, arr) -> None:

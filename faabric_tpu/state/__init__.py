@@ -7,6 +7,14 @@ from faabric_tpu.state.backend import (
     SharedFileAuthority,
     StateAuthority,
 )
+from faabric_tpu.state.device_handle import (
+    DeviceHandleError,
+    DeviceHandleRegistry,
+    DeviceStateHandle,
+    StaleDeviceHandle,
+    get_device_handle_registry,
+    reset_device_handles,
+)
 from faabric_tpu.state.kv import STATE_CHUNK_SIZE, StateKeyValue
 from faabric_tpu.state.state import State
 from faabric_tpu.state.remote import (
@@ -18,6 +26,12 @@ from faabric_tpu.state.remote import (
 )
 
 __all__ = [
+    "DeviceHandleError",
+    "DeviceHandleRegistry",
+    "DeviceStateHandle",
+    "StaleDeviceHandle",
+    "get_device_handle_registry",
+    "reset_device_handles",
     "MasterMemoryAuthority",
     "RedisAuthority",
     "RemoteAuthority",

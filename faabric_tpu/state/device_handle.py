@@ -1,0 +1,303 @@
+"""Device-resident state handles: pass HBM arrays by reference (ISSUE 15).
+
+The executor-chain workload SURVEY §2.1/§2.5 describes — faabric-style
+batch functions chained over shared arrays — today moves every
+intermediate through the host state KV (``StateKeyValue``:
+device_get → host image → device_put). For arrays that never leave the
+chip between steps both transfers are pure waste. This module is the
+zero-copy tier:
+
+- ``push(world_id, rank, name, arr)`` registers a **live, committed,
+  single-device jax.Array** under a compact, JSON-serializable
+  :class:`DeviceStateHandle` (world / rank / name / shape / dtype /
+  device id / generation / uid) — **no host staging**: the registry
+  holds a reference to the array exactly where it lives in HBM.
+- ``pull(handle)`` hands the array back **by reference** — zero
+  copies, the lazy-materialization contract: nothing moves until a
+  consumer explicitly asks for host bytes via ``pull_host`` (one
+  counted device→host copy) or queues a device snapshot diff.
+- Handles ride executor chains as plain dicts (``to_dict`` /
+  ``from_dict``) — what crosses the invocation boundary is ~100 bytes
+  of metadata, never the payload.
+
+Safety contract (the ISSUE 15 small-fix): a migrated rank must never
+pull a stale HBM reference. ``MpiWorld.prepare_migration`` calls
+:func:`invalidate_world` — the world's generation bumps and every
+outstanding handle drops (flight-recorded); a pull of an invalidated
+handle raises :class:`StaleDeviceHandle` instead of returning a buffer
+whose chip assignment no longer matches the world. After the
+re-handshake (``activate_device_plane``) the executor re-pushes its
+arrays, minting fresh handles under the new generation — "drop +
+re-handshake re-registers them".
+
+Snapshot bridge: ``snapshot_of(handle)`` wraps the live array in a
+:class:`~faabric_tpu.snapshot.device_snapshot.DeviceSnapshot`, so
+dirty-page diffing runs ON the chip and only the diff bytes ever cross
+to the host (SURVEY §7).
+
+Memory note: the registry pins pushed arrays (that is its job — a
+handle must stay pullable), bounded by ``FAABRIC_DEVICE_HANDLES_MAX``
+(default 256 per process); pushing past the cap evicts nothing and
+raises — silent eviction would turn a valid handle stale, which is
+exactly the bug class the generation check exists to make loud.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+from faabric_tpu.util.config import _env_int
+from faabric_tpu.util.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_MAX_HANDLES = 256
+
+
+class StaleDeviceHandle(KeyError):
+    """The handle's HBM reference is gone or from a pre-migration
+    generation — re-push after the re-handshake."""
+
+
+class DeviceHandleError(ValueError):
+    """The pushed value is not a committed single-device jax.Array (or
+    the registry is at capacity)."""
+
+
+@dataclass(frozen=True)
+class DeviceStateHandle:
+    """Compact by-reference name for one HBM array. Serializable —
+    executor chains pass the dict, never the payload."""
+
+    world_id: int
+    rank: int
+    name: str
+    shape: tuple
+    dtype: str
+    device_id: int
+    gen: int
+    uid: int
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["shape"] = list(self.shape)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeviceStateHandle":
+        return cls(world_id=int(d["world_id"]), rank=int(d["rank"]),
+                   name=str(d["name"]), shape=tuple(d["shape"]),
+                   dtype=str(d["dtype"]), device_id=int(d["device_id"]),
+                   gen=int(d["gen"]), uid=int(d["uid"]))
+
+    @property
+    def nbytes(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n * np.dtype(self.dtype).itemsize
+
+
+class DeviceHandleRegistry:
+    """Per-process HBM handle table."""
+
+    # Concurrency contract (tools/concheck.py): executor threads push/
+    # pull concurrently while migrations invalidate; one lock covers
+    # the table (operations are dict hits — no compile, no transfer
+    # under the lock).
+    GUARDS = {
+        "_entries": "_lock",
+        "_world_gen": "_lock",
+        "_by_world": "_lock",
+        "_next_uid": "_lock",
+    }
+
+    def __init__(self, max_handles: int | None = None) -> None:
+        self.max_handles = (max_handles if max_handles is not None else
+                            _env_int("FAABRIC_DEVICE_HANDLES_MAX",
+                                     DEFAULT_MAX_HANDLES))
+        self._lock = threading.Lock()
+        self._entries: dict[int, tuple[DeviceStateHandle, object]] = {}
+        self._by_world: dict[int, set[int]] = {}
+        self._world_gen: dict[int, int] = {}
+        self._next_uid = 1
+
+    # ------------------------------------------------------------------
+    def push(self, world_id: int, rank: int, name: str,
+             arr) -> DeviceStateHandle:
+        """Register a device-resident array; NO host staging — the
+        array object itself is pinned, exactly where it lives."""
+        from faabric_tpu.device_plane.plane import is_device_payload
+
+        if not is_device_payload(arr):
+            raise DeviceHandleError(
+                "push() needs a jax.Array (host values belong in the "
+                "state KV; device_put first to pin a host buffer)")
+        try:
+            committed = bool(getattr(arr, "committed", False))
+            devs = arr.sharding.device_set
+        except Exception as e:  # noqa: BLE001 — exotic array types
+            raise DeviceHandleError(f"unsupported array type: {e!r}")
+        if not committed or len(devs) != 1:
+            raise DeviceHandleError(
+                "push() needs a COMMITTED single-device array "
+                f"(committed={committed}, devices={len(devs)})")
+        (dev,) = devs
+        with self._lock:
+            if len(self._entries) >= self.max_handles:
+                raise DeviceHandleError(
+                    f"device handle registry at capacity "
+                    f"({self.max_handles}); drop handles or raise "
+                    "FAABRIC_DEVICE_HANDLES_MAX")
+            gen = self._world_gen.setdefault(world_id, 0)
+            uid = self._next_uid
+            self._next_uid += 1
+            handle = DeviceStateHandle(
+                world_id=int(world_id), rank=int(rank), name=str(name),
+                shape=tuple(int(s) for s in arr.shape),
+                dtype=str(np.dtype(arr.dtype)), device_id=int(dev.id),
+                gen=gen, uid=uid)
+            self._entries[uid] = (handle, arr)
+            self._by_world.setdefault(world_id, set()).add(uid)
+        return handle
+
+    def _resolve(self, handle: DeviceStateHandle):
+        if isinstance(handle, dict):
+            handle = DeviceStateHandle.from_dict(handle)
+        with self._lock:
+            gen = self._world_gen.get(handle.world_id, 0)
+            entry = self._entries.get(handle.uid)
+        if handle.gen != gen or entry is None:
+            raise StaleDeviceHandle(
+                f"device handle {handle.uid} "
+                f"({handle.world_id}/{handle.rank}/{handle.name}) is "
+                f"stale: generation {handle.gen} vs {gen} — the rank "
+                "migrated; re-handshake and re-push")
+        return entry
+
+    def pull(self, handle):
+        """The live HBM array, by reference — zero transfers."""
+        return self._resolve(handle)[1]
+
+    def pull_host(self, handle) -> np.ndarray:
+        """Materialize on host: the ONE counted device→host copy."""
+        from faabric_tpu.device_plane.copies import D2H, count_copy
+
+        arr = self._resolve(handle)[1]
+        out = np.asarray(arr)
+        count_copy(D2H, int(out.nbytes), "state")
+        return out
+
+    def push_from_host(self, world_id: int, rank: int, name: str,
+                       host_arr, device) -> DeviceStateHandle:
+        """Escape hatch for host values entering the HBM tier: one
+        counted host→device placement, then a normal push."""
+        import jax
+
+        host_arr = np.asarray(host_arr)
+        from faabric_tpu.device_plane.copies import H2D, count_copy
+
+        arr = jax.device_put(host_arr, device)
+        count_copy(H2D, int(host_arr.nbytes), "state")
+        return self.push(world_id, rank, name, arr)
+
+    def snapshot_of(self, handle):
+        """A DeviceSnapshot tracking the handle's live array: dirty
+        detection and diff extraction stay ON the chip."""
+        from faabric_tpu.snapshot.device_snapshot import DeviceSnapshot
+
+        return DeviceSnapshot(self.pull(handle))
+
+    # ------------------------------------------------------------------
+    def drop(self, handle) -> bool:
+        if isinstance(handle, dict):
+            handle = DeviceStateHandle.from_dict(handle)
+        with self._lock:
+            entry = self._entries.pop(handle.uid, None)
+            if entry is not None:
+                self._by_world.get(handle.world_id, set()).discard(
+                    handle.uid)
+        return entry is not None
+
+    def invalidate_world(self, world_id: int) -> int:
+        """Migration hook (``MpiWorld.prepare_migration``): bump the
+        world's generation and drop every outstanding handle — a
+        migrated rank can never pull a stale HBM reference. Flight-
+        recorded so post-mortems can tie a StaleDeviceHandle burst to
+        the remap that caused it."""
+        with self._lock:
+            self._world_gen[world_id] = \
+                self._world_gen.get(world_id, 0) + 1
+            gen = self._world_gen[world_id]
+            uids = self._by_world.pop(world_id, set())
+            dropped = 0
+            nbytes = 0
+            for uid in uids:
+                entry = self._entries.pop(uid, None)
+                if entry is not None:
+                    dropped += 1
+                    nbytes += entry[0].nbytes
+        if dropped:
+            from faabric_tpu.telemetry.flight import flight_record
+
+            flight_record("device_handle_invalidate", world=world_id,
+                          gen=gen, dropped=dropped, bytes=nbytes)
+        if dropped:
+            logger.info(
+                "Invalidated %d device state handle(s) (%d bytes) for "
+                "world %s (generation %d)", dropped, nbytes, world_id,
+                gen)
+        return dropped
+
+    def world_generation(self, world_id: int) -> int:
+        with self._lock:
+            return self._world_gen.get(world_id, 0)
+
+    def summary(self) -> dict:
+        with self._lock:
+            handles = [h.to_dict() for h, _a in self._entries.values()]
+            gens = dict(self._world_gen)
+        return {"count": len(handles),
+                "bytes": sum(DeviceStateHandle.from_dict(h).nbytes
+                             for h in handles),
+                "world_generations": gens,
+                "handles": handles}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_world.clear()
+            self._world_gen.clear()
+
+
+_registry: DeviceHandleRegistry | None = None
+_registry_lock = threading.Lock()
+
+
+def get_device_handle_registry() -> DeviceHandleRegistry:
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = DeviceHandleRegistry()
+    return _registry
+
+
+def invalidate_world(world_id: int) -> int:
+    """Module-level convenience for the migration path: invalidate
+    without instantiating a registry nobody used."""
+    with _registry_lock:
+        reg = _registry
+    if reg is None:
+        return 0
+    return reg.invalidate_world(world_id)
+
+
+def reset_device_handles() -> None:
+    """Test hook: drop the singleton."""
+    global _registry
+    with _registry_lock:
+        _registry = None

@@ -249,7 +249,8 @@ class _NullPerfStore:
                 codec="raw") -> None:
         pass
 
-    def link_gibs(self, dst, plane=None, min_bytes: int = 0):
+    def link_gibs(self, dst, plane=None, min_bytes: int = 0,
+                  codec=None):
         # Signature mirrors PerfProfileStore.link_gibs exactly: the
         # schedule selector passes min_bytes, and a metrics-off
         # TypeError here would kill rank 0 before its selection
@@ -337,7 +338,8 @@ class PerfProfileStore:
 
     # -- queries --------------------------------------------------------
     def link_gibs(self, dst, plane: str | None = None,
-                  min_bytes: int = 0) -> float | None:
+                  min_bytes: int = 0,
+                  codec: str | None = None) -> float | None:
         """Best current bandwidth estimate toward ``dst`` (max EWMA over
         codecs/size classes with real evidence), or None when the link
         is unmeasured — the governor's assume-slow default then holds.
@@ -347,12 +349,18 @@ class PerfProfileStore:
         falsely slow link — the governor asks for big-frame evidence
         only, so a link carrying nothing but compact delta frames
         reports None (→ fallback) instead of locking itself into
-        compression on an underestimate."""
+        compression on an underestimate.
+
+        ``codec`` restricts the evidence to one wire codec's rows —
+        how the governor's tuned-threshold derivation reads the delta
+        path's own measured wire rate (ISSUE 15 satellite)."""
         with self._lock:
             items = list(self._entries.items())
         best = None
-        for (d, p, _codec, klass), e in items:
+        for (d, p, c, klass), e in items:
             if d != dst or (plane is not None and p != plane):
+                continue
+            if codec is not None and c != codec:
                 continue
             if min_bytes and class_floor(klass) < min_bytes:
                 continue

@@ -46,7 +46,10 @@ Knobs: ``FAABRIC_WIRE_CODEC`` (``auto`` default; ``raw`` disables;
 ``quant`` allows lossy int8 on the leader ring; comma-combinable,
 e.g. ``delta,quant``), ``FAABRIC_DELTA_CACHE_MB`` (per-side base-cache
 budget, default 128), ``FAABRIC_WIRE_CODEC_MIN_GIBS`` (auto-mode link
-speed above which compression never pays, default 4).
+speed above which compression never pays — an explicit OVERRIDE: when
+unset the threshold is tuned per destination from the perf-profile
+store's measured delta-path effective rate, falling back to 4 GiB/s
+with no delta evidence; see ``WireCodecGovernor._threshold_gibs``).
 """
 
 from __future__ import annotations
@@ -911,16 +914,28 @@ class WireCodecGovernor:
 
     WINDOW_SECONDS = 5.0
 
+    # Clamp range for the TUNED threshold: measurement glitches must
+    # not push the break-even outside physically sensible link speeds
+    TUNED_MIN_GIBS = 0.25
+    TUNED_MAX_GIBS = 32.0
+
     def __init__(self, mode: str | None = None) -> None:
         self._lock = threading.Lock()
         if mode is None:
             mode = os.environ.get("FAABRIC_WIRE_CODEC", "auto")
         self.mode = _parse_mode(mode)
+        # ISSUE 15 satellite (the ROADMAP item-1 leftover): the
+        # auto-mode bandwidth threshold is TUNED from the perf-profile
+        # store per destination (see _threshold_gibs) — the env knob is
+        # now an OVERRIDE, applied only when explicitly set; 4.0 GiB/s
+        # remains the no-evidence default.
+        self.min_gibs_env_set = "FAABRIC_WIRE_CODEC_MIN_GIBS" in os.environ
         try:
             self.min_gibs = float(os.environ.get(
                 "FAABRIC_WIRE_CODEC_MIN_GIBS", "4.0"))
         except ValueError:
             self.min_gibs = 4.0
+            self.min_gibs_env_set = False
         self._decisions: dict[tuple, tuple[str, float]] = {}
         self._matrix_cells: list[dict] = []
         self._matrix_expires = 0.0
@@ -966,7 +981,8 @@ class WireCodecGovernor:
         if gibs is None:
             gibs = self._link_gibs(src, dst)
             source = "commmatrix"
-        choice = "delta" if (gibs is None or gibs < self.min_gibs) \
+        threshold, threshold_src = self._threshold_gibs(host, src, dst)
+        choice = "delta" if (gibs is None or gibs < threshold) \
             else "raw"
         with self._lock:
             prev = self._decisions.get(key)
@@ -983,8 +999,62 @@ class WireCodecGovernor:
                           verdict=choice,
                           prev=prev[0] if prev else None,
                           gibs=round(gibs, 3) if gibs is not None
-                          else None, source=source)
+                          else None, source=source,
+                          threshold=round(threshold, 3),
+                          threshold_src=threshold_src)
         return choice
+
+    def _threshold_gibs(self, host: str, src, dst) -> tuple[float, str]:
+        """The raw-vs-compress break-even bandwidth for one link
+        (ISSUE 15 satellite, the ROADMAP item-1 leftover).
+
+        Priority: an EXPLICITLY set ``FAABRIC_WIRE_CODEC_MIN_GIBS``
+        always wins (the operator override). Otherwise the threshold is
+        TUNED from measurement: compression pays exactly while the raw
+        link is slower than the delta path's measured *effective*
+        payload rate — the store's delta-codec wire bandwidth toward
+        ``host`` × the link's observed raw/wire compression ratio (comm
+        matrix ``bytes_raw``/``bytes`` on delta rows; per-(src, dst)
+        first, any measured delta link as fallback). No delta evidence
+        yet → the 4 GiB/s default, exactly as before."""
+        if self.min_gibs_env_set:
+            return self.min_gibs, "env"
+        delta_gibs = get_perf_store().link_gibs(
+            host, plane="bulk-tcp", codec="delta")
+        if delta_gibs is None or delta_gibs <= 0:
+            return self.min_gibs, "default"
+        ratio = self._delta_ratio(src, dst)
+        if ratio is None:
+            return self.min_gibs, "default"
+        tuned = min(max(delta_gibs * ratio, self.TUNED_MIN_GIBS),
+                    self.TUNED_MAX_GIBS)
+        return tuned, "tuned"
+
+    def _delta_ratio(self, src, dst) -> float | None:
+        """Observed raw/wire byte ratio of delta frames — per (src,
+        dst) when that link has delta history, the matrix-wide delta
+        aggregate otherwise (a fresh link borrows the workload's
+        typical compressibility). Reuses the windowed comm-matrix
+        snapshot ``_link_gibs`` maintains."""
+        self._link_gibs(src, dst)  # refresh the window if due
+        with self._lock:
+            cells = self._matrix_cells
+        link_raw = link_wire = all_raw = all_wire = 0
+        for c in cells:
+            if c.get("codec") != "delta" or c.get("plane") != "bulk-tcp":
+                continue
+            wire = c.get("bytes", 0)
+            raw = c.get("bytes_raw", wire)
+            all_raw += raw
+            all_wire += wire
+            if c.get("src") == str(src) and c.get("dst") == str(dst):
+                link_raw += raw
+                link_wire += wire
+        if link_wire > 0:
+            return link_raw / link_wire
+        if all_wire > 0:
+            return all_raw / all_wire
+        return None
 
     def _link_gibs(self, src, dst) -> float | None:
         """Measured GiB/s for the (src, dst) bulk link from the comm

@@ -12,7 +12,11 @@ w1), run the activation handshake, and prove:
     differ by fold order, which is pinned at unit level);
 (b) the collective payload puts ZERO bytes on the host shm/tcp planes —
     the comm-matrix ``plane=device`` rows carry the traffic instead;
-(c) an ineligible shape (non-commuting UserOp) falls back to the host
+(c) ISSUE 15: a device-RESIDENT allreduce (jax arrays already committed
+    on the chips) additionally moves ZERO bytes across the host↔device
+    boundary — the ``faabric_device_copy_*`` accounting — with results
+    bitwise identical and still on device;
+(d) an ineligible shape (non-commuting UserOp) falls back to the host
     ladder and still agrees with numpy.
 
 The parent only orchestrates — ``jax.distributed.initialize`` is
@@ -162,7 +166,43 @@ def _child_main(my_idx: int, coord_port: int) -> None:
         report["host_plane_bytes"] = sum(
             v for p, v in delta.items() if p in DATA_PLANES)
 
-        # (c) ineligible op falls back and still agrees
+        # (c) ISSUE 15 acceptance: device-RESIDENT allreduce — inputs
+        # already committed on the chips — records ZERO bytes on the
+        # host data planes AND ZERO host<->device staging copies (the
+        # new faabric_device_copy_* accounting), with results bitwise
+        # identical to the host flat ring AND still device-resident
+        import jax
+
+        from faabric_tpu.device_plane import device_copy_totals
+
+        plane = world.device_plane()
+        dev_datas = {r: jax.device_put(ar_datas[r], plane.devices[r])
+                     for r in my_ranks}
+        # resident-key compile off the accounting clock (compiles move
+        # no payload, but keep the measured window clean)
+        run_ranks(lambda r: world.allreduce(r, dev_datas[r], MpiOp.SUM))
+        c0 = device_copy_totals()
+        rb0 = plane_bytes()
+        res = run_ranks(lambda r: world.allreduce(r, dev_datas[r],
+                                                  MpiOp.SUM))
+        c1 = device_copy_totals()
+        rb1 = plane_bytes()
+        rdelta = {p: rb1.get(p, 0) - rb0.get(p, 0)
+                  for p in set(rb0) | set(rb1)}
+        report["resident_copy_count"] = c1["count"] - c0["count"]
+        report["resident_copy_bytes"] = c1["bytes"] - c0["bytes"]
+        report["resident_host_plane_bytes"] = sum(
+            v for p, v in rdelta.items() if p in DATA_PLANES)
+        report["resident_device_bytes"] = rdelta.get("device", 0)
+        report["resident_device_bytes_expected"] = sum(
+            ar_datas[r].nbytes for r in my_ranks)
+        for r in my_ranks:
+            assert hasattr(res[r], "sharding"), type(res[r])
+            out = np.asarray(res[r])
+            assert out.dtype == np.int32, r
+            assert np.array_equal(out, flat_ar[r]), r
+
+        # (d) ineligible op falls back and still agrees
         op = UserOp(lambda a, b: np.maximum(a, b), commute=True)
         fb = run_ranks(lambda r: world.allreduce(
             r, ar_datas[r].copy(), op))
@@ -223,10 +263,18 @@ def test_dist_device_plane_cross_process_bitwise_and_accounting():
         # data planes (the handshake/barrier control traffic is ptp)
         assert rep["device_bytes"] == rep["device_bytes_expected"], rep
         assert rep["host_plane_bytes"] == 0, rep
+        # ISSUE 15: the resident rounds moved zero host<->device bytes
+        # and zero host-plane bytes; the device rows carried them
+        assert rep["resident_copy_count"] == 0, rep
+        assert rep["resident_copy_bytes"] == 0, rep
+        assert rep["resident_host_plane_bytes"] == 0, rep
+        assert rep["resident_device_bytes"] == \
+            rep["resident_device_bytes_expected"], rep
         # the ineligible-op fallback did NOT disable the plane — it
         # never entered the rung
         assert rep["disabled"] is None, rep
-        assert rep["cached"] == 3, rep
+        # 3 host-round executables + the residency-keyed allreduce
+        assert rep["cached"] == 4, rep
 
 
 if __name__ == "__main__":

@@ -178,6 +178,33 @@ def test_gate_device_plane_key_reported_only_first_round(tmp_path,
     assert "REGRESSION" not in out
 
 
+def test_gate_device_resident_keys_reported_only_first_round(tmp_path,
+                                                             capsys):
+    """ISSUE 15 first-round keys (the CI/tooling satellite): the
+    device-resident allreduce rate and the host<->device copy-bytes
+    accounting figure are tracked but not gated until a round of
+    spread exists — with both DIRECTIONS pinned here so the eventual
+    promotion inherits the right polarity: the rate is throughput
+    (higher-better), the copy bytes are waste (lower-better — the
+    _bytes suffix rule this PR adds)."""
+    for key in ("device_resident_allreduce_gibs",
+                "device_host_copy_bytes"):
+        assert key in bench_gate.REPORTED_ONLY
+    assert bench_gate.direction("device_resident_allreduce_gibs") == 1
+    assert bench_gate.direction("device_host_copy_bytes") == -1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"device_resident_allreduce_gibs": 3.0,
+                  "device_host_copy_bytes": 0.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"device_resident_allreduce_gibs": 0.5,   # -83%
+                  "device_host_copy_bytes": 96_000_000.0})
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "device_resident_allreduce_gibs" in out
+    assert "reported-only" in out
+    assert "REGRESSION" not in out
+
+
 def test_gate_tolerates_new_and_missing_keys(tmp_path):
     """Rounds grow new sections; a key in only one round must never
     fail the gate."""

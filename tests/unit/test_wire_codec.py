@@ -200,6 +200,99 @@ def test_governor_quant_policy():
     assert gov.quant_for_link("", "h", True) is True
 
 
+class _StubPerfStore:
+    """Minimal PerfProfileStore stand-in for threshold tests."""
+
+    def __init__(self, raw_gibs=None, delta_gibs=None):
+        self.raw_gibs = raw_gibs
+        self.delta_gibs = delta_gibs
+
+    def link_gibs(self, dst, plane=None, min_bytes=0, codec=None):
+        return self.delta_gibs if codec == "delta" else self.raw_gibs
+
+
+def _inject_matrix(gov, cells):
+    import time as _time
+
+    gov._matrix_cells = cells
+    gov._matrix_expires = _time.monotonic() + 999.0
+
+
+def test_governor_tuned_threshold_from_perf_store(monkeypatch):
+    """ISSUE 15 satellite (the ROADMAP item-1 leftover): with the env
+    knob unset, the auto-mode break-even threshold is TUNED from the
+    perf store's measured delta-path rate × the observed raw/wire
+    compression ratio — compression pays exactly while the raw link is
+    slower than what delta would effectively deliver."""
+    import faabric_tpu.transport.codec as codec_mod
+
+    monkeypatch.delenv("FAABRIC_WIRE_CODEC_MIN_GIBS", raising=False)
+    # delta moves wire bytes at 0.05 GiB/s, and historically compressed
+    # 100:1 on this link → effective 5 GiB/s of payload; the raw link
+    # measures 1.0 GiB/s < 5 → delta wins despite being "fast" by the
+    # old fixed 4.0 default... and with a poor 2:1 ratio the tuned
+    # threshold collapses to the 0.25 clamp and raw wins.
+    store = _StubPerfStore(raw_gibs=1.0, delta_gibs=0.05)
+    monkeypatch.setattr(codec_mod, "get_perf_store", lambda: store)
+    gov = WireCodecGovernor(mode="auto")
+    assert not gov.min_gibs_env_set
+    _inject_matrix(gov, [{"plane": "bulk-tcp", "codec": "delta",
+                          "src": "0", "dst": "1",
+                          "bytes": 1_000, "bytes_raw": 100_000}])
+    threshold, src = gov._threshold_gibs("far-a", 0, 1)
+    assert src == "tuned" and threshold == pytest.approx(5.0)
+    assert gov.bulk_codec("far-a", False, 0, 1, 1 << 20) == "delta"
+
+    gov2 = WireCodecGovernor(mode="auto")
+    _inject_matrix(gov2, [{"plane": "bulk-tcp", "codec": "delta",
+                           "src": "0", "dst": "1",
+                           "bytes": 100_000, "bytes_raw": 200_000}])
+    threshold, src = gov2._threshold_gibs("far-b", 0, 1)
+    assert src == "tuned"
+    assert threshold == pytest.approx(gov2.TUNED_MIN_GIBS)  # clamped
+    assert gov2.bulk_codec("far-b", False, 0, 1, 1 << 20) == "raw"
+
+    # A fresh (src, dst) pair with no delta history borrows the
+    # matrix-wide aggregate ratio instead of giving up
+    threshold, src = gov2._threshold_gibs("far-c", 7, 8)
+    assert src == "tuned"
+
+
+def test_governor_threshold_env_knob_overrides(monkeypatch):
+    """An explicitly set FAABRIC_WIRE_CODEC_MIN_GIBS remains the
+    operator override: tuned evidence is ignored."""
+    import faabric_tpu.transport.codec as codec_mod
+
+    monkeypatch.setenv("FAABRIC_WIRE_CODEC_MIN_GIBS", "9.5")
+    store = _StubPerfStore(raw_gibs=6.0, delta_gibs=0.05)
+    monkeypatch.setattr(codec_mod, "get_perf_store", lambda: store)
+    gov = WireCodecGovernor(mode="auto")
+    assert gov.min_gibs_env_set
+    _inject_matrix(gov, [{"plane": "bulk-tcp", "codec": "delta",
+                          "src": "0", "dst": "1",
+                          "bytes": 100_000, "bytes_raw": 200_000}])
+    threshold, src = gov._threshold_gibs("far-d", 0, 1)
+    assert (threshold, src) == (9.5, "env")
+    # measured 6.0 < 9.5 → delta (the override, not the 0.25 tuned)
+    assert gov.bulk_codec("far-d", False, 0, 1, 1 << 20) == "delta"
+
+
+def test_governor_threshold_defaults_without_delta_evidence(monkeypatch):
+    """No delta history anywhere: the 4 GiB/s default holds, exactly
+    as before this PR."""
+    import faabric_tpu.transport.codec as codec_mod
+
+    monkeypatch.delenv("FAABRIC_WIRE_CODEC_MIN_GIBS", raising=False)
+    store = _StubPerfStore(raw_gibs=5.0, delta_gibs=None)
+    monkeypatch.setattr(codec_mod, "get_perf_store", lambda: store)
+    gov = WireCodecGovernor(mode="auto")
+    _inject_matrix(gov, [])
+    threshold, src = gov._threshold_gibs("far-e", 0, 1)
+    assert (threshold, src) == (4.0, "default")
+    # 5.0 ≥ 4.0 → raw, the pre-PR behaviour
+    assert gov.bulk_codec("far-e", False, 0, 1, 1 << 20) == "raw"
+
+
 def test_quant_codec_per_link_raw_passthrough():
     """encode(quantize=False) ships the NaN-scale raw form — the
     receiver decodes BITWISE-identical fp32, carried in-band."""

@@ -25,9 +25,12 @@ import re
 import sys
 
 # NOTE: _per_s (throughput rates, e.g. invocations_per_s) must be
-# classified BEFORE the trailing-_s latency rule catches them
+# classified BEFORE the trailing-_s latency rule catches them.
+# _bytes (ISSUE 15): accounting byte counts — device_host_copy_bytes —
+# where fewer bytes moved is strictly better; direction pinned by
+# tests/unit/test_bench_gate.py.
 HIGHER_BETTER = re.compile(r"(_gibs|_per_s|mfu|_speedup)")
-LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio|_err)$")
+LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio|_err|_bytes)$")
 
 # Headline figures (ISSUE 5 data plane; ISSUE 8 invocation plane): once
 # a round has recorded one of these, a later round missing it is a
@@ -77,9 +80,19 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # target) and invocation_p99_ms the planner-folded admit→record e2e
 # p99 under the concurrent QPS workload (log-bucket quantile —
 # coarse by construction, so it rides reported-only first).
+# ISSUE 15 device-resident keys (first recorded round, promote next):
+# device_resident_allreduce_gibs is the zero-host-copy allreduce rate
+# on payloads already living in device memory (on this CPU container
+# the device_put it skips is a cheap memcpy, so no speedup is expected
+# here — the figure exists for the TPU rounds where the skipped
+# transfers are PCIe/DMA); device_host_copy_bytes is the asserted-zero
+# copy accounting for the timed resident rounds (lower-is-better —
+# _bytes direction pinned in the unit test).
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "lifecycle_stamp_ns", "invocation_p99_ms",
                  "host_allreduce_device_gibs",
+                 "device_resident_allreduce_gibs",
+                 "device_host_copy_bytes",
                  "allreduce_quant_max_abs_err",
                  "host_allreduce_procs_raw_gibs",
                  "host_allreduce_procs_coded_gibs",

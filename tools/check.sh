@@ -39,6 +39,15 @@ if ! JAX_PLATFORMS=cpu python -m faabric_tpu.mpi.schedule_compile \
     rc=1
 fi
 
+echo "== pallas ring selftest (device ring-permute p2p) =="
+# On this container it validates the XLA fallback permute and reports
+# the Pallas kernel as untested (no TPU granted) — fast, clean; with a
+# granted TPU the same hook exercises make_async_remote_copy for real.
+if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python -m faabric_tpu.device_plane.pallas_ring --selftest; then
+    rc=1
+fi
+
 if [ "${1:-}" = "--with-tests" ]; then
     echo "== tier-1 suite =="
     rm -f /tmp/_t1.log
