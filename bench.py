@@ -2053,6 +2053,97 @@ def bench_lifecycle(quick: bool = False) -> dict:
     }
 
 
+def bench_state(quick: bool = False) -> dict:
+    """ISSUE 16 state plane: master-image hot reads, replica pull and
+    dirty-chunk partial push over a real loopback StateServer, and the
+    per-key access ledger's record cost enabled vs the shared
+    ``FAABRIC_METRICS=0`` no-op singleton (contract: a disabled state op
+    pays one no-op method call — tens of ns, not a locked dict walk)."""
+    from faabric_tpu.state import STATE_CHUNK_SIZE, State, StateKeyValue
+    from faabric_tpu.state.remote import StateClient, StateServer
+    from faabric_tpu.telemetry.statestats import (
+        NULL_STATE_STATS,
+        StateStatsStore,
+    )
+    from faabric_tpu.transport.client_pool import ClientPool
+    from faabric_tpu.transport.common import (
+        clear_host_aliases,
+        register_host_alias,
+    )
+
+    # Ledger feed cost: one private store so the figures are not skewed
+    # by whatever the process-wide ledger already holds
+    n = 20_000 if quick else 200_000
+    store = StateStatsStore(max_keys=64)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        store.record("bench/blob", "get", nbytes=4096)
+    record_ns = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n):
+        NULL_STATE_STATS.record("bench/blob", "get", nbytes=4096)
+    record_noop_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # Hot read: one-chunk get_chunk against the local master image — the
+    # per-step cost a training loop pays re-reading unchanged state
+    size = (1 << 20) if quick else (4 << 20)
+    master_state = State("benchstateA")
+    kv = master_state.get_kv("bench", "blob", size)
+    kv.set(b"\x5a" * size)
+    reads = 5_000 if quick else 50_000
+    t0 = time.perf_counter()
+    for _ in range(reads):
+        kv.get_chunk(0, STATE_CHUNK_SIZE)
+    hot_read_ns = (time.perf_counter() - t0) / reads * 1e9
+
+    # Replica ↔ master chunk protocol over real loopback TCP. Stay clear
+    # of the ephemeral port range (>=32768)
+    base = random.randint(10, 200) * 100
+    register_host_alias("benchstateA", "127.0.0.1", base)
+    register_host_alias("benchstateB", "127.0.0.1", base + 1000)
+    server = StateServer(master_state, "benchstateA")
+    server.start()
+    pool = ClientPool(StateClient)
+    try:
+        rkv = StateKeyValue("bench", "blob", size, False, "benchstateA",
+                            client_factory=pool.get,
+                            local_host="benchstateB")
+        pulls = 2 if quick else 6
+        rkv.pull()  # warm the connection / cold path
+        t0 = time.perf_counter()
+        for _ in range(pulls):
+            rkv.pull()
+        pull_gibs = pulls * size / (time.perf_counter() - t0) / 2**30
+
+        # Partial push: every other chunk dirty, so only half the value
+        # travels — the dirty-mask path, not a full-value copy
+        pushes = 2 if quick else 6
+        chunk = b"\xa5" * STATE_CHUNK_SIZE
+        push_s, push_bytes = 0.0, 0
+        for _ in range(pushes):
+            for off in range(0, size, 2 * STATE_CHUNK_SIZE):
+                rkv.set_chunk(off, chunk)
+            dirty = rkv.n_dirty_chunks()
+            t0 = time.perf_counter()
+            rkv.push_partial()
+            push_s += time.perf_counter() - t0
+            push_bytes += dirty * STATE_CHUNK_SIZE
+        push_gibs = push_bytes / push_s / 2**30
+    finally:
+        pool.close_all()
+        server.stop()
+        clear_host_aliases()
+
+    return {
+        "hot_read_ns": round(hot_read_ns, 1),
+        "pull_gibs": round(pull_gibs, 4),
+        "push_partial_gibs": round(push_gibs, 4),
+        "record_ns": round(record_ns, 1),
+        "record_noop_ns": round(record_noop_ns, 1),
+        "value_mib": size >> 20,
+    }
+
+
 def bench_robustness(quick: bool = False) -> dict:
     """ISSUE 2 robustness section: recovery latency under worker loss.
 
@@ -3441,6 +3532,7 @@ def main() -> None:
     host_section("perf_introspection",
                  lambda: bench_perf_introspection(quick))
     host_section("lifecycle", lambda: bench_lifecycle(quick))
+    host_section("state", lambda: bench_state(quick))
 
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
         # Device phase: TPU first with per-section watchdogs; CPU tiny
@@ -3604,6 +3696,17 @@ def main() -> None:
     lf = extras.get("lifecycle") or {}
     if lf.get("stamp_ns") is not None:
         summary["lifecycle_stamp_ns"] = lf["stamp_ns"]
+    # ISSUE 16 state-plane keys (REPORTED_ONLY this round): master-image
+    # hot read, replica pull / partial-push throughput over loopback,
+    # and the access-ledger record cost enabled vs the no-op singleton
+    st = extras.get("state") or {}
+    for src, dst in (("hot_read_ns", "state_hot_read_ns"),
+                     ("pull_gibs", "state_pull_gibs"),
+                     ("push_partial_gibs", "state_push_partial_gibs"),
+                     ("record_ns", "statestats_record_ns"),
+                     ("record_noop_ns", "statestats_record_noop_ns")):
+        if st.get(src) is not None:
+            summary[dst] = st[src]
     result = {
         "metric": "ptp_dispatch_p50_ms",
         "value": round(p50, 4) if p50 else None,

@@ -117,6 +117,9 @@ class PlannerHttpEndpoint:
                     elif path == "/topology":
                         body = endpoint.topology_json().encode()
                         ctype = "application/json"
+                    elif path == "/statemap":
+                        body = endpoint.statemap_json().encode()
+                        ctype = "application/json"
                     else:
                         body = b'{"status": "running"}'
                         ctype = "application/json"
@@ -211,6 +214,18 @@ class PlannerHttpEndpoint:
 
     def healthz_json(self) -> str:
         return json.dumps(self.planner.health_summary())
+
+    def statemap_json(self) -> str:
+        """Cluster state map (ISSUE 16): every host's per-key access
+        ledger merged into per-key master/size/origin rows with hot-key
+        ranking, per-host mastership totals, and the cluster locality
+        ratio — the steering surface for ROADMAP item 2's future
+        replica/placement decisions."""
+        from faabric_tpu.telemetry import aggregate_statemap
+
+        doc = aggregate_statemap(
+            self.planner.collect_telemetry(blocks=("statestats",)))
+        return json.dumps(doc)
 
     def timeseries_json(self) -> str:
         """Cluster-merged time-series rings (ISSUE 14): every host's

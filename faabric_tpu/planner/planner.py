@@ -2243,6 +2243,7 @@ class Planner:
             get_proc_stats,
             get_timeseries,
             perf_telemetry_block,
+            statestats_telemetry_block,
             trace_events,
         )
 
@@ -2259,6 +2260,9 @@ class Planner:
             # ISSUE 15: live device-plane summaries (executable-cache
             # stats, copy accounting) — GET /topology's device block
             "device_planes": device_planes_summary,
+            # ISSUE 16: per-key state access ledger + snapshot lifecycle
+            # stats — GET /statemap merges these across hosts
+            "statestats": statestats_telemetry_block,
         }
         out: dict = {"planner": {name: build() for name, build in
                                  builders.items()

@@ -30,13 +30,24 @@ post-mortem needs no live cluster — and prints a RANKED diagnosis:
   their error budget on every evaluation window;
 - **queue growth / capacity exhaustion** (ISSUE 14) — trends from the
   ``/timeseries`` ring: an ingress depth that keeps growing, or free
-  slots pinned at zero while a backlog holds.
+  slots pinned at zero while a backlog holds;
+- **hot-key skew** (ISSUE 16) — one state key's byte traffic dwarfing
+  the median of the rest of the ``/statemap``;
+- **master hotspot** (ISSUE 16) — one host serving most of the
+  cluster's state bytes as master while others sit idle;
+- **pull amplification** (ISSUE 16) — a key whose replicas keep
+  re-pulling chunks they already pulled clean (total vs first-time
+  chunk pulls);
+- **lock convoy** (ISSUE 16) — global-lock waits on a key repeatedly
+  stalling past ``FAABRIC_STATE_LOCK_STALL_MS``.
 
 ``--selftest`` runs the analyzers over a built-in synthetic cluster
 with one planted slow link, one planted straggler, an escape storm, a
-run-dominated lifecycle tail, a burning latency SLO and a growing
-ingress queue, and exits non-zero unless all of them rank in the
-findings — the smoke gate ``tools/check.sh`` runs.
+run-dominated lifecycle tail, a burning latency SLO, a growing
+ingress queue, and a state map with a planted hot key, master
+hotspot, amplified puller and lock convoy, and exits non-zero unless
+all of them rank in the findings — the smoke gate ``tools/check.sh``
+runs.
 """
 
 from __future__ import annotations
@@ -51,7 +62,7 @@ import sys
 from faabric_tpu.telemetry.perfprofile import _median
 
 SOURCES = ("perf", "metrics", "commmatrix", "healthz", "topology",
-           "timeseries")
+           "timeseries", "statemap")
 
 # File-name candidates per source for --dir mode (first hit wins)
 _FILE_CANDIDATES = {
@@ -61,6 +72,7 @@ _FILE_CANDIDATES = {
     "healthz": ("healthz.json",),
     "topology": ("topology.json",),
     "timeseries": ("timeseries.json",),
+    "statemap": ("statemap.json",),
 }
 
 # A link must carry this many samples before the doctor will call it
@@ -68,6 +80,14 @@ _FILE_CANDIDATES = {
 MIN_LINK_MESSAGES = 5
 SLOW_LINK_RATIO = 0.5     # below this × plane median → finding
 ESCAPE_STORM_RATIO = 0.05  # escapes / coded frames above this → finding
+
+# State-map analyzers (ISSUE 16)
+HOT_KEY_SKEW_RATIO = 8.0      # top key bytes / median of the rest
+MIN_HOT_KEY_BYTES = 1 << 20   # noise floor for skew/hotspot calls
+MASTER_HOTSPOT_SHARE = 0.7    # one master serving this share of bytes
+PULL_AMP_RATIO = 3.0          # total chunk pulls / first-time pulls
+MIN_PULL_CHUNKS = 32          # pulls below this are not a pattern
+MIN_LOCK_STALLS = 2           # one slow acquire is not a convoy
 
 
 # ---------------------------------------------------------------------------
@@ -511,6 +531,126 @@ def check_profile_matrix_agreement(perf: dict | None,
     return findings
 
 
+def _statemap_keys(statemap: dict | None) -> list[dict]:
+    """Ranked key rows minus the cardinality-overflow bucket."""
+    return [r for r in (statemap or {}).get("keys") or []
+            if r.get("key") != "other"]
+
+
+def check_hot_key_skew(statemap: dict | None) -> list[dict]:
+    """One key's byte traffic dwarfing the median of the rest (ISSUE
+    16): the rebuild's replicate-or-repartition candidate. Needs at
+    least three keys — skew against nothing is not a diagnosis."""
+    rows = [r for r in _statemap_keys(statemap)
+            if (r.get("bytes_total") or 0) > 0]
+    if len(rows) < 3:
+        return []
+    top = rows[0]  # aggregate_statemap ranks by -bytes_total
+    med = _median([r.get("bytes_total") or 0 for r in rows[1:]])
+    if top["bytes_total"] < MIN_HOT_KEY_BYTES or med <= 0:
+        return []
+    ratio = top["bytes_total"] / med
+    if ratio < HOT_KEY_SKEW_RATIO:
+        return []
+    origins = sorted((top.get("by_origin") or {}).items(),
+                     key=lambda kv: -kv[1].get("bytes", 0))
+    origin_s = ", ".join(f"{h}={o.get('bytes', 0) >> 20}MiB"
+                         for h, o in origins[:3])
+    return [{
+        "kind": "hot_key_skew",
+        "severity": min(82.0, 45.0 + ratio),
+        "subject": f"state key {top.get('key')}",
+        "detail": (f"{top['bytes_total'] >> 20} MiB of traffic vs "
+                   f"{max(1, int(med)) >> 20} MiB median across "
+                   f"{len(rows) - 1} other key(s) ({ratio:.0f}×); "
+                   f"master {top.get('master') or '?'}"
+                   + (f"; by origin: {origin_s}" if origin_s else "")),
+    }]
+
+
+def check_master_hotspot(statemap: dict | None) -> list[dict]:
+    """One host serving most of the cluster's state bytes as master
+    (ISSUE 16). Served bytes per master = the traffic of every key it
+    masters; only meaningful once a second host participates."""
+    rows = _statemap_keys(statemap)
+    served: dict[str, int] = {}
+    for r in rows:
+        master = r.get("master")
+        if master:
+            served[master] = (served.get(master, 0)
+                              + (r.get("bytes_total") or 0))
+    hosts = (statemap or {}).get("hosts") or {}
+    involved = set(served) | {h for h, row in hosts.items()
+                              if (row.get("origin_bytes") or 0) > 0}
+    total = sum(served.values())
+    if len(involved) < 2 or total < MIN_HOT_KEY_BYTES:
+        return []
+    top_host, top_bytes = max(served.items(), key=lambda kv: kv[1])
+    share = top_bytes / total
+    if share < MASTER_HOTSPOT_SHARE:
+        return []
+    n_keys = sum(1 for r in rows if r.get("master") == top_host)
+    return [{
+        "kind": "master_hotspot",
+        "severity": min(80.0, 40.0 + 40.0 * share),
+        "subject": f"host {top_host}",
+        "detail": (f"masters {n_keys} key(s) carrying "
+                   f"{top_bytes >> 20} MiB of the cluster's "
+                   f"{total >> 20} MiB state traffic ({share:.0%}) "
+                   f"across {len(involved)} involved host(s) — "
+                   "rebalance mastership or replicate the hot keys"),
+    }]
+
+
+def check_pull_amplification(statemap: dict | None) -> list[dict]:
+    """Replicas repeatedly re-pulling chunks they already pulled clean
+    (ISSUE 16): total chunk pulls far above first-time pulls means the
+    full-image invalidation is throwing away clean chunks a future
+    delta-pull path would keep."""
+    findings = []
+    for r in _statemap_keys(statemap):
+        total = r.get("pull_chunks_total") or 0
+        fresh = r.get("pull_chunks_fresh") or 0
+        if total < MIN_PULL_CHUNKS or fresh <= 0:
+            continue
+        amp = total / fresh
+        if amp < PULL_AMP_RATIO:
+            continue
+        findings.append({
+            "kind": "pull_amplification",
+            "severity": min(75.0, 30.0 + amp),
+            "subject": f"state key {r.get('key')}",
+            "detail": (f"{total} chunk pulls for {fresh} first-time "
+                       f"chunks ({amp:.1f}× amplification) — replicas "
+                       "keep re-pulling clean chunks; consider "
+                       "version-gated or delta pulls"),
+        })
+    return findings
+
+
+def check_lock_convoy(statemap: dict | None) -> list[dict]:
+    """Global-lock waits on a key repeatedly stalling past
+    FAABRIC_STATE_LOCK_STALL_MS (ISSUE 16): writers convoying on one
+    lock serialise the cluster no matter how fast the links are."""
+    findings = []
+    for r in _statemap_keys(statemap):
+        stalls = r.get("lock_stalls") or 0
+        waits = r.get("lock_waits") or 0
+        if stalls < MIN_LOCK_STALLS:
+            continue
+        ratio = stalls / max(1, waits)
+        findings.append({
+            "kind": "lock_convoy",
+            "severity": min(85.0, 45.0 + stalls + 40.0 * ratio),
+            "subject": f"state key {r.get('key')}",
+            "detail": (f"{stalls} of {waits} global-lock waits stalled "
+                       f"past the threshold ({ratio:.0%}) — writers are "
+                       "convoying; shard the key or batch the locked "
+                       "section"),
+        })
+    return findings
+
+
 def diagnose(sources: dict) -> list[dict]:
     """Every check over whatever sources are present, ranked most-severe
     first."""
@@ -525,6 +665,10 @@ def diagnose(sources: dict) -> list[dict]:
     findings += check_queue_trend(sources.get("timeseries"))
     findings += check_profile_matrix_agreement(sources.get("perf"),
                                                sources.get("commmatrix"))
+    findings += check_hot_key_skew(sources.get("statemap"))
+    findings += check_master_hotspot(sources.get("statemap"))
+    findings += check_pull_amplification(sources.get("statemap"))
+    findings += check_lock_convoy(sources.get("statemap"))
     findings.sort(key=lambda f: -f["severity"])
     return findings
 
@@ -551,8 +695,10 @@ def selftest_sources() -> dict:
     """A synthetic 3-host cluster with one planted slow link (hA→hC at
     ~1/10 of the plane median), one planted straggler (rank 5 arriving
     ~40 ms late every round), a codec escape storm, a run-dominated
-    lifecycle tail, a burning p99 latency SLO and an ingress queue
-    growing through the time-series window (ISSUE 14)."""
+    lifecycle tail, a burning p99 latency SLO, an ingress queue
+    growing through the time-series window (ISSUE 14), and a state map
+    with a hot key on a master hotspot, an amplified puller and a lock
+    convoy (ISSUE 16)."""
     def link(src, dst, gibs, messages=200, nbytes=512 << 20):
         return {"src": src, "dst": dst, "plane": "bulk-tcp",
                 "codec": "raw", "size_class": "1MiB",
@@ -657,9 +803,55 @@ def selftest_sources() -> dict:
     topology = {"hosts": {}, "worlds": {
         "900": {"size": 8,
                 "hosts": {"hA": [0, 1, 2, 3], "hC": [4, 5, 6, 7]}}}}
+
+    # ISSUE 16 plants, built through the real merge so the selftest
+    # also exercises aggregate_statemap: demo/hot dominates the byte
+    # traffic (hot-key skew) and is mastered on hA, which thereby
+    # serves ~95% of the cluster's state bytes (master hotspot);
+    # demo/amplified re-pulls its chunks 50× (pull amplification);
+    # demo/locky stalls 24 of 120 lock waits (lock convoy).
+    from faabric_tpu.telemetry import aggregate_statemap
+
+    def krow(key, **kw):
+        row = {"key": key, "master": "", "size": 0, "is_master": False,
+               "ops_total": 0, "bytes_total": 0,
+               "local_reads": 0, "remote_reads": 0,
+               "pull_chunks_total": 0, "pull_chunks_fresh": 0,
+               "lock_waits": 0, "lock_stalls": 0}
+        row.update(kw)
+        return row
+
+    def block(*rows):
+        return {"statestats": {"keys": list(rows), "snapshots": {},
+                               "registry_bytes": 0, "max_keys": 256}}
+
+    state_tel = {
+        "hA": block(
+            krow("demo/hot", is_master=True, size=64 << 20,
+                 ops_total=5000, bytes_total=1 << 30, local_reads=5000),
+            krow("demo/amplified", is_master=True, size=8 << 20,
+                 ops_total=50, bytes_total=32 << 20, local_reads=50)),
+        "hB": block(
+            krow("demo/hot", master="hA", size=64 << 20, ops_total=3000,
+                 bytes_total=1 << 30, remote_reads=3000,
+                 pull_chunks_total=600, pull_chunks_fresh=580),
+            krow("demo/amplified", master="hA", ops_total=900,
+                 bytes_total=200 << 20, remote_reads=900,
+                 pull_chunks_total=5000, pull_chunks_fresh=100),
+            krow("demo/locky", master="hC", ops_total=120,
+                 bytes_total=1 << 20, lock_waits=120, lock_stalls=24)),
+        "hC": block(
+            krow("demo/locky", is_master=True, size=1 << 20,
+                 ops_total=10, bytes_total=1 << 20, local_reads=10),
+            krow("demo/cold0", is_master=True, size=1 << 20,
+                 ops_total=20, bytes_total=2 << 20, local_reads=20),
+            krow("demo/cold1", is_master=True, size=1 << 20,
+                 ops_total=20, bytes_total=2 << 20, local_reads=20)),
+    }
+    statemap = aggregate_statemap(state_tel)
     return {"perf": perf, "metrics": metrics, "commmatrix": None,
             "healthz": healthz, "topology": topology,
-            "timeseries": timeseries}
+            "timeseries": timeseries, "statemap": statemap}
 
 
 def run_selftest() -> int:
@@ -694,6 +886,20 @@ def run_selftest() -> int:
         problems.append("planted ingress queue growth not found")
     if "capacity_exhausted" not in all_kinds:
         problems.append("planted capacity exhaustion not found")
+    # ISSUE 16 analyzers: the hot key, its master hotspot, the
+    # amplified puller and the lock convoy must all be found
+    hot = [f for f in findings if f["kind"] == "hot_key_skew"]
+    if not hot or "demo/hot" not in hot[0]["subject"]:
+        problems.append("planted hot key demo/hot not found")
+    hotspot = [f for f in findings if f["kind"] == "master_hotspot"]
+    if not hotspot or "hA" not in hotspot[0]["subject"]:
+        problems.append("planted master hotspot hA not found")
+    amp = [f for f in findings if f["kind"] == "pull_amplification"]
+    if not amp or "demo/amplified" not in amp[0]["subject"]:
+        problems.append("planted pull amplification not found")
+    convoy = [f for f in findings if f["kind"] == "lock_convoy"]
+    if not convoy or "demo/locky" not in convoy[0]["subject"]:
+        problems.append("planted lock convoy demo/locky not found")
     if problems:
         print("doctor selftest FAILED:", "; ".join(problems))
         return 1

@@ -229,6 +229,7 @@ class FunctionCallServer(MessageEndpointServer):
                 get_proc_stats,
                 get_timeseries,
                 perf_telemetry_block,
+                statestats_telemetry_block,
                 trace_events,
             )
 
@@ -252,6 +253,9 @@ class FunctionCallServer(MessageEndpointServer):
                 # (executable-cache stats + copy accounting) for the
                 # planner's GET /topology device block
                 "device_planes": _device_planes_block,
+                # ISSUE 16: this host's per-key state access ledger +
+                # snapshot lifecycle stats (planner GET /statemap)
+                "statestats": statestats_telemetry_block,
             }
             wanted = msg.header.get("blocks")
             body: dict = {name: build() for name, build in

@@ -33,11 +33,13 @@ existing machinery).
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
 from faabric_tpu.device_plane.copies import D2H, H2D, count_copy
 from faabric_tpu.snapshot.snapshot import SnapshotData, SnapshotDiff
+from faabric_tpu.telemetry.statestats import get_state_stats
 
 DEVICE_PAGE_SIZE = 4096
 
@@ -162,11 +164,16 @@ class DeviceSnapshot:
         gathered on device and transferred in one batch. Adjacent dirty
         pages coalesce into a single diff."""
         self._check(arr)
+        stats = get_state_stats()
+        t0 = time.perf_counter() if stats.enabled else 0.0
         # One word image serves the compare, the gather, and (optionally)
         # the baseline refresh — not one transient full-size copy each
         w = _as_word_image(arr)
         idx = np.flatnonzero(self._flags_w(w))
         if idx.size == 0:
+            if stats.enabled:
+                stats.snapshot_event("device_diff",
+                                     seconds=time.perf_counter() - t0)
             return []
         # Pad the index list to a power-of-two bucket (repeating the last
         # page — harmlessly re-gathered, sliced off below) so distinct
@@ -195,6 +202,11 @@ class DeviceSnapshot:
             import jax.numpy as jnp
 
             self._baseline_w = jnp.copy(w)  # reuse the computed image
+        if stats.enabled:
+            stats.snapshot_event(
+                "device_diff", nbytes=sum(len(d.data) for d in diffs),
+                pages=int(idx.size), regions=len(diffs),
+                seconds=time.perf_counter() - t0)
         return diffs
 
     @property

@@ -8,9 +8,15 @@ import threading
 from typing import Optional
 
 from faabric_tpu.snapshot.snapshot import SnapshotData
+from faabric_tpu.telemetry.statestats import get_state_stats
 
 
 class SnapshotRegistry:
+    # Concurrency contract (tools/concheck.py)
+    GUARDS = {
+        "_snapshots": "_lock",
+    }
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._snapshots: dict[str, SnapshotData] = {}
@@ -20,6 +26,7 @@ class SnapshotRegistry:
             raise ValueError("Empty snapshot key")
         with self._lock:
             self._snapshots[key] = snap
+        self._note_residency()
 
     def get_snapshot(self, key: str) -> SnapshotData:
         with self._lock:
@@ -39,11 +46,24 @@ class SnapshotRegistry:
     def delete_snapshot(self, key: str) -> None:
         with self._lock:
             self._snapshots.pop(key, None)
+        self._note_residency()
 
     def get_snapshot_count(self) -> int:
         with self._lock:
             return len(self._snapshots)
 
+    def resident_bytes(self) -> int:
+        """Total bytes of registered snapshot images on this host."""
+        with self._lock:
+            snaps = list(self._snapshots.values())
+        return sum(s.size for s in snaps)
+
     def clear(self) -> None:
         with self._lock:
             self._snapshots.clear()
+        self._note_residency()
+
+    def _note_residency(self) -> None:
+        stats = get_state_stats()
+        if stats.enabled:
+            stats.set_registry_bytes(self.resident_bytes())

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 from typing import TYPE_CHECKING
 
 from faabric_tpu.snapshot.snapshot import (
@@ -20,6 +21,8 @@ from faabric_tpu.snapshot.snapshot import (
     SnapshotDiff,
     SnapshotMergeOperation,
 )
+from faabric_tpu.telemetry import span
+from faabric_tpu.telemetry.statestats import get_state_stats
 from faabric_tpu.transport.client import MessageEndpointClient
 from faabric_tpu.transport.common import (
     SNAPSHOT_ASYNC_PORT,
@@ -122,8 +125,14 @@ class SnapshotClient(MessageEndpointClient):
 
         logger.debug("Pushing snapshot %s (%s) to %s", key,
                      format_byte_size(snap.size), self.host)
-        self.sync_send(int(SnapshotCalls.PUSH_SNAPSHOT), header,
-                       snap.to_bytes())
+        stats = get_state_stats()
+        t0 = time.perf_counter() if stats.enabled else 0.0
+        with span("snapshot", "push", key=key, nbytes=snap.size):
+            self.sync_send(int(SnapshotCalls.PUSH_SNAPSHOT), header,
+                           snap.to_bytes())
+        if stats.enabled:
+            stats.snapshot_event("push", nbytes=snap.size,
+                                 seconds=time.perf_counter() - t0)
 
     def push_snapshot_update(self, key: str,
                              diffs: list[SnapshotDiff]) -> None:
@@ -132,8 +141,15 @@ class SnapshotClient(MessageEndpointClient):
                 _diff_pushes.append((self.host, key, diffs))
             return
         metas, tail = diffs_to_wire(diffs)
-        self.sync_send(int(SnapshotCalls.PUSH_SNAPSHOT_UPDATE),
-                       {"key": key, "diffs": metas}, tail)
+        stats = get_state_stats()
+        t0 = time.perf_counter() if stats.enabled else 0.0
+        with span("snapshot", "push_update", key=key, nbytes=len(tail)):
+            self.sync_send(int(SnapshotCalls.PUSH_SNAPSHOT_UPDATE),
+                           {"key": key, "diffs": metas}, tail)
+        if stats.enabled:
+            stats.snapshot_event("push", nbytes=len(tail),
+                                 regions=len(diffs),
+                                 seconds=time.perf_counter() - t0)
 
     def push_thread_result(self, app_id: int, msg_id: int, return_value: int,
                            key: str, diffs: list[SnapshotDiff]) -> None:
@@ -146,10 +162,17 @@ class SnapshotClient(MessageEndpointClient):
                 _diff_pushes.append((self.host, key, diffs))
             return
         metas, tail = diffs_to_wire(diffs)
-        self.sync_send(int(SnapshotCalls.THREAD_RESULT), {
-            "app_id": app_id, "msg_id": msg_id,
-            "return_value": return_value, "key": key, "diffs": metas,
-        }, tail)
+        stats = get_state_stats()
+        t0 = time.perf_counter() if stats.enabled else 0.0
+        with span("snapshot", "thread_result", key=key, nbytes=len(tail)):
+            self.sync_send(int(SnapshotCalls.THREAD_RESULT), {
+                "app_id": app_id, "msg_id": msg_id,
+                "return_value": return_value, "key": key, "diffs": metas,
+            }, tail)
+        if stats.enabled:
+            stats.snapshot_event("push", nbytes=len(tail),
+                                 regions=len(diffs),
+                                 seconds=time.perf_counter() - t0)
 
     def delete_snapshot(self, key: str) -> None:
         if is_mock_mode():

@@ -84,6 +84,13 @@ class MasterMemoryAuthority(StateAuthority):
 
     local = True
 
+    # Concurrency contract (tools/concheck.py): the append log mutates
+    # under _lock; _value_lock IS the datum clients contend on (held
+    # across their critical sections), never a guard for attributes.
+    GUARDS = {
+        "_appended": "_lock",
+    }
+
     # Slightly under the client socket timeout so a contended lock
     # surfaces as an RPC error on the requester rather than an orphaned
     # server thread that acquires for a dead client
@@ -185,6 +192,15 @@ class SharedFileAuthority(StateAuthority):
     flock on ``<safe>.lock``."""
 
     local = False  # nothing for the StateServer to serve
+
+    # Concurrency contract (tools/concheck.py). NOT listed: _lock_fd —
+    # lock()/unlock() mutate it outside _iolock on purpose, because the
+    # flock handoff itself serialises them (one holder at a time) and
+    # taking _iolock there would stall every reader behind a 30 s
+    # contended-lock poll loop.
+    GUARDS = {
+        "_mm": "_iolock",
+    }
 
     def __init__(self, user: str, key: str, size: int,
                  state_dir: str) -> None:

@@ -12,11 +12,22 @@ get(...))`` — state stays host-resident, chips pull what they need).
 Authority interactions (where the authoritative bytes live) go through a
 pluggable :mod:`faabric_tpu.state.backend` — planner-elected in-memory
 masters by default, shared-memory files with ``STATE_MODE=file``.
+
+Observability (ISSUE 16): every op feeds the per-key access ledger
+(:mod:`faabric_tpu.telemetry.statestats` — op counts, bytes, chunk
+counts, dirty ratios, latency quantiles, pull amplification), remote
+chunk traffic rides ``plane=state`` comm-matrix rows and ``state/*``
+spans, pull/push failures and global-lock stalls flight-record, and
+in-run state time charges the invocation's lifecycle ledger
+(``charge_state_time``) so ``/healthz`` can attribute state-bound
+invocations. With ``FAABRIC_METRICS=0`` every handle is the shared
+no-op singleton and the hot paths skip even the clock reads.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -26,6 +37,14 @@ from faabric_tpu.state.backend import (
     RemoteAuthority,
     StateAuthority,
 )
+from faabric_tpu.telemetry.commmatrix import get_comm_matrix
+from faabric_tpu.telemetry.flight import flight_record
+from faabric_tpu.telemetry.lifecycle import charge_state_time
+from faabric_tpu.telemetry.statestats import (
+    get_state_stats,
+    lock_stall_threshold_s,
+)
+from faabric_tpu.telemetry.tracer import span
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -38,14 +57,31 @@ def n_chunks(size: int) -> int:
 
 
 class StateKeyValue:
+    # Concurrency contract (tools/concheck.py): the image and every
+    # mask/cache derived from it mutate under the one RLock. Telemetry
+    # handles (_stats, _comm) are write-once in __init__ and internally
+    # locked — plain attribute reads thereafter.
+    GUARDS = {
+        "_data": "_lock",
+        "_pulled": "_lock",
+        "_ever_pulled": "_lock",
+        "_dirty": "_lock",
+        "_n_dirty": "_lock",
+        "_version": "_lock",
+        "_device_cache": "_lock",
+    }
+
     def __init__(self, user: str, key: str, size: int,
                  is_master: bool, master_host: str,
                  client_factory=None,
-                 authority: Optional[StateAuthority] = None) -> None:
+                 authority: Optional[StateAuthority] = None,
+                 local_host: str = "") -> None:
         self.user = user
         self.key = key
         self.size = size
         self.master_host = master_host
+        self.full_key = f"{user}/{key}"
+        self.local_host = local_host or "local"
 
         if authority is None:
             authority = (MasterMemoryAuthority(user, key) if is_master
@@ -65,7 +101,17 @@ class StateKeyValue:
         chunks = n_chunks(size)
         # Local-authority data is authoritative: everything is "pulled"
         self._pulled = np.full(chunks, self.is_master, dtype=bool)
+        # Monotone: chunks pulled at least once, ever — the denominator
+        # of the pull-amplification signal (pull() resets _pulled but
+        # never this, so a re-pull of a clean chunk reads as repeat)
+        self._ever_pulled = np.full(chunks, self.is_master, dtype=bool)
         self._dirty = np.zeros(chunks, dtype=bool)
+        self._n_dirty = 0
+
+        self._stats = get_state_stats()
+        self._comm = get_comm_matrix()
+        self._stats.note_key(self.full_key, master=master_host,
+                             size=size, is_master=self.is_master)
 
     # ------------------------------------------------------------------
     def _chunk_range(self, offset: int, length: int) -> tuple[int, int]:
@@ -73,34 +119,68 @@ class StateKeyValue:
         last = (offset + max(1, length) - 1) // STATE_CHUNK_SIZE
         return first, last + 1
 
-    def _ensure_pulled(self, offset: int, length: int) -> None:
+    def _ensure_pulled(self, offset: int, length: int) -> int:
+        """Pull any not-yet-pulled chunks covering the range from the
+        authority; returns how many chunks travelled (0 = the read was
+        served entirely from the local image)."""
         if self.is_master:
-            return
+            return 0
         first, last = self._chunk_range(offset, length)
         with self._lock:
             missing = [c for c in range(first, min(last, self._pulled.size))
                        if not self._pulled[c]]
+            fresh = sum(1 for c in missing if not self._ever_pulled[c])
         if not missing:
-            return
-        for c in missing:
-            lo = c * STATE_CHUNK_SIZE
-            hi = min(self.size, lo + STATE_CHUNK_SIZE)
-            data = self.authority.pull_chunk(lo, hi - lo)
-            with self._lock:
-                self._data[lo:lo + len(data)] = np.frombuffer(data, np.uint8)
-                self._pulled[c] = True
-                self._bump_version()
+            return 0
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        nbytes = 0
+        with span("state", "pull", key=self.full_key,
+                  chunks=len(missing)):
+            for c in missing:
+                lo = c * STATE_CHUNK_SIZE
+                hi = min(self.size, lo + STATE_CHUNK_SIZE)
+                try:
+                    data = self.authority.pull_chunk(lo, hi - lo)
+                except Exception as e:  # noqa: BLE001 — record, re-raise
+                    flight_record("state_pull_fail", key=self.full_key,
+                                  master=self.master_host, offset=lo,
+                                  error=repr(e))
+                    raise
+                nbytes += len(data)
+                with self._lock:
+                    self._data[lo:lo + len(data)] = np.frombuffer(
+                        data, np.uint8)
+                    self._pulled[c] = True
+                    self._ever_pulled[c] = True
+                    self._bump_version_locked()
+        if recording:
+            dt_ns = time.monotonic_ns() - t0
+            charge_state_time(dt_ns)
+            self._stats.record(self.full_key, "pull", nbytes=nbytes,
+                               chunks=len(missing), fresh_chunks=fresh,
+                               seconds=dt_ns / 1e9, remote=True)
+            # Master→client chunk traffic on the state plane: raw ==
+            # wire today; a future delta-push path diverges them
+            self._comm.record(self.master_host, self.local_host,
+                              "state", nbytes, seconds=dt_ns / 1e9,
+                              raw_bytes=nbytes)
+        return len(missing)
 
     # ------------------------------------------------------------------
     # Reads
     # ------------------------------------------------------------------
     def get(self) -> bytes:
-        self._ensure_pulled(0, self.size)
+        pulled = self._ensure_pulled(0, self.size)
+        self._stats.record(self.full_key, "get", nbytes=self.size,
+                           remote=pulled > 0)
         with self._lock:
             return self._data.tobytes()
 
     def get_array(self) -> np.ndarray:
-        self._ensure_pulled(0, self.size)
+        pulled = self._ensure_pulled(0, self.size)
+        self._stats.record(self.full_key, "get", nbytes=self.size,
+                           remote=pulled > 0)
         with self._lock:
             return self._data.copy()
 
@@ -109,7 +189,9 @@ class StateKeyValue:
             raise ValueError(
                 f"Chunk [{offset}, {offset + length}) out of bounds "
                 f"(size {self.size})")
-        self._ensure_pulled(offset, length)
+        pulled = self._ensure_pulled(offset, length)
+        self._stats.record(self.full_key, "get_chunk", nbytes=length,
+                           remote=pulled > 0)
         with self._lock:
             return self._data[offset:offset + length].tobytes()
 
@@ -123,7 +205,12 @@ class StateKeyValue:
             self._data[:] = np.frombuffer(data, np.uint8)
             self._pulled[:] = True
             self._dirty[:] = True
-            self._bump_version()
+            self._n_dirty = int(self._dirty.size)
+            self._bump_version_locked()
+            n_dirty = self._n_dirty
+        self._stats.record(self.full_key, "set", nbytes=self.size,
+                           chunks=n_chunks(self.size))
+        self._stats.set_dirty_outstanding(self.full_key, n_dirty)
 
     def set_chunk(self, offset: int, data: bytes) -> None:
         if offset + len(data) > self.size:
@@ -132,9 +219,14 @@ class StateKeyValue:
         with self._lock:
             self._data[offset:offset + len(data)] = np.frombuffer(data,
                                                                   np.uint8)
+            self._n_dirty += int((~self._dirty[first:last]).sum())
             self._dirty[first:last] = True
             self._pulled[first:last] = True
-            self._bump_version()
+            self._bump_version_locked()
+            n_dirty = self._n_dirty
+        self._stats.record(self.full_key, "set_chunk",
+                           nbytes=len(data), chunks=last - first)
+        self._stats.set_dirty_outstanding(self.full_key, n_dirty)
 
     # ------------------------------------------------------------------
     # Push / pull (non-master ↔ master)
@@ -143,38 +235,96 @@ class StateKeyValue:
         if self.is_master:
             with self._lock:
                 self._dirty[:] = False
+                self._n_dirty = 0
+            self._stats.set_dirty_outstanding(self.full_key, 0)
             return
-        self.authority.push_chunk(0, self.get())
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        with self._lock:
+            dirty_before = self._n_dirty
+        payload = self.get()
+        with span("state", "push_full", key=self.full_key,
+                  nbytes=len(payload)):
+            try:
+                self.authority.push_chunk(0, payload)
+            except Exception as e:  # noqa: BLE001 — record, re-raise
+                flight_record("state_push_fail", key=self.full_key,
+                              master=self.master_host, op="push_full",
+                              error=repr(e))
+                raise
         with self._lock:
             self._dirty[:] = False
+            self._n_dirty = 0
+        if recording:
+            dt_ns = time.monotonic_ns() - t0
+            charge_state_time(dt_ns)
+            self._stats.record(self.full_key, "push_full",
+                               nbytes=len(payload),
+                               chunks=n_chunks(self.size),
+                               dirty_chunks=dirty_before,
+                               seconds=dt_ns / 1e9, remote=True)
+            self._comm.record(self.local_host, self.master_host,
+                              "state", len(payload),
+                              seconds=dt_ns / 1e9,
+                              raw_bytes=len(payload))
+            self._stats.set_dirty_outstanding(self.full_key, 0)
 
     def push_partial(self) -> None:
         """Push only the dirty chunks (reference pushPartial)."""
         if self.is_master:
             with self._lock:
                 self._dirty[:] = False
+                self._n_dirty = 0
+            self._stats.set_dirty_outstanding(self.full_key, 0)
             return
         with self._lock:
             dirty = [int(c) for c in np.where(self._dirty)[0]]
         if not dirty:
             return
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        nbytes = 0
         # Batched pushes (backends that can pipeline — redis — send each
         # group in one round-trip), bounded to a few MiB per group so a
         # fully-dirty multi-GiB value neither doubles peak memory nor
         # holds the kv lock for the whole payload copy
         group_chunks = max(1, (4 << 20) // STATE_CHUNK_SIZE)
-        for g in range(0, len(dirty), group_chunks):
-            group = dirty[g:g + group_chunks]
+        with span("state", "push_partial", key=self.full_key,
+                  chunks=len(dirty)):
+            for g in range(0, len(dirty), group_chunks):
+                group = dirty[g:g + group_chunks]
+                with self._lock:
+                    writes = []
+                    for c in group:
+                        lo = c * STATE_CHUNK_SIZE
+                        hi = min(self.size, lo + STATE_CHUNK_SIZE)
+                        writes.append((lo, self._data[lo:hi].tobytes()))
+                try:
+                    self.authority.push_chunks(writes)
+                except Exception as e:  # noqa: BLE001 — record, re-raise
+                    flight_record("state_push_fail", key=self.full_key,
+                                  master=self.master_host,
+                                  op="push_partial", error=repr(e))
+                    raise
+                nbytes += sum(len(d) for _off, d in writes)
+                with self._lock:
+                    for c in group:
+                        self._dirty[c] = False
+                    self._n_dirty = int(self._dirty.sum())
+        if recording:
+            dt_ns = time.monotonic_ns() - t0
+            charge_state_time(dt_ns)
+            self._stats.record(self.full_key, "push_partial",
+                               nbytes=nbytes,
+                               chunks=n_chunks(self.size),
+                               dirty_chunks=len(dirty),
+                               seconds=dt_ns / 1e9, remote=True)
+            self._comm.record(self.local_host, self.master_host,
+                              "state", nbytes, seconds=dt_ns / 1e9,
+                              raw_bytes=nbytes)
             with self._lock:
-                writes = []
-                for c in group:
-                    lo = c * STATE_CHUNK_SIZE
-                    hi = min(self.size, lo + STATE_CHUNK_SIZE)
-                    writes.append((lo, self._data[lo:hi].tobytes()))
-            self.authority.push_chunks(writes)
-            with self._lock:
-                for c in group:
-                    self._dirty[c] = False
+                n_dirty = self._n_dirty
+            self._stats.set_dirty_outstanding(self.full_key, n_dirty)
 
     def pull(self) -> None:
         """Re-pull the whole value from the master."""
@@ -192,7 +342,17 @@ class StateKeyValue:
     # Appends (reference append/getAppended/clearAppended)
     # ------------------------------------------------------------------
     def append(self, data: bytes) -> None:
-        self.authority.append(data)
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        with span("state", "append", key=self.full_key,
+                  nbytes=len(data)):
+            self.authority.append(data)
+        if recording:
+            dt_ns = time.monotonic_ns() - t0
+            charge_state_time(dt_ns)
+            self._stats.record(self.full_key, "append",
+                               nbytes=len(data), seconds=dt_ns / 1e9,
+                               remote=not self.is_master)
 
     def get_appended(self, n_values: int) -> list[bytes]:
         return self.authority.get_appended(n_values)
@@ -204,7 +364,22 @@ class StateKeyValue:
     # Locks (authority-hosted)
     # ------------------------------------------------------------------
     def lock_global(self) -> None:
-        self.authority.lock()
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        with span("state", "lock_global", key=self.full_key):
+            self.authority.lock()
+        if recording:
+            wait_s = (time.monotonic_ns() - t0) / 1e9
+            charge_state_time(int(wait_s * 1e9))
+            stalled = wait_s >= lock_stall_threshold_s()
+            self._stats.record(self.full_key, "lock_global",
+                               seconds=wait_s,
+                               remote=not self.is_master)
+            self._stats.lock_wait(self.full_key, wait_s, stalled=stalled)
+            if stalled:
+                flight_record("state_lock_stall", key=self.full_key,
+                              master=self.master_host,
+                              wait_ms=round(wait_s * 1e3, 3))
 
     def unlock_global(self) -> None:
         self.authority.unlock()
@@ -249,11 +424,15 @@ class StateKeyValue:
                 f"device value is {host.size} bytes, KV holds {self.size}")
         self.set(host.tobytes())
 
-    def _bump_version(self) -> None:
+    def _bump_version_locked(self) -> None:
         self._version += 1
         self._device_cache.clear()
 
     # -- master-side entry points used by the StateServer ---------------
+    # Serving traffic is deliberately NOT ledgered here: each client
+    # records its own pulls/pushes, and the statemap merge attributes
+    # origin from those client-side rows — a server-side record would
+    # double-count every remote byte in the cluster totals.
     def server_pull_chunk(self, offset: int, length: int) -> bytes:
         with self._lock:
             return self._data[offset:offset + length].tobytes()
@@ -266,7 +445,7 @@ class StateKeyValue:
             self._data[offset:offset + len(data)] = np.frombuffer(data,
                                                                   np.uint8)
             self._pulled[first:last] = True
-            self._bump_version()
+            self._bump_version_locked()
 
     def server_append(self, data: bytes) -> None:
         self.authority.append(data)

@@ -11,6 +11,7 @@ import enum
 import threading
 from typing import TYPE_CHECKING
 
+from faabric_tpu.telemetry import flight_record, span
 from faabric_tpu.transport.client import MessageEndpointClient
 from faabric_tpu.transport.common import (
     STATE_ASYNC_PORT,
@@ -40,6 +41,8 @@ class StateCalls(enum.IntEnum):
     LOCK = 8
     UNLOCK = 9
 
+
+_OP_NAMES = {int(c): c.name.lower() for c in StateCalls}
 
 _mock_lock = threading.Lock()
 # (host, user, key, offset, data)
@@ -133,46 +136,53 @@ class StateServer(MessageEndpointServer):
         code = msg.code
         h = msg.header
         user, key = h["user"], h["key"]
+        op = _OP_NAMES.get(code, str(code))
 
         kv = self.state.try_get_kv(user, key)
         if kv is None or not kv.is_master:
+            # A replica asked the wrong host: stale master routing. Worth a
+            # black-box record — a burst of these means the planner's master
+            # table and the clients' cached masters have diverged.
+            flight_record("state_not_master", key=f"{user}/{key}",
+                          host=self.state.host, op=op)
             raise KeyError(f"Host is not master for state {user}/{key}")
 
-        if code == int(StateCalls.PULL):
-            data = kv.server_pull_chunk(h["offset"], h["length"])
-            return handler_response(payload=data)
+        with span("state", f"serve_{op}", key=f"{user}/{key}"):
+            if code == int(StateCalls.PULL):
+                data = kv.server_pull_chunk(h["offset"], h["length"])
+                return handler_response(payload=data)
 
-        if code == int(StateCalls.PUSH):
-            kv.server_push_chunk(h["offset"], msg.payload)
-            return handler_response()
+            if code == int(StateCalls.PUSH):
+                kv.server_push_chunk(h["offset"], msg.payload)
+                return handler_response()
 
-        if code == int(StateCalls.SIZE):
-            return handler_response(header={"size": kv.size})
+            if code == int(StateCalls.SIZE):
+                return handler_response(header={"size": kv.size})
 
-        if code == int(StateCalls.APPEND):
-            kv.server_append(msg.payload)
-            return handler_response()
+            if code == int(StateCalls.APPEND):
+                kv.server_append(msg.payload)
+                return handler_response()
 
-        if code == int(StateCalls.PULL_APPENDED):
-            values = kv.get_appended(h["n_values"])
-            return handler_response(
-                header={"lengths": [len(v) for v in values]},
-                payload=b"".join(values))
+            if code == int(StateCalls.PULL_APPENDED):
+                values = kv.get_appended(h["n_values"])
+                return handler_response(
+                    header={"lengths": [len(v) for v in values]},
+                    payload=b"".join(values))
 
-        if code == int(StateCalls.CLEAR_APPENDED):
-            kv.clear_appended()
-            return handler_response()
+            if code == int(StateCalls.CLEAR_APPENDED):
+                kv.clear_appended()
+                return handler_response()
 
-        if code == int(StateCalls.DELETE):
-            self.state.delete_kv(user, key)
-            return handler_response()
+            if code == int(StateCalls.DELETE):
+                self.state.delete_kv(user, key)
+                return handler_response()
 
-        if code == int(StateCalls.LOCK):
-            kv.lock_global()
-            return handler_response()
+            if code == int(StateCalls.LOCK):
+                kv.lock_global()
+                return handler_response()
 
-        if code == int(StateCalls.UNLOCK):
-            kv.unlock_global()
-            return handler_response()
+            if code == int(StateCalls.UNLOCK):
+                kv.unlock_global()
+                return handler_response()
 
         raise ValueError(f"Unknown sync state call {code}")

@@ -17,6 +17,11 @@ logger = get_logger(__name__)
 
 
 class State:
+    # Concurrency contract (tools/concheck.py)
+    GUARDS = {
+        "_kvs": "_lock",
+    }
+
     def __init__(self, host: str, planner_client=None) -> None:
         self.host = host
         self.planner_client = planner_client
@@ -50,7 +55,7 @@ class State:
 
             authority = RedisAuthority(user, key, size)
             kv = StateKeyValue(user, key, authority.size, False, "<redis>",
-                               authority=authority)
+                               authority=authority, local_host=self.host)
         elif mode != "inmemory":
             raise ValueError(f"Unknown STATE_MODE {mode!r}")
         else:
@@ -79,16 +84,21 @@ class State:
                     "needs an explicit size")
         authority = SharedFileAuthority(user, key, size, conf.state_dir)
         return StateKeyValue(user, key, authority.size, False, "<file>",
-                             authority=authority)
+                             authority=authority, local_host=self.host)
 
     def _make_inmemory_kv(self, user: str, key: str,
                           size: int) -> StateKeyValue:
+        from faabric_tpu.telemetry import flight_record
+
         full = f"{user}/{key}"
         if self.planner_client is not None:
             master = self.planner_client.claim_state_master(user, key)
         else:
             master = self.host
         is_master = master == self.host
+        if is_master:
+            flight_record("state_master_claim", key=full, host=self.host,
+                          size=max(size, 0))
 
         if size <= 0:
             if is_master:
@@ -98,6 +108,8 @@ class State:
                 if self.planner_client is not None:
                     try:
                         self.planner_client.drop_state_master(user, key)
+                        flight_record("state_master_drop", key=full,
+                                      host=self.host, reason="no_size")
                     except Exception:  # noqa: BLE001
                         logger.warning("Could not release claim on %s", full)
                 raise ValueError(
@@ -105,7 +117,8 @@ class State:
             size = self._client_factory(master).state_size(user, key)
 
         return StateKeyValue(user, key, size, is_master, master,
-                             client_factory=self._client_factory)
+                             client_factory=self._client_factory,
+                             local_host=self.host)
 
     def try_get_kv(self, user: str, key: str) -> Optional[StateKeyValue]:
         with self._lock:
@@ -118,6 +131,10 @@ class State:
                 and self.planner_client is not None:
             try:
                 self.planner_client.drop_state_master(user, key)
+                from faabric_tpu.telemetry import flight_record
+
+                flight_record("state_master_drop", key=f"{user}/{key}",
+                              host=self.host, reason="delete")
             except Exception:  # noqa: BLE001
                 logger.debug("Could not drop master for %s/%s", user, key)
 

@@ -72,6 +72,15 @@ PHASE_RESULT_PUSH = "rsp"      # worker pushing the result
 PHASE_RECORDED = "rec"         # planner recorded the result
 PHASE_WAITER_WAKE = "wwk"      # waiting client woken with the result
 
+# NOT a stamp: accumulated in-run state pull/push nanoseconds (ISSUE
+# 16). Written by charge_state_time() from the state hot paths while an
+# ExecutorContext is set; ledger_durations() carves it out of the run
+# window as its own "state" phase so /healthz dominant-phase ranking
+# can attribute state-bound invocations (they used to read as opaque
+# "run"). A duration key must never enter the time-sorted stamp walk —
+# its value is an interval, not a point on the monotonic clock.
+PHASE_STATE_ACC = "stx"
+
 # Duration label for the gap ENDING at each stamp (time-sorted — a
 # requeued message's second-attempt dispatch stamp lands after its
 # requeue stamp, and the sort attributes the gaps truthfully).
@@ -157,6 +166,26 @@ def get_lifecycle() -> Lifecycle | _NullLifecycle:
     return _lifecycle
 
 
+def charge_state_time(ns: int) -> None:
+    """Charge ``ns`` nanoseconds of state pull/push time to the message
+    currently executing on THIS thread (ISSUE 16). No-op unless the
+    lifecycle plane is on AND an ExecutorContext is set — state ops
+    from non-executor threads (benches, servers, tests) charge nobody.
+    Accumulates: one run window may perform many state ops."""
+    if not get_lifecycle().enabled or ns <= 0:
+        return
+    try:
+        from faabric_tpu.executor.context import ExecutorContext
+
+        if not ExecutorContext.is_set():
+            return
+        msg = ExecutorContext.get().msg
+        msg.lc[PHASE_STATE_ACC] = (
+            msg.lc.get(PHASE_STATE_ACC, 0) + int(ns))
+    except Exception:  # noqa: BLE001 — attribution must never kill an op
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Pure ledger analysis
 # ---------------------------------------------------------------------------
@@ -166,15 +195,28 @@ def ledger_durations(lc: dict) -> dict[str, float]:
     TIME (not taxonomy order — a requeue reorders the tail) and each
     gap is attributed to the label of the stamp that ends it. Negative
     gaps (cross-machine clock offset) clamp to 0. Unknown keys keep
-    their raw name so a future phase never silently vanishes."""
-    stamps = sorted(((int(v), k) for k, v in (lc or {}).items()
-                     if isinstance(v, (int, float))))
+    their raw name so a future phase never silently vanishes.
+
+    ``stx`` (ISSUE 16) is a DURATION, not a stamp: accumulated in-run
+    state pull/push ns. It is excluded from the stamp walk and carved
+    OUT of the run window (``state`` + ``run`` still sum to the old
+    ``run``, so the fold's clock-coherence guard is unaffected)."""
+    lc = lc or {}
+    stamps = sorted(((int(v), k) for k, v in lc.items()
+                     if isinstance(v, (int, float))
+                     and k != PHASE_STATE_ACC))
     out: dict[str, float] = {}
     for i in range(1, len(stamps)):
         t, key = stamps[i]
         label = PHASE_LABELS.get(key, key)
         out[label] = out.get(label, 0.0) + max(
             0.0, (t - stamps[i - 1][0]) / 1e9)
+    acc = lc.get(PHASE_STATE_ACC)
+    if isinstance(acc, (int, float)) and acc > 0 and "run" in out:
+        state = min(out["run"], int(acc) / 1e9)
+        if state > 0:
+            out["state"] = state
+            out["run"] -= state
     return out
 
 

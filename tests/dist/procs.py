@@ -1074,6 +1074,27 @@ class DistExecutor(Executor):
         msg.output_data = b"state-ok"
         return int(ReturnValue.SUCCESS)
 
+    def fn_state_hot(self, msg, req):
+        """ISSUE 16 statemap acceptance: hammer the planted hot key
+        from this (non-master) host — repeated full re-pulls (pull
+        amplification) plus a two-chunk dirty push — and report the
+        wire bytes moved, so the test can check the per-key ledger
+        against the plane=state comm-matrix rows independently."""
+        from faabric_tpu.state import STATE_CHUNK_SIZE
+
+        state = self.scheduler.state
+        kv = state.get_kv("dist", "hot")
+        wire = 0
+        for _ in range(3):
+            kv.pull()
+            wire += kv.size
+        kv.set_chunk(0, b"\x09" * STATE_CHUNK_SIZE)
+        kv.set_chunk(2 * STATE_CHUNK_SIZE, b"\x09" * STATE_CHUNK_SIZE)
+        wire += kv.n_dirty_chunks() * STATE_CHUNK_SIZE
+        kv.push_partial()
+        msg.output_data = f"wire={wire}".encode()
+        return int(ReturnValue.SUCCESS)
+
 
 class DistFactory(ExecutorFactory):
     def create_executor(self, msg):

@@ -155,6 +155,36 @@ def test_gate_lifecycle_plane_keys_reported_only_first_round(tmp_path,
     assert "lifecycle_stamp_ns" in out and "reported-only" in out
 
 
+def test_gate_state_plane_keys_reported_only_first_round(tmp_path,
+                                                         capsys):
+    """ISSUE 16 first-round keys: the state-plane figures (hot read,
+    replica pull/push throughput, ledger record cost enabled vs no-op)
+    are tracked but not gated until a round of spread exists — and the
+    direction regexes classify them correctly (_ns lower-better, _gibs
+    higher-better)."""
+    for key in ("state_hot_read_ns", "statestats_record_ns",
+                "statestats_record_noop_ns"):
+        assert key in bench_gate.REPORTED_ONLY
+        assert bench_gate.direction(key) == -1
+    for key in ("state_pull_gibs", "state_push_partial_gibs"):
+        assert key in bench_gate.REPORTED_ONLY
+        assert bench_gate.direction(key) == 1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"state_hot_read_ns": 2500.0, "state_pull_gibs": 0.06,
+                  "state_push_partial_gibs": 0.05,
+                  "statestats_record_ns": 1800.0,
+                  "statestats_record_noop_ns": 90.0})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"state_hot_read_ns": 9000.0,     # +260%: reported only
+                  "state_pull_gibs": 0.01,         # -83%: reported only
+                  "state_push_partial_gibs": 0.05,
+                  "statestats_record_ns": 1700.0,
+                  "statestats_record_noop_ns": 95.0})
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "state_hot_read_ns" in out and "reported-only" in out
+
+
 def test_gate_device_plane_key_reported_only_first_round(tmp_path,
                                                          capsys):
     """ISSUE 10 first-round key: the device-plane allreduce rate is

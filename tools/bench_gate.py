@@ -88,6 +88,13 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # transfers are PCIe/DMA); device_host_copy_bytes is the asserted-zero
 # copy accounting for the timed resident rounds (lower-is-better —
 # _bytes direction pinned in the unit test).
+# ISSUE 16 state-plane keys (first recorded round, promote next):
+# state_hot_read_ns is the one-chunk master-image read a training loop
+# pays per step; state_pull_gibs / state_push_partial_gibs the replica
+# chunk-protocol throughput over loopback TCP (latency-bound — per-4KiB
+# round-trips, not memcpy); statestats_record_ns the enabled access-
+# ledger feed and statestats_record_noop_ns its FAABRIC_METRICS=0
+# floor (contract: one no-op method call, ≲100 ns).
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "lifecycle_stamp_ns", "invocation_p99_ms",
                  "host_allreduce_device_gibs",
@@ -101,7 +108,10 @@ REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "delta_stream_raw_gibs", "delta_stream_speedup",
                  "delta_stream_wire_speedup",
                  "perf_feed_ns", "perf_feed_noop_ns",
-                 "doctor_selftest_ms")
+                 "doctor_selftest_ms",
+                 "state_hot_read_ns", "state_pull_gibs",
+                 "state_push_partial_gibs",
+                 "statestats_record_ns", "statestats_record_noop_ns")
 
 # Round-5 container drift (see ROADMAP "Recent"): ptp dispatch p50 (the
 # headline "value") and delta_apply_reuse_ms read worse in ANY tree on
