@@ -2053,6 +2053,78 @@ def bench_lifecycle(quick: bool = False) -> dict:
     }
 
 
+def bench_continuous_profile(quick: bool = False) -> dict:
+    """ISSUE 18: the always-on stack sampler's three contract figures.
+    (a) one sampler pass — ``sys._current_frames`` walk +
+    ``/proc/self/task`` CPU scan + trie fold — the cost every
+    ``FAABRIC_PROFILE_INTERVAL_MS`` tick pays; (b) the sampler's
+    measured drag while a CPU-bound workload runs at the default 25 ms
+    cadence (acceptance: ≤ 2%); (c) the GIL-pressure drift gauge on an
+    idle process (contract: ~0 — a hot reading here means the
+    estimator, not the workload, is noisy)."""
+    from faabric_tpu.telemetry.profiler import Profiler
+
+    p = Profiler(interval_s=0.025)
+    n = 200 if quick else 1_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        p.sample_now(0.0)
+    sample_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # Measured drag = min-of-trials wall time for a FIXED CPU-bound
+    # work unit, sampler off vs on. min-of is the low-noise estimator
+    # (scheduler preemption only ever ADDS time) and still includes the
+    # sampler's cost, which recurs every 25 ms tick regardless. The
+    # sampler's self-measured cost share rides as a companion figure —
+    # it OVERSTATES under GIL contention (its GIL wait counts toward
+    # the sample cost while the workload keeps running).
+    def _burn_units(units: int) -> float:
+        x = 1
+        t0 = time.perf_counter()
+        for _ in range(units * 10_000):
+            x = (x * 48271) % 2147483647
+        return time.perf_counter() - t0
+
+    per_unit = _burn_units(5) / 5
+    work = max(1, int((0.3 if quick else 0.8) / per_unit))
+    trials = 3 if quick else 5
+    # Interleaved off/on pairs so slow container drift (cold caches,
+    # background settling) hits both sides equally; median of the
+    # per-pair deltas so one descheduled trial on this 1-core container
+    # cannot fake (or mask) a regression
+    prof = Profiler(interval_s=0.025)
+    deltas = []
+    for _ in range(trials):
+        off_t = _burn_units(work)
+        prof.start()
+        try:
+            on_t = _burn_units(work)
+        finally:
+            prof.stop()
+        if off_t > 0:
+            deltas.append((on_t - off_t) / off_t * 100.0)
+    busy = prof.snapshot()
+    deltas.sort()
+    overhead_pct = max(0.0, deltas[len(deltas) // 2]) if deltas else 0.0
+
+    idle = Profiler(interval_s=0.025)
+    idle.start()
+    try:
+        time.sleep(0.5 if quick else 1.0)
+    finally:
+        idle.stop()
+    idle_snap = idle.snapshot()
+    return {
+        "sample_ns": round(sample_ns, 1),
+        "overhead_pct": round(overhead_pct, 3),
+        "sampler_cost_pct": busy["overhead_pct"],
+        "samples": busy["samples"],
+        "gil_pressure_busy": busy["gil"]["pressure"],
+        "gil_pressure_idle": idle_snap["gil"]["pressure"],
+        "idle_samples": idle_snap["samples"],
+    }
+
+
 def bench_state(quick: bool = False) -> dict:
     """ISSUE 16 state plane: master-image hot reads, replica pull and
     dirty-chunk partial push over a real loopback StateServer, and the
@@ -3533,6 +3605,8 @@ def main() -> None:
                  lambda: bench_perf_introspection(quick))
     host_section("lifecycle", lambda: bench_lifecycle(quick))
     host_section("state", lambda: bench_state(quick))
+    host_section("continuous_profile",
+                 lambda: bench_continuous_profile(quick))
 
     if not quick or os.environ.get("BENCH_DEVICE") == "1":
         # Device phase: TPU first with per-section watchdogs; CPU tiny
@@ -3707,6 +3781,17 @@ def main() -> None:
                      ("record_noop_ns", "statestats_record_noop_ns")):
         if st.get(src) is not None:
             summary[dst] = st[src]
+    # ISSUE 18 continuous-profiling keys (REPORTED_ONLY this round, all
+    # three lower-is-better — directions pinned in the unit test): one
+    # stack-sampler pass, the measured busy-workload drag at the
+    # default 25 ms cadence (acceptance ≤ 2%), and the idle-process
+    # GIL drift gauge (contract ~0)
+    cp = extras.get("continuous_profile") or {}
+    for src, dst in (("sample_ns", "profile_sample_ns"),
+                     ("overhead_pct", "profile_overhead_pct"),
+                     ("gil_pressure_idle", "gil_pressure_idle")):
+        if cp.get(src) is not None:
+            summary[dst] = cp[src]
     result = {
         "metric": "ptp_dispatch_p50_ms",
         "value": round(p50, 4) if p50 else None,

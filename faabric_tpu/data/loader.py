@@ -145,7 +145,7 @@ class DataLoader:
             finally:
                 put(_END)
 
-        t = threading.Thread(target=producer, name="dataloader-prefetch",
+        t = threading.Thread(target=producer, name="data/prefetch",
                              daemon=True)
         t.start()
         try:

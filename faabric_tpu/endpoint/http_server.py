@@ -120,6 +120,9 @@ class PlannerHttpEndpoint:
                     elif path == "/statemap":
                         body = endpoint.statemap_json().encode()
                         ctype = "application/json"
+                    elif path == "/profile":
+                        body = endpoint.profile_json().encode()
+                        ctype = "application/json"
                     else:
                         body = b'{"status": "running"}'
                         ctype = "application/json"
@@ -143,7 +146,7 @@ class PlannerHttpEndpoint:
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
-                                        name="planner-http", daemon=True)
+                                        name="endpoint/planner-http", daemon=True)
         self._thread.start()
         logger.debug("Planner HTTP endpoint on :%d", self.port)
 
@@ -225,6 +228,18 @@ class PlannerHttpEndpoint:
 
         doc = aggregate_statemap(
             self.planner.collect_telemetry(blocks=("statestats",)))
+        return json.dumps(doc)
+
+    def profile_json(self) -> str:
+        """Cluster CPU profile (ISSUE 18): every host's stack-sampler
+        trie merged into ranked per-host × thread-class × collapsed-
+        stack rows with CPU weighting and per-process GIL pressure —
+        the evidence surface for the planner-shard / native-transport
+        ROADMAP items."""
+        from faabric_tpu.telemetry import aggregate_profile
+
+        doc = aggregate_profile(
+            self.planner.collect_telemetry(blocks=("profile",)))
         return json.dumps(doc)
 
     def timeseries_json(self) -> str:

@@ -78,6 +78,12 @@ class WorkerHttpEndpoint:
 
         return json.dumps(live_ring_doc())
 
+    @staticmethod
+    def profile_json() -> str:
+        from faabric_tpu.telemetry import get_profiler
+
+        return json.dumps(get_profiler().snapshot())
+
     def start(self) -> None:
         """Best-effort: a health probe must never take the worker down.
         A bind failure (e.g. two aliased workers on one box sharing
@@ -112,6 +118,9 @@ class WorkerHttpEndpoint:
                     elif path == "/flight":
                         self._respond(200,
                                       endpoint.flight_json().encode())
+                    elif path == "/profile":
+                        self._respond(200,
+                                      endpoint.profile_json().encode())
                     else:
                         self._reject()
                 except Exception as e:  # noqa: BLE001 — a scrape error
@@ -135,7 +144,7 @@ class WorkerHttpEndpoint:
             return
         self.port = self._server.server_address[1]  # resolve port 0
         self._thread = threading.Thread(target=self._server.serve_forever,
-                                        name="worker-http", daemon=True)
+                                        name="endpoint/worker-http", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:

@@ -257,7 +257,7 @@ class Executor:
             self._task_queues[pool_idx] = Queue()
             t = threading.Thread(
                 target=self._pool_thread_loop, args=(pool_idx,),
-                name=f"{self.id}-pool-{pool_idx}", daemon=True,
+                name=f"executor/pool@{self.id}-{pool_idx}", daemon=True,
             )
             self._pool_threads[pool_idx] = t
             t.start()

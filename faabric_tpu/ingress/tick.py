@@ -131,6 +131,12 @@ class IngressCoordinator:
                  admission: AdmissionController | None = None) -> None:
         self._planner = planner
         self.admission = admission or AdmissionController()
+        # Per-coordinator tick-thread name (ISSUE 18): the class prefix
+        # ``ingress/tick`` keeps profiler attribution stable while the
+        # ``@instance`` suffix lets a test (or doctor) scope thread
+        # queries to THIS coordinator — under full-suite load another
+        # test's still-draining coordinator must not alias ours.
+        self._tick_name = f"ingress/tick@{id(self):x}"
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._queue: list[_Pending] = []
@@ -317,7 +323,7 @@ class IngressCoordinator:
             return
         self._stop = False
         t = threading.Thread(target=self._tick_loop,
-                             name="planner-ingress-tick", daemon=True)
+                             name=self._tick_name, daemon=True)
         self._thread = t
         t.start()
 

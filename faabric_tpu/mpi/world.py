@@ -708,7 +708,7 @@ class MpiWorld:
         with self._lock:
             w = self._send_workers.get(rank)
             if w is None:
-                w = _SendWorker(f"mpi-{self.id}-send-r{rank}")
+                w = _SendWorker(f"mpi/send@{self.id}-r{rank}")
                 self._send_workers[rank] = w
             return w
 

@@ -84,6 +84,8 @@ def clear_mock_planner_calls() -> None:
 
 
 class KeepAliveThread(PeriodicBackgroundThread):
+    thread_name = "runtime/keep-alive"
+
     def __init__(self, client: "PlannerClient", slots: int, n_devices: int) -> None:
         super().__init__()
         self.client = client

@@ -396,7 +396,7 @@ class PlannerJournal:
     def _start_drain_thread_locked(self) -> None:
         self._drain_stop = False
         t = threading.Thread(target=self._drain_loop,
-                             name="planner-journal-drain", daemon=True)
+                             name="planner/journal-drain", daemon=True)
         self._drain_thread = t
         t.start()
 

@@ -395,7 +395,7 @@ class Planner:
                 threading.Thread(
                     target=self._recover_messages,
                     args=(app_id, msgs, b"Host expired"),
-                    name=f"recover-{app_id}", daemon=True).start()
+                    name=f"planner/recover@{app_id}", daemon=True).start()
 
     def get_available_hosts(self) -> list[HostState]:
         self.expire_hosts()
@@ -792,7 +792,7 @@ class Planner:
                 # unbounded dispatcher-thread pileup.
                 workers = [threading.Thread(
                     target=dispatch_one, args=(ip, subs),
-                    name=f"dispatch-{ip}", daemon=True)
+                    name=f"planner/dispatch@{ip}", daemon=True)
                     for ip, subs in groups.items()]
                 for w in workers:
                     w.start()
@@ -1294,7 +1294,7 @@ class Planner:
         threading.Thread(
             target=self._recover_messages,
             args=(sub.app_id, list(sub.messages), reason),
-            name=f"recover-{sub.app_id}", daemon=True).start()
+            name=f"planner/recover@{sub.app_id}", daemon=True).start()
 
     def _decision_from_cache_locked(self, req: BatchExecuteRequest,
                              host_map) -> Optional[SchedulingDecision]:
@@ -2029,7 +2029,7 @@ class Planner:
                 target=self._recover_messages,
                 args=(app_id, msgs,
                       b"Host never re-registered after planner restart"),
-                name=f"recover-{app_id}", daemon=True).start()
+                name=f"planner/recover@{app_id}", daemon=True).start()
 
     def _reclaim_host_rows_locked(self, ip: str) -> None:
         """Re-apply slot/port/device claims for in-flight rows pinned to
@@ -2243,6 +2243,7 @@ class Planner:
             get_proc_stats,
             get_timeseries,
             perf_telemetry_block,
+            profile_telemetry_block,
             statestats_telemetry_block,
             trace_events,
         )
@@ -2263,6 +2264,9 @@ class Planner:
             # ISSUE 16: per-key state access ledger + snapshot lifecycle
             # stats — GET /statemap merges these across hosts
             "statestats": statestats_telemetry_block,
+            # ISSUE 18: in-process sampling profiler trie + GIL gauge —
+            # GET /profile merges these across hosts
+            "profile": profile_telemetry_block,
         }
         out: dict = {"planner": {name: build() for name, build in
                                  builders.items()
@@ -2293,7 +2297,7 @@ class Planner:
         threads = []
         for i, ip in enumerate(ips):
             t = threading.Thread(target=scrape, args=(i, ip),
-                                 name=f"telemetry-scrape-{ip}",
+                                 name=f"telemetry/scrape@{ip}",
                                  daemon=True)
             if self._telemetry_scrapes.setdefault(ip, t) is not t:
                 logger.warning(

@@ -32,6 +32,8 @@ class _ExpiryReaper(PeriodicBackgroundThread):
     listings — so a dead worker's in-flight messages would only be
     requeued when some client happened to poke the planner."""
 
+    thread_name = "planner/reaper"
+
     def __init__(self, planner) -> None:
         super().__init__()
         self.planner = planner
@@ -140,6 +142,12 @@ class PlannerServer(MessageEndpointServer):
         # stop) would otherwise drop the refcount under a co-resident
         # runtime and silently halt its sampling
         self._sampling = True
+        # Continuous CPU profiler (ISSUE 18): always-on stack sampler
+        # feeding GET /profile. Same refcount discipline as above.
+        from faabric_tpu.telemetry import start_profiler
+
+        start_profiler()
+        self._profiling = True
 
     def stop(self) -> None:
         from faabric_tpu.telemetry import get_timeseries, stop_sampler
@@ -147,6 +155,11 @@ class PlannerServer(MessageEndpointServer):
         if getattr(self, "_sampling", False):
             self._sampling = False
             stop_sampler()
+        if getattr(self, "_profiling", False):
+            self._profiling = False
+            from faabric_tpu.telemetry import stop_profiler
+
+            stop_profiler()
         # Unregister what start() registered: leftover closures would
         # pin this planner alive and keep a surviving in-process
         # sampler polling a stopped server's locks. fn-matched, so a
@@ -212,7 +225,7 @@ class PlannerServer(MessageEndpointServer):
                     logger.debug("Abort relay of group %d to %s failed",
                                  group_id, host, exc_info=True)
 
-        threading.Thread(target=relay, name=f"abort-relay-{group_id}",
+        threading.Thread(target=relay, name=f"planner/abort-relay@{group_id}",
                          daemon=True).start()
 
     # ------------------------------------------------------------------

@@ -78,7 +78,7 @@ class MiniRedisServer:
         self.port = s.getsockname()[1]
         self._listener = s
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="miniredis-accept", daemon=True)
+            target=self._accept_loop, name="redis/accept", daemon=True)
         self._accept_thread.start()
 
     def stop(self) -> None:
@@ -115,7 +115,7 @@ class MiniRedisServer:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 name="miniredis-conn", daemon=True)
+                                 name="redis/conn", daemon=True)
             with self._conns_lock:
                 self._conns[conn] = t
             t.start()

@@ -62,7 +62,7 @@ import sys
 from faabric_tpu.telemetry.perfprofile import _median
 
 SOURCES = ("perf", "metrics", "commmatrix", "healthz", "topology",
-           "timeseries", "statemap")
+           "timeseries", "statemap", "profile")
 
 # File-name candidates per source for --dir mode (first hit wins)
 _FILE_CANDIDATES = {
@@ -73,6 +73,7 @@ _FILE_CANDIDATES = {
     "topology": ("topology.json",),
     "timeseries": ("timeseries.json",),
     "statemap": ("statemap.json",),
+    "profile": ("profile.json",),
 }
 
 # A link must carry this many samples before the doctor will call it
@@ -88,6 +89,18 @@ MASTER_HOTSPOT_SHARE = 0.7    # one master serving this share of bytes
 PULL_AMP_RATIO = 3.0          # total chunk pulls / first-time pulls
 MIN_PULL_CHUNKS = 32          # pulls below this are not a pattern
 MIN_LOCK_STALLS = 2           # one slow acquire is not a convoy
+
+# CPU-profile analyzers (ISSUE 18)
+CPU_HOTSPOT_SHARE = 0.35      # one stack's share of its host's CPU
+MIN_HOTSPOT_CPU_MS = 500.0    # noise floor: below this, no hotspot call
+GIL_PRESSURE_HIGH = 0.25      # sampler-drift pressure gauge threshold
+# Avg runnable threads to call saturation. 0.5, not 1.0: the census
+# counts threads that burned >= half a sample window, and one core can
+# only sustain ~1 such thread — so on a 1-core host the LIFETIME
+# average tops out near the busy fraction and never reaches 1.0.
+MIN_GIL_RUNNABLE = 0.5
+MIN_PROFILE_SAMPLES = 50      # samples before the profile is evidence
+SAMPLER_STARVED_RATIO = 0.6   # samples/expected below this → starved
 
 
 # ---------------------------------------------------------------------------
@@ -651,6 +664,110 @@ def check_lock_convoy(statemap: dict | None) -> list[dict]:
     return findings
 
 
+def check_cpu_hotspot(profile: dict | None) -> list[dict]:
+    """One collapsed stack burning an outsized share of its host's
+    sampled CPU (ISSUE 18): the direct evidence the planner-shard /
+    native-transport ROADMAP items need — WHICH frames to move, not
+    just that the process is busy."""
+    findings = []
+    if not profile:
+        return findings
+    per_host: dict[str, list[dict]] = {}
+    for row in profile.get("stacks") or []:
+        per_host.setdefault(row.get("host", "?"), []).append(row)
+    for host, rows in sorted(per_host.items()):
+        host_cpu = sum(r.get("cpu_ms") or 0.0 for r in rows)
+        if host_cpu < MIN_HOTSPOT_CPU_MS:
+            continue
+        top = max(rows, key=lambda r: r.get("cpu_ms") or 0.0)
+        share = (top.get("cpu_ms") or 0.0) / host_cpu
+        if share < CPU_HOTSPOT_SHARE:
+            continue
+        frames = top.get("frames") or ["?"]
+        findings.append({
+            "kind": "cpu_hotspot",
+            "severity": min(90.0, 50.0 + 40.0 * share),
+            "subject": f"{host} thread {top.get('class', '?')}",
+            "detail": (f"one stack burns {share:.0%} of the host's "
+                       f"{host_cpu:.0f} ms sampled CPU — hot frame "
+                       f"{frames[-1]}; move or shard it before adding "
+                       "threads (they'd contend, not help)"),
+        })
+    return findings
+
+
+def check_gil_saturation(profile: dict | None,
+                         metrics: dict | None = None) -> list[dict]:
+    """A process whose sampler wakeups drift late while multiple
+    threads stay runnable (ISSUE 18): the threads are serialized on the
+    interpreter, so adding more buys queueing, not throughput. Cross-
+    checked against the lockcheck hold-time histogram when present — a
+    lock convoy shows the same drift but names a lock site instead."""
+    findings = []
+    if not profile:
+        return findings
+    # Mean lockcheck hold time, if the metrics source carries it: long
+    # holds mean the stall is A lock, not THE lock (the GIL)
+    hold_note = ""
+    if metrics:
+        hold_sum = sum(v for _, v in
+                       metrics.get("faabric_lock_hold_seconds_sum", []))
+        hold_cnt = sum(v for _, v in
+                       metrics.get("faabric_lock_hold_seconds_count",
+                                   []))
+        if hold_cnt > 0 and hold_sum / hold_cnt > 0.001:
+            hold_note = (f" (lockcheck mean hold "
+                         f"{1000.0 * hold_sum / hold_cnt:.1f} ms — "
+                         "suspect a lock convoy before the GIL)")
+    hosts_meta = profile.get("hosts") or {}
+    for host, gil in sorted((profile.get("gil") or {}).items()):
+        pressure = gil.get("pressure") or 0.0
+        runnable = gil.get("runnable_avg") or 0.0
+        samples = (hosts_meta.get(host) or {}).get("samples") or 0
+        if samples < MIN_PROFILE_SAMPLES:
+            continue
+        if pressure < GIL_PRESSURE_HIGH or runnable < MIN_GIL_RUNNABLE:
+            continue
+        findings.append({
+            "kind": "gil_saturation",
+            "severity": min(88.0, 40.0 + 50.0 * pressure),
+            "subject": f"{host} (pid {(hosts_meta.get(host) or {}).get('pid')})",
+            "detail": (f"sampler wakeups drift {pressure:.0%} of the "
+                       f"interval late with {runnable:.1f} threads "
+                       "runnable on average — the process is "
+                       "interpreter-bound; shard the work across "
+                       f"processes, not threads{hold_note}"),
+        })
+    return findings
+
+
+def check_sampler_starved(profile: dict | None) -> list[dict]:
+    """The profiler itself missing most of its wakeups (ISSUE 18):
+    every other profile finding from that host is undercounted, so say
+    so — low severity, but it gates trust in the rest."""
+    findings = []
+    if not profile:
+        return findings
+    for host, meta in sorted((profile.get("hosts") or {}).items()):
+        expected = meta.get("expected_samples") or 0
+        samples = meta.get("samples") or 0
+        if expected < MIN_PROFILE_SAMPLES:
+            continue
+        ratio = samples / expected
+        if ratio >= SAMPLER_STARVED_RATIO:
+            continue
+        findings.append({
+            "kind": "sampler_starved",
+            "severity": 25.0,
+            "subject": f"{host} profiler",
+            "detail": (f"only {samples} of {expected} scheduled "
+                       f"samples ran ({ratio:.0%}) — the box is "
+                       "saturated enough to starve a 25 ms timer; "
+                       "this host's profile UNDERCOUNTS its hotspots"),
+        })
+    return findings
+
+
 def diagnose(sources: dict) -> list[dict]:
     """Every check over whatever sources are present, ranked most-severe
     first."""
@@ -669,6 +786,10 @@ def diagnose(sources: dict) -> list[dict]:
     findings += check_master_hotspot(sources.get("statemap"))
     findings += check_pull_amplification(sources.get("statemap"))
     findings += check_lock_convoy(sources.get("statemap"))
+    findings += check_cpu_hotspot(sources.get("profile"))
+    findings += check_gil_saturation(sources.get("profile"),
+                                     sources.get("metrics"))
+    findings += check_sampler_starved(sources.get("profile"))
     findings.sort(key=lambda f: -f["severity"])
     return findings
 
@@ -849,9 +970,72 @@ def selftest_sources() -> dict:
                  ops_total=20, bytes_total=2 << 20, local_reads=20)),
     }
     statemap = aggregate_statemap(state_tel)
+
+    # ISSUE 18 plants, built through the real aggregate_profile so the
+    # selftest also exercises the profile merge: hA burns ~70% of its
+    # CPU in one planner/tick stack (cpu_hotspot) while its sampler
+    # drifts 60% late with 3 runnable threads (gil_saturation); hB is
+    # idle and must yield ZERO profile findings; hC's sampler ran only
+    # 300 of 1000 scheduled wakeups (sampler_starved).
+    from faabric_tpu.telemetry import aggregate_profile
+
+    def pstack(cls, frames, samples, cpu_ms):
+        return {"class": cls, "frames": frames, "samples": samples,
+                "cpu_ms": cpu_ms}
+
+    def psnap(samples, expected, stacks, pressure, runnable_avg,
+              pid=100):
+        return {
+            "enabled": True, "pid": pid, "interval_ms": 25.0,
+            "samples": samples, "expected_samples": expected,
+            "wall_s": expected * 0.025, "sample_cost_ms": 0.1,
+            "overhead_pct": 0.4, "nodes": 64, "max_nodes": 4096,
+            "dropped_frames": 0,
+            "classes": {s["class"]: {"samples": s["samples"],
+                                     "cpu_ms": s["cpu_ms"],
+                                     "threads_now": 1}
+                        for s in stacks},
+            "stacks": stacks,
+            "gil": {"pressure": pressure,
+                    "drift_ratio_avg": pressure,
+                    "drift_ratio_max": pressure * 2,
+                    "runnable_now": int(runnable_avg),
+                    "runnable_avg": runnable_avg,
+                    "late_samples": int(samples * pressure)},
+        }
+
+    hot_frames = ["_tick_loop (ingress/tick.py:330)",
+                  "call_batch_group (planner/planner.py:700)",
+                  "_pack_decision (planner/planner.py:812)"]
+    profile_tel = {
+        "hA": {"profile": psnap(
+            1200, 1250,
+            [pstack("planner/tick", hot_frames, 900, 2100.0),
+             pstack("transport/worker@planner-server-sync",
+                    ["_worker_loop (transport/server.py:160)"],
+                    200, 600.0),
+             pstack("main", ["serve (runner/runtime.py:40)"],
+                    100, 80.0)],
+            pressure=0.6, runnable_avg=3.2, pid=101)},
+        # Idle host: tiny CPU, calm sampler — must stay finding-free
+        "hB": {"profile": psnap(
+            1200, 1220,
+            [pstack("main", ["wait (threading.py:320)"], 1150, 40.0),
+             pstack("telemetry/sampler",
+                    ["do_work (telemetry/timeseries.py:200)"],
+                    50, 12.0)],
+            pressure=0.02, runnable_avg=0.1, pid=102)},
+        "hC": {"profile": psnap(
+            300, 1000,
+            [pstack("executor/pool@e1-0",
+                    ["run (executor/executor.py:250)"], 280, 260.0)],
+            pressure=0.1, runnable_avg=0.5, pid=103)},
+    }
+    profile = aggregate_profile(profile_tel)
     return {"perf": perf, "metrics": metrics, "commmatrix": None,
             "healthz": healthz, "topology": topology,
-            "timeseries": timeseries, "statemap": statemap}
+            "timeseries": timeseries, "statemap": statemap,
+            "profile": profile}
 
 
 def run_selftest() -> int:
@@ -900,6 +1084,26 @@ def run_selftest() -> int:
     convoy = [f for f in findings if f["kind"] == "lock_convoy"]
     if not convoy or "demo/locky" not in convoy[0]["subject"]:
         problems.append("planted lock convoy demo/locky not found")
+    # ISSUE 18 analyzers: the hA tick hotspot, hA's GIL saturation and
+    # hC's starved sampler must be found; idle hB must stay clean
+    hotspots = [f for f in findings if f["kind"] == "cpu_hotspot"]
+    if not hotspots or "hA" not in hotspots[0]["subject"]:
+        problems.append("planted cpu hotspot on hA not found")
+    elif "planner/tick" not in hotspots[0]["subject"]:
+        problems.append("hotspot not attributed to planner/tick: "
+                        + hotspots[0]["subject"])
+    gil = [f for f in findings if f["kind"] == "gil_saturation"]
+    if not gil or "hA" not in gil[0]["subject"]:
+        problems.append("planted GIL saturation on hA not found")
+    starved = [f for f in findings if f["kind"] == "sampler_starved"]
+    if not starved or "hC" not in starved[0]["subject"]:
+        problems.append("planted starved sampler on hC not found")
+    profile_kinds = ("cpu_hotspot", "gil_saturation", "sampler_starved")
+    hb_noise = [f for f in findings
+                if f["kind"] in profile_kinds and "hB" in f["subject"]]
+    if hb_noise:
+        problems.append(f"idle host hB produced profile findings: "
+                        f"{[f['kind'] for f in hb_noise]}")
     if problems:
         print("doctor selftest FAILED:", "; ".join(problems))
         return 1

@@ -132,11 +132,19 @@ class WorkerRuntime:
         # Time-series ring (ISSUE 14): every worker samples its own
         # process gauges + executor load; the planner merges the rings
         # behind GET /timeseries. Shared, refcounted sampler thread.
-        from faabric_tpu.telemetry import get_timeseries, start_sampler
+        from faabric_tpu.telemetry import (
+            get_timeseries,
+            start_profiler,
+            start_sampler,
+        )
 
         self._executors_gauge = self.scheduler.get_executor_count
         get_timeseries().register("executors", self._executors_gauge)
         start_sampler()
+        # Continuous CPU profiler (ISSUE 18): refcounted like the
+        # sampler, so a co-resident planner shares the one thread
+        start_profiler()
+        self._profiling = True
         if register:
             self.planner_client.register_host(
                 self.slots, self.n_devices, overwrite=True,
@@ -187,9 +195,16 @@ class WorkerRuntime:
         if not self._started:
             return
         self._started = False
-        from faabric_tpu.telemetry import get_timeseries, stop_sampler
+        from faabric_tpu.telemetry import (
+            get_timeseries,
+            stop_profiler,
+            stop_sampler,
+        )
 
         stop_sampler()
+        if getattr(self, "_profiling", False):
+            self._profiling = False
+            stop_profiler()
         # Drop OUR gauge registration (fn-matched): it would pin this
         # runtime's scheduler for the rest of the process; a co-resident
         # runtime that re-registered the name keeps its series

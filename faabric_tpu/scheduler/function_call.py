@@ -229,6 +229,7 @@ class FunctionCallServer(MessageEndpointServer):
                 get_proc_stats,
                 get_timeseries,
                 perf_telemetry_block,
+                profile_telemetry_block,
                 statestats_telemetry_block,
                 trace_events,
             )
@@ -256,6 +257,9 @@ class FunctionCallServer(MessageEndpointServer):
                 # ISSUE 16: this host's per-key state access ledger +
                 # snapshot lifecycle stats (planner GET /statemap)
                 "statestats": statestats_telemetry_block,
+                # ISSUE 18: this host's stack-sampler trie + GIL gauge
+                # (planner GET /profile)
+                "profile": profile_telemetry_block,
             }
             wanted = msg.header.get("blocks")
             body: dict = {name: build() for name, build in

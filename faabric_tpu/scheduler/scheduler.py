@@ -41,6 +41,8 @@ class ReaperThread(PeriodicBackgroundThread):
     """Reaps executors idle beyond bound_timeout
     (reference SchedulerReaperThread, Scheduler.cpp:160-237)."""
 
+    thread_name = "scheduler/reaper"
+
     def __init__(self, scheduler: "Scheduler") -> None:
         super().__init__()
         self.scheduler = scheduler

@@ -193,6 +193,8 @@ class TimeSeriesRing:
 
 
 class TimeSeriesSampler(PeriodicBackgroundThread):
+    thread_name = "telemetry/sampler"
+
     def __init__(self, ring: TimeSeriesRing) -> None:
         super().__init__()
         self.ring = ring

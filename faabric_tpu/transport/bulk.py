@@ -303,7 +303,7 @@ class BulkServer:
         s.listen(64)
         self._listener = s
         t = threading.Thread(target=self._accept_loop,
-                             name=f"bulk-accept-{self.port}", daemon=True)
+                             name=f"bulk/accept@{self.port}", daemon=True)
         with self._lock:
             self._threads.append(t)
         t.start()
@@ -321,7 +321,7 @@ class BulkServer:
                     return  # listener closed
                 continue  # one bad connection must not kill the acceptor
             t = threading.Thread(target=self._conn_loop, args=(conn,),
-                                 name="bulk-conn", daemon=True)
+                                 name="bulk/conn", daemon=True)
             with self._lock:
                 self._conns.append(conn)
                 # Prune finished conn threads + closed sockets so the
@@ -481,7 +481,7 @@ class BulkServer:
             return None
         t = threading.Thread(target=self._ring_drain_loop,
                              args=(ring, stop),
-                             name=f"bulk-shm-{name[-12:]}", daemon=True)
+                             name=f"bulk/shm-drain@{name[-12:]}", daemon=True)
         t.start()
         return t
 
@@ -864,7 +864,7 @@ class _Stripe:
             return
         sock = self.sock
         t = threading.Thread(target=self._nack_reader, args=(sock,),
-                             name=f"bulk-nack-{self.tag}", daemon=True)
+                             name=f"bulk/nack-reader@{self.tag}", daemon=True)
         self.nack_thread = t
         t.start()
 

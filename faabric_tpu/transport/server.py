@@ -147,13 +147,13 @@ class MessageEndpointServer:
         for listener, plane in ((self._async_listener, "async"), (self._sync_listener, "sync")):
             t = threading.Thread(
                 target=self._accept_loop, args=(listener, plane),
-                name=f"{self.label}-{plane}-accept", daemon=True,
+                name=f"transport/accept@{self.label}-{plane}", daemon=True,
             )
             t.start()
             self._threads.append(t)
         for i in range(self.n_threads):
             t = threading.Thread(
-                target=self._worker_loop, name=f"{self.label}-worker-{i}", daemon=True
+                target=self._worker_loop, name=f"transport/worker@{self.label}-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
@@ -268,7 +268,7 @@ class MessageEndpointServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(
                 target=self._conn_loop, args=(conn, plane),
-                name=f"{self.label}-{plane}-conn", daemon=True,
+                name=f"transport/conn@{self.label}-{plane}", daemon=True,
             )
             with self._conn_lock:
                 self._conns.add(conn)

@@ -17,6 +17,11 @@ logger = get_logger(__name__)
 
 
 class PeriodicBackgroundThread:
+    # Subclasses set this to a ``subsystem/role`` name (ISSUE 18 thread
+    # naming convention) so profiler / lockcheck attribution is
+    # readable; the class-name fallback keeps foreign subclasses legal.
+    thread_name: str | None = None
+
     def __init__(self) -> None:
         self._thread: threading.Thread | None = None
         self._stop_event = threading.Event()
@@ -41,7 +46,9 @@ class PeriodicBackgroundThread:
         self.interval = interval_seconds
         self._stop_event.clear()
         self._thread = threading.Thread(
-            target=self._loop, name=f"{type(self).__name__}-periodic", daemon=True
+            target=self._loop,
+            name=self.thread_name or f"{type(self).__name__}-periodic",
+            daemon=True,
         )
         self._thread.start()
 

@@ -1096,6 +1096,55 @@ class DistExecutor(Executor):
         return int(ReturnValue.SUCCESS)
 
 
+    def fn_profile_spin(self, msg, req):
+        """ISSUE 18 profiling acceptance: burn this executor-pool
+        thread inside a distinctively named frame for input_data
+        seconds, with two light lock-convoy helper threads contending a
+        shared lock alongside it — the planted cpu_hotspot +
+        gil_saturation scenario the merged /profile and the doctor must
+        attribute to THIS host and thread class while it runs."""
+        import threading
+
+        dur = float(msg.input_data.decode() or "4")
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def convoy():
+            # Short bursts under the lock, mostly parked: enough GIL
+            # handoff churn to keep the drift estimator honest without
+            # out-burning the planted frame below
+            x = 0
+            while not stop.is_set():
+                with lock:
+                    for _ in range(2_000):
+                        x = (x * 48271) % 2147483647
+                stop.wait(0.002)
+
+        helpers = [threading.Thread(target=convoy,
+                                    name=f"test/convoy@{i}", daemon=True)
+                   for i in range(2)]
+        for t in helpers:
+            t.start()
+        try:
+            _planted_profile_burn(dur)
+        finally:
+            stop.set()
+            for t in helpers:
+                t.join(timeout=5)
+        msg.output_data = b"spun"
+        return int(ReturnValue.SUCCESS)
+
+
+def _planted_profile_burn(dur: float) -> None:
+    """Distinctive frame the ISSUE 18 dist test hunts for in the merged
+    /profile ranking — keep the name unique across the tree."""
+    end = time.monotonic() + dur
+    x = 0
+    while time.monotonic() < end:
+        for _ in range(5_000):
+            x = (x * 1103515245 + 12345) & 0x7FFFFFFF
+
+
 class DistFactory(ExecutorFactory):
     def create_executor(self, msg):
         return DistExecutor(msg)

@@ -30,8 +30,17 @@ def drain_stdout(p):
                 pass
         except Exception:  # noqa: BLE001 — the pipe died with the child
             pass
+        finally:
+            # Close at EOF: an unclosed pipe fd lives until the Popen
+            # is GC'd and shows up in the leak gate attributed to
+            # whichever test happened to run in between
+            try:
+                p.stdout.close()
+            except Exception:  # noqa: BLE001
+                pass
 
-    threading.Thread(target=_loop, daemon=True).start()
+    threading.Thread(target=_loop, name="test/drain-stdout",
+                     daemon=True).start()
 
 
 @pytest.fixture(scope="module")
@@ -619,55 +628,78 @@ def test_device_plane_cross_process_collectives(dist_cluster):
     plane_aliases = ALIASES + ",w3=127.0.0.1+19000,w4=127.0.0.1+22000"
     env = dict(os.environ, FAABRIC_HOST_ALIASES=plane_aliases,
                JAX_PLATFORMS="cpu")
-    procs = [subprocess.Popen(
-        [sys.executable, PROCS, "planeworker", h, "2"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for h in ("w3", "w4")]
-    try:
-        lines: dict[int, str] = {}
 
-        def read_first(i):
-            # Skip log lines; the report line starts with PLANE-
-            while True:
-                line = procs[i].stdout.readline()
-                if not line or line.startswith("PLANE-"):
-                    lines[i] = line.strip()
-                    return
+    def attempt() -> tuple[dict[int, str], bool]:
+        """One plane-formation round. Returns (report lines, transient):
+        ``transient`` marks the known 1-core load flake — a worker dying
+        mid gloo rendezvous (conn reset / empty report) — which warrants
+        one retry; a PLANE-ERR report is a real failure and does not."""
+        procs = [subprocess.Popen(
+            [sys.executable, PROCS, "planeworker", h, "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for h in ("w3", "w4")]
+        try:
+            lines: dict[int, str] = {}
 
-        threads = [threading.Thread(target=read_first, args=(i,))
-                   for i in range(2)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=90)
-        assert all(not t.is_alive() for t in threads), (
-            f"plane worker never reported: {lines}")
-        for p in procs:
-            drain_stdout(p)
-        for i in range(2):
-            assert lines[i].startswith("PLANE-OK"), lines
-        # One process must own ranks 0-3, the other 4-7, all seeing the
-        # full 8-device plane
-        assert {l.split("gdev=")[1].split()[0]
-                for l in lines.values()} == {"8"}
-        ranks = {l.split("ranks=")[1].split(" pp_loss=")[0]
+            def read_first(i):
+                # Skip log lines; the report line starts with PLANE-
+                while True:
+                    line = procs[i].stdout.readline()
+                    if not line or line.startswith("PLANE-"):
+                        lines[i] = line.strip()
+                        return
+
+            threads = [threading.Thread(target=read_first, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            assert all(not t.is_alive() for t in threads), (
+                f"plane worker never reported: {lines}")
+            for p in procs:
+                drain_stdout(p)
+            transient = any(not lines.get(i) for i in range(2))
+            return lines, transient
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            # Close the pipe fds explicitly: a worker that died before
+            # reporting leaves its pipe open in THIS process, and the
+            # leak gate attributes the fd to whichever test ran here
+            for p in procs:
+                if p.stdout is not None:
+                    p.stdout.close()
+
+    lines, transient = attempt()
+    if transient:
+        # Known 1-core full-suite load flake (recorded at PR 16): the
+        # gloo rendezvous inside jax.distributed can lose its TCP
+        # connection when the box is saturated and the process dies
+        # before reporting. One retry on a quieter scheduler; a second
+        # empty report is a real failure.
+        lines, transient = attempt()
+    for i in range(2):
+        assert lines[i].startswith("PLANE-OK"), lines
+    # One process must own ranks 0-3, the other 4-7, all seeing the
+    # full 8-device plane
+    assert {l.split("gdev=")[1].split()[0]
+            for l in lines.values()} == {"8"}
+    ranks = {l.split("ranks=")[1].split(" pp_loss=")[0]
+             for l in lines.values()}
+    assert ranks == {"[0, 1, 2, 3]", "[4, 5, 6, 7]"}, ranks
+    # Both controllers ran the SAME global train steps: identical
+    # losses from the dp*tp step AND the cross-process-pp 1F1B step
+    losses = {l.split(" loss=")[1] for l in lines.values()}
+    assert len(losses) == 1, lines
+    pp_losses = {l.split("pp_loss=")[1].split()[0]
                  for l in lines.values()}
-        assert ranks == {"[0, 1, 2, 3]", "[4, 5, 6, 7]"}, ranks
-        # Both controllers ran the SAME global train steps: identical
-        # losses from the dp*tp step AND the cross-process-pp 1F1B step
-        losses = {l.split(" loss=")[1] for l in lines.values()}
-        assert len(losses) == 1, lines
-        pp_losses = {l.split("pp_loss=")[1].split()[0]
-                     for l in lines.values()}
-        assert len(pp_losses) == 1, lines
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+    assert len(pp_losses) == 1, lines
 
 
 def test_dist_worker_crash_fail_dispatch_and_expiry():

@@ -155,34 +155,67 @@ def test_gate_lifecycle_plane_keys_reported_only_first_round(tmp_path,
     assert "lifecycle_stamp_ns" in out and "reported-only" in out
 
 
-def test_gate_state_plane_keys_reported_only_first_round(tmp_path,
-                                                         capsys):
-    """ISSUE 16 first-round keys: the state-plane figures (hot read,
-    replica pull/push throughput, ledger record cost enabled vs no-op)
-    are tracked but not gated until a round of spread exists — and the
-    direction regexes classify them correctly (_ns lower-better, _gibs
-    higher-better)."""
-    for key in ("state_hot_read_ns", "statestats_record_ns",
-                "statestats_record_noop_ns"):
-        assert key in bench_gate.REPORTED_ONLY
-        assert bench_gate.direction(key) == -1
-    for key in ("state_pull_gibs", "state_push_partial_gibs"):
-        assert key in bench_gate.REPORTED_ONLY
-        assert bench_gate.direction(key) == 1
+def test_gate_state_plane_keys_promoted_to_gated(tmp_path, capsys):
+    """ISSUE 18 satellite: the ISSUE 16 state-plane keys graduated
+    from REPORTED_ONLY after their first recorded round (the standard
+    one-round ratchet) — a >20% move in the bad direction now FAILS
+    the gate. statestats_record_ns alone stays reported-only (the
+    enabled-path feed cost is scheduler-jitter-shaped)."""
+    for key in ("state_hot_read_ns", "state_pull_gibs",
+                "state_push_partial_gibs", "statestats_record_noop_ns"):
+        assert key not in bench_gate.REPORTED_ONLY
+    assert "statestats_record_ns" in bench_gate.REPORTED_ONLY
+    # directions: _ns lower-better, _gibs higher-better
+    assert bench_gate.direction("state_hot_read_ns") == -1
+    assert bench_gate.direction("statestats_record_noop_ns") == -1
+    assert bench_gate.direction("state_pull_gibs") == 1
+    assert bench_gate.direction("state_push_partial_gibs") == 1
     _write_round(tmp_path, "BENCH_r01.json", 0.05,
                  {"state_hot_read_ns": 2500.0, "state_pull_gibs": 0.06,
                   "state_push_partial_gibs": 0.05,
                   "statestats_record_ns": 1800.0,
                   "statestats_record_noop_ns": 90.0})
     _write_round(tmp_path, "BENCH_r02.json", 0.05,
-                 {"state_hot_read_ns": 9000.0,     # +260%: reported only
-                  "state_pull_gibs": 0.01,         # -83%: reported only
+                 {"state_hot_read_ns": 9000.0,     # +260%: gated now
+                  "state_pull_gibs": 0.01,         # -83%: gated now
                   "state_push_partial_gibs": 0.05,
-                  "statestats_record_ns": 1700.0,
+                  "statestats_record_ns": 9999.0,  # reported-only
                   "statestats_record_noop_ns": 95.0})
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "FAILED (2 regression(s))" in out
+    assert "state_hot_read_ns" in out and "state_pull_gibs" in out
+    assert "statestats_record_ns: 1800.0 -> 9999.0" in out
+    assert "reported-only" in out
+
+
+def test_gate_profiler_keys_reported_only_first_round(tmp_path, capsys):
+    """ISSUE 18 first-round keys: the stack-sampler figures (per-pass
+    cost, measured firehose overhead, idle GIL pressure) are tracked
+    but not gated until a round of spread exists — with all three
+    DIRECTIONS pinned here so the eventual promotion inherits the
+    right polarity: _ns and the new _pct suffix are lower-better, and
+    gil_pressure_idle (a unit-less [0,1] score no regex catches) is
+    classified lower-better by the name-exact LOWER_BETTER_KEYS
+    list."""
+    for key in ("profile_sample_ns", "profile_overhead_pct",
+                "gil_pressure_idle"):
+        assert key in bench_gate.REPORTED_ONLY
+        assert bench_gate.direction(key) == -1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"profile_sample_ns": 60000.0,
+                  "profile_overhead_pct": 0.5,
+                  "gil_pressure_idle": 0.02})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"profile_sample_ns": 200000.0,  # +233%: reported only
+                  "profile_overhead_pct": 1.9,
+                  "gil_pressure_idle": 0.4})
     assert bench_gate.main(["--repo", str(tmp_path)]) == 0
     out = capsys.readouterr().out
-    assert "state_hot_read_ns" in out and "reported-only" in out
+    assert "profile_sample_ns" in out and "reported-only" in out
+    # gil_pressure_idle must be LOADED (not silently dropped by the
+    # direction regexes) so its moves at least print
+    assert "gil_pressure_idle" in out
 
 
 def test_gate_device_plane_key_reported_only_first_round(tmp_path,

@@ -459,7 +459,7 @@ def test_duplicate_ring_attach_refused(bulk_pair):
     # Still exactly one drain registered, and traffic still flows on it
     assert list(server._attached_rings) == [name]
     drains = [t for t in threading.enumerate()
-              if t.name == f"bulk-shm-{name[-12:]}"]
+              if t.name == f"bulk/shm-drain@{name[-12:]}"]
     assert len(drains) == 1
     payload = bytes(np.arange(BULK_THRESHOLD * 2, dtype=np.uint8) % 251)
     a.send_message(GROUP, 0, 1, payload, must_order=True)

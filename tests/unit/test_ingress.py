@@ -77,6 +77,21 @@ def test_concurrent_submissions_batch_into_ticks():
     decisions = {}
     errs = []
 
+    # Make the overlap deterministic: mock-mode call_batch finishes
+    # inside one GIL slice, so 30 barrier-released threads can fully
+    # SERIALIZE — each finds the ingress idle, takes the immediate
+    # path, and batchedTotal reads 0 (the 1-core full-suite flake
+    # recorded at PR 16). A sleep inside call_batch releases the GIL
+    # while the immediate path is held (_inline > 0), guaranteeing the
+    # remaining submissions observe a busy ingress and enqueue.
+    real_call_batch = p.call_batch
+
+    def slow_call_batch(req, *a, **k):
+        time.sleep(0.02)
+        return real_call_batch(req, *a, **k)
+
+    p.call_batch = slow_call_batch
+
     barrier = threading.Barrier(30)
 
     def submit(i):
@@ -539,8 +554,11 @@ def test_stop_with_stalled_tick_never_resurrects_zombie_thread():
             time.sleep(0.02)
         assert p.get_scheduling_decision(req2.app_id) is not None
         assert p.ingress.stats()["tickThreadAlive"]
+        # Scoped to THIS coordinator's tick name (ingress/tick@<id>):
+        # under full-suite load another test's coordinator may still be
+        # draining its own tick thread, which must not count here.
         ticks = [t for t in threading.enumerate()
-                 if t.name == "planner-ingress-tick" and t.is_alive()]
+                 if t.name == p.ingress._tick_name and t.is_alive()]
         assert ticks == [t_new]
     finally:
         release.set()

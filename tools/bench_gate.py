@@ -29,8 +29,15 @@ import sys
 # _bytes (ISSUE 15): accounting byte counts — device_host_copy_bytes —
 # where fewer bytes moved is strictly better; direction pinned by
 # tests/unit/test_bench_gate.py.
+# _pct (ISSUE 18): overhead percentages — profile_overhead_pct — where
+# lower is strictly better; direction pinned by the unit test.
 HIGHER_BETTER = re.compile(r"(_gibs|_per_s|mfu|_speedup)")
-LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio|_err|_bytes)$")
+LOWER_BETTER = re.compile(r"(_ms|_ns|_s|_ratio|_err|_bytes|_pct)$")
+
+# Name-exact lower-is-better keys neither regex catches (ISSUE 18):
+# the idle-cluster GIL pressure gauge — a [0, 1] score, not a unit —
+# where any upward move means the idle path got noisier.
+LOWER_BETTER_KEYS = ("gil_pressure_idle",)
 
 # Headline figures (ISSUE 5 data plane; ISSUE 8 invocation plane): once
 # a round has recorded one of these, a later round missing it is a
@@ -88,13 +95,18 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # transfers are PCIe/DMA); device_host_copy_bytes is the asserted-zero
 # copy accounting for the timed resident rounds (lower-is-better —
 # _bytes direction pinned in the unit test).
-# ISSUE 16 state-plane keys (first recorded round, promote next):
-# state_hot_read_ns is the one-chunk master-image read a training loop
-# pays per step; state_pull_gibs / state_push_partial_gibs the replica
-# chunk-protocol throughput over loopback TCP (latency-bound — per-4KiB
-# round-trips, not memcpy); statestats_record_ns the enabled access-
-# ledger feed and statestats_record_noop_ns its FAABRIC_METRICS=0
-# floor (contract: one no-op method call, ≲100 ns).
+# PROMOTED (ISSUE 18 satellite): state_hot_read_ns, state_pull_gibs,
+# state_push_partial_gibs and statestats_record_noop_ns graduated
+# after their first recorded round (the ISSUE 16 deferral, same
+# one-round ratchet as the ISSUE 9/10/14 promotions) — they now gate
+# like any other key. statestats_record_ns stays reported-only: the
+# enabled-path feed cost is scheduler-jitter-shaped on this container.
+# ISSUE 18 profiler keys (first recorded round, promote next):
+# profile_sample_ns is one stack-sampler pass over the live threads
+# (trie fold + /proc scan); profile_overhead_pct the sampler's
+# measured drag on the invocation firehose (acceptance: ≤ 2); and
+# gil_pressure_idle the drift gauge on an idle cluster (contract: ~0 —
+# direction pinned via LOWER_BETTER_KEYS).
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "lifecycle_stamp_ns", "invocation_p99_ms",
                  "host_allreduce_device_gibs",
@@ -109,9 +121,9 @@ REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "delta_stream_wire_speedup",
                  "perf_feed_ns", "perf_feed_noop_ns",
                  "doctor_selftest_ms",
-                 "state_hot_read_ns", "state_pull_gibs",
-                 "state_push_partial_gibs",
-                 "statestats_record_ns", "statestats_record_noop_ns")
+                 "statestats_record_ns",
+                 "profile_sample_ns", "profile_overhead_pct",
+                 "gil_pressure_idle")
 
 # Round-5 container drift (see ROADMAP "Recent"): ptp dispatch p50 (the
 # headline "value") and delta_apply_reuse_ms read worse in ANY tree on
@@ -145,15 +157,17 @@ def load_metrics(path: str) -> dict[str, float]:
     for key, val in (parsed.get("summary") or {}).items():
         if isinstance(val, bool) or not isinstance(val, (int, float)):
             continue
-        if HIGHER_BETTER.search(key) or LOWER_BETTER.search(key):
+        if (HIGHER_BETTER.search(key) or LOWER_BETTER.search(key)
+                or key in LOWER_BETTER_KEYS):
             out[key] = float(val)
     return out
 
 
 def direction(key: str) -> int:
     """+1 = higher is better, -1 = lower is better."""
-    if key == "value" or (LOWER_BETTER.search(key)
-                          and not HIGHER_BETTER.search(key)):
+    if key == "value" or key in LOWER_BETTER_KEYS or (
+            LOWER_BETTER.search(key)
+            and not HIGHER_BETTER.search(key)):
         return -1
     return 1
 

@@ -39,6 +39,11 @@ if ! JAX_PLATFORMS=cpu python -m faabric_tpu.mpi.schedule_compile \
     rc=1
 fi
 
+echo "== profile selftest (stack sampler attribution) =="
+if ! JAX_PLATFORMS=cpu python -m faabric_tpu.runner.profile --selftest; then
+    rc=1
+fi
+
 echo "== pallas ring selftest (device ring-permute p2p) =="
 # On this container it validates the XLA fallback permute and reports
 # the Pallas kernel as untested (no TPU granted) — fast, clean; with a
