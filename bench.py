@@ -2176,6 +2176,7 @@ def bench_state(quick: bool = False) -> dict:
     server = StateServer(master_state, "benchstateA")
     server.start()
     pool = ClientPool(StateClient)
+    backup_server = None
     try:
         rkv = StateKeyValue("bench", "blob", size, False, "benchstateA",
                             client_factory=pool.get,
@@ -2201,15 +2202,84 @@ def bench_state(quick: bool = False) -> dict:
             push_s += time.perf_counter() - t0
             push_bytes += dirty * STATE_CHUNK_SIZE
         push_gibs = push_bytes / push_s / 2**30
+
+        # Replicated write path (ISSUE 19): the same dirty-chunk client
+        # push, but the master synchronously forwards every acked chunk
+        # to a backup host BEFORE responding — the honest cost of
+        # FAABRIC_STATE_REPLICAS=1 vs push_partial_gibs above (the
+        # FAABRIC_STATE_REPLICAS=0 figure)
+        register_host_alias("benchstateC", "127.0.0.1", base + 2000)
+        backup_state = State("benchstateC")
+        backup_server = StateServer(backup_state, "benchstateC")
+        backup_server.start()
+        mkv = master_state.get_kv("bench", "rblob", size)
+        mkv.set(b"\x5a" * size)
+        mkv.adopt_placement("benchstateC", 1)
+        rkv2 = StateKeyValue("bench", "rblob", size, False, "benchstateA",
+                             client_factory=pool.get,
+                             local_host="benchstateB", epoch=1)
+        rkv2.pull()
+        rep_s, rep_bytes = 0.0, 0
+        for _ in range(pushes):
+            for off in range(0, size, 2 * STATE_CHUNK_SIZE):
+                rkv2.set_chunk(off, chunk)
+            dirty = rkv2.n_dirty_chunks()
+            t0 = time.perf_counter()
+            rkv2.push_partial()
+            rep_s += time.perf_counter() - t0
+            rep_bytes += dirty * STATE_CHUNK_SIZE
+        replicated_gibs = rep_bytes / rep_s / 2**30
+
+        # Epoch-fenced failover end to end over real loopback: planner
+        # drops the master -> backup promoted (PROMOTE RPC, with
+        # self-promotion as the fallback) -> the stale master's next
+        # forward is fenced -> the client re-resolves and its write
+        # acks on the new master. Measured remove_host -> first ack.
+        from faabric_tpu.planner.planner import Planner
+
+        planner = Planner()
+        planner.register_host("benchstateA", 2, 0)
+        planner.register_host("benchstateC", 2, 0)
+        fm, fb, fe = planner.claim_state_master("bench", "fo",
+                                                "benchstateA")
+        fsize = 1 << 20
+        fkv = master_state.get_kv("bench", "fo", fsize)
+        fkv.set(b"\x11" * fsize)
+        fkv.adopt_placement(fb, fe)
+        ckv = StateKeyValue(
+            "bench", "fo", fsize, False, "benchstateA",
+            client_factory=pool.get, local_host="benchstateB",
+            epoch=fe,
+            resolver=lambda: planner.claim_state_master(
+                "bench", "fo", "benchstateB"))
+        ckv.set_chunk(0, chunk)
+        ckv.push_partial()  # acked baseline: the backup holds a replica
+        failover_s = None
+        t0 = time.perf_counter()
+        planner.remove_host("benchstateA")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                ckv.set_chunk(0, chunk)
+                ckv.push_partial()
+                failover_s = time.perf_counter() - t0
+                break
+            except Exception:  # noqa: BLE001 — fenced mid-failover
+                time.sleep(0.005)
     finally:
         pool.close_all()
         server.stop()
+        if backup_server is not None:
+            backup_server.stop()
         clear_host_aliases()
 
     return {
         "hot_read_ns": round(hot_read_ns, 1),
         "pull_gibs": round(pull_gibs, 4),
         "push_partial_gibs": round(push_gibs, 4),
+        "replicated_push_gibs": round(replicated_gibs, 4),
+        "master_failover_s": (round(failover_s, 4)
+                              if failover_s is not None else None),
         "record_ns": round(record_ns, 1),
         "record_noop_ns": round(record_noop_ns, 1),
         "value_mib": size >> 20,
@@ -3773,10 +3843,17 @@ def main() -> None:
     # ISSUE 16 state-plane keys (REPORTED_ONLY this round): master-image
     # hot read, replica pull / partial-push throughput over loopback,
     # and the access-ledger record cost enabled vs the no-op singleton
+    # ISSUE 19 adds the replicated-write rate (same dirty-chunk push
+    # with a synchronous backup forward before the ack — compare
+    # against state_push_partial_gibs for the replication overhead)
+    # and the measured loopback failover: planner remove_host → first
+    # acked write through the promoted backup
     st = extras.get("state") or {}
     for src, dst in (("hot_read_ns", "state_hot_read_ns"),
                      ("pull_gibs", "state_pull_gibs"),
                      ("push_partial_gibs", "state_push_partial_gibs"),
+                     ("replicated_push_gibs", "state_replicated_push_gibs"),
+                     ("master_failover_s", "master_failover_s"),
                      ("record_ns", "statestats_record_ns"),
                      ("record_noop_ns", "statestats_record_noop_ns")):
         if st.get(src) is not None:

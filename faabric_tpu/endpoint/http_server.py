@@ -222,12 +222,14 @@ class PlannerHttpEndpoint:
         """Cluster state map (ISSUE 16): every host's per-key access
         ledger merged into per-key master/size/origin rows with hot-key
         ranking, per-host mastership totals, and the cluster locality
-        ratio — the steering surface for ROADMAP item 2's future
-        replica/placement decisions."""
-        from faabric_tpu.telemetry import aggregate_statemap
+        ratio. ISSUE 19 overlays the planner's authoritative placement
+        journal (master/backup/epoch) — host ledgers lag right after a
+        failover, the journal never does."""
+        from faabric_tpu.telemetry import aggregate_statemap, merge_placement
 
         doc = aggregate_statemap(
             self.planner.collect_telemetry(blocks=("statestats",)))
+        merge_placement(doc, self.planner.state_placement())
         return json.dumps(doc)
 
     def profile_json(self) -> str:

@@ -54,6 +54,19 @@ Actions:
 - ``drop`` / ``suppress`` return the :data:`DROP` / :data:`SUPPRESS`
   verdict, which the call site interprets (skip the send, skip the
   keep-alive, ...).
+
+State-plane points (ISSUE 19, same grammar): ``state.pull`` and
+``state.push`` fire on a replica's remote chunk pulls/pushes,
+``state.replicate`` on the master's synchronous forward to its backup.
+All three map a ``drop`` verdict to a raised
+:class:`FaultConnectionError` — a dropped state RPC is
+indistinguishable from a dead peer, so the retry / no-ack machinery is
+what gets exercised, not a silent skip::
+
+    # fail the first backup forward, then heal
+    FAABRIC_FAULTS="state.replicate=drop@times=1"
+    # every pull from key a/k times out at the client
+    FAABRIC_FAULTS="state.pull=kill_conn@key=a/k"
 """
 
 from __future__ import annotations

@@ -807,11 +807,17 @@ class PlannerClient(MessageEndpointClient):
             return None
         return DevicePlaneSpec.from_dict(resp.header["spec"])
 
-    def claim_state_master(self, user: str, key: str) -> str:
+    def claim_state_master(self, user: str,
+                           key: str) -> tuple[str, str, int]:
+        """Resolve a key's placement, claiming mastership for this host
+        if unowned. Returns ``(master, backup, epoch)`` — backup is ""
+        and epoch 0 when replication is off (FAABRIC_STATE_REPLICAS=0)
+        or against a pre-ISSUE-19 planner."""
         resp = self.sync_send(int(PlannerCalls.CLAIM_STATE_MASTER), {
             "user": user, "key": key, "host": self.this_host,
         }, idempotent=True)
-        return resp.header["master"]
+        h = resp.header
+        return (h["master"], h.get("backup", ""), int(h.get("epoch", 0)))
 
     def drop_state_master(self, user: str, key: str) -> None:
         self.sync_send(int(PlannerCalls.DROP_STATE_MASTER),

@@ -177,6 +177,8 @@ class Planner:
         "_group_hosts": "_lock",
         "_num_migrations": "_lock",
         "_state_masters": "_lock",
+        "_state_backups": "_lock",
+        "_state_epochs": "_lock",
         "_device_plane": "_lock",
         "_journal_last_hosts": "_lock",
         "_results_count": "_lock",
@@ -246,6 +248,13 @@ class Planner:
         # getMasterIP(claim)); here the planner IS the cluster metadata
         # service, so a claim is one RPC with no external dependency.
         self._state_masters: dict[str, str] = {}
+        # Crash tolerance (ISSUE 19): per-key backup host (consistent-
+        # hash placed, always != master) and fencing epoch. Epochs are
+        # NEVER deleted on drop — only reset() clears them — so a
+        # re-claimed key always gets a strictly higher epoch and a
+        # revived stale master can never ack under its old one.
+        self._state_backups: dict[str, str] = {}
+        self._state_epochs: dict[str, int] = {}
 
         # Multi-process device plane (parallel/distributed.py): workers
         # join at boot; the planner assigns process ids in join order
@@ -346,16 +355,115 @@ class Planner:
                 self._journal_append("host_remove", ip=ip)
 
     def _drop_state_masters_for_locked(self, ips: set[str]) -> None:
-        """Drop every state-master entry owned by ``ips`` (called under
-        the planner lock on host death/removal)."""
-        dead = [k for k, v in self._state_masters.items() if v in ips]
-        for key in dead:
-            del self._state_masters[key]
-            if self._journal.enabled:
-                self._journal_append("state_drop", key=key)
-        if dead:
-            logger.warning("Dropped %d state masterships of dead host(s) "
-                           "%s", len(dead), sorted(ips))
+        """Fail over (or drop) every state-master entry owned by ``ips``
+        (called under the planner lock on host death/removal/expiry).
+
+        ISSUE 19: a dead master whose backup is still live is PROMOTED —
+        epoch bumped, transition journalled durably, a new backup
+        elected — instead of dropped; only when master AND backup are
+        both gone does the entry drop (honest data loss, see
+        docs/fault_tolerance.md). A dead backup under a live master just
+        gets a replacement elected (no epoch bump: ownership did not
+        change). Promotion RPCs are dispatched on a daemon thread — no
+        network I/O ever happens under the planner lock."""
+        promoted: list[tuple[str, str, str, int]] = []
+        dropped: list[str] = []
+        for full, master in list(self._state_masters.items()):
+            backup = self._state_backups.get(full, "")
+            if master in ips:
+                if backup and backup not in ips and backup in self._hosts:
+                    epoch = self._state_epochs.get(full, 0) + 1
+                    new_backup = self._elect_backup_locked(
+                        full, {backup} | set(ips))
+                    self._state_masters[full] = backup
+                    self._state_backups[full] = new_backup
+                    self._state_epochs[full] = epoch
+                    if self._journal.enabled:
+                        self._journal_append("state_failover", key=full,
+                                             host=backup, backup=new_backup,
+                                             epoch=epoch)
+                    flight_record("state_failover", key=full,
+                                  old_master=master, new_master=backup,
+                                  backup=new_backup, epoch=epoch)
+                    promoted.append((full, backup, new_backup, epoch))
+                else:
+                    del self._state_masters[full]
+                    self._state_backups.pop(full, None)
+                    if self._journal.enabled:
+                        self._journal_append("state_drop", key=full)
+                    dropped.append(full)
+            elif backup and backup in ips:
+                new_backup = self._elect_backup_locked(
+                    full, {master} | set(ips))
+                self._state_backups[full] = new_backup
+                if self._journal.enabled:
+                    self._journal_append("state_backup", key=full,
+                                         backup=new_backup)
+        if dropped:
+            logger.warning("Dropped %d state mastership(s) of dead host(s) "
+                           "%s (no live backup)", len(dropped), sorted(ips))
+        if promoted:
+            logger.warning(
+                "Failing over %d state mastership(s) from dead host(s) %s",
+                len(promoted), sorted(ips))
+            self._dispatch_state_promotions(promoted)
+
+    def _elect_backup_locked(self, full: str, exclude: set[str]) -> str:
+        """Consistent-hash backup election among live registered hosts
+        (empty string when replication is off or no eligible host)."""
+        if get_system_config().state_replicas <= 0:
+            return ""
+        live = [h for h in self._hosts if h not in exclude]
+        if not live:
+            return ""
+        from faabric_tpu.state.placement import place_backup
+
+        return place_backup(full, live)
+
+    def _dispatch_state_promotions(
+            self, promoted: list[tuple[str, str, str, int]]) -> None:
+        threading.Thread(
+            target=self._notify_state_promotions, args=(list(promoted),),
+            name="planner/state-promote", daemon=True).start()
+
+    def _notify_state_promotions(
+            self, promoted: list[tuple[str, str, str, int]]) -> None:
+        """Tell each promoted backup to convert its replica into the
+        master copy. Best-effort: a lost notification is covered by
+        self-promotion — the first fenced client op carrying the new
+        epoch triggers the same conversion on the backup host."""
+        from faabric_tpu.state.remote import StateClient
+
+        for full, master, backup, epoch in promoted:
+            user, _, key = full.partition("/")
+            try:
+                client = StateClient(master)
+                try:
+                    ok = client.promote(user, key, epoch, backup)
+                finally:
+                    client.close()
+            except Exception as e:  # noqa: BLE001 — best-effort notify
+                logger.warning(
+                    "State promotion notify %s -> %s failed: %s (the new "
+                    "master self-promotes on its first fenced op)",
+                    full, master, e)
+                continue
+            if not ok:
+                logger.warning(
+                    "Host %s holds no replica of %s; dropping the "
+                    "mastership so the next claim re-elects", master, full)
+                self._drop_failed_promotion(full, epoch)
+
+    def _drop_failed_promotion(self, full: str, epoch: int) -> None:
+        """A promoted host reported no replica: drop the entry (keeping
+        the epoch) unless a newer transition already superseded it."""
+        with self._lock:
+            if self._state_epochs.get(full, 0) != epoch:
+                return
+            if self._state_masters.pop(full, None) is not None:
+                self._state_backups.pop(full, None)
+                if self._journal.enabled:
+                    self._journal_append("state_drop", key=full)
 
     def expire_hosts(self) -> None:
         conf = get_system_config()
@@ -1674,38 +1782,98 @@ class Planner:
     # State master registry
     # ------------------------------------------------------------------
     def claim_state_master(self, user: str, key: str,
-                           claiming_host: str) -> str:
-        """Return the master host for a state key, claiming it for the
-        caller if unowned (the Redis getMasterIP(claim) analog)."""
+                           claiming_host: str) -> tuple[str, str, int]:
+        """Return ``(master, backup, epoch)`` for a state key, claiming
+        mastership for the caller if unowned (the Redis getMasterIP(claim)
+        analog, grown a replica placement and a fencing epoch, ISSUE 19).
+
+        Fresh claims elect the claimer as master (locality: first writer
+        is usually the hottest), a consistent-hash backup among the other
+        live hosts, and bump the epoch. A recorded master that fell out
+        of the host registry fails over to its live backup (promotion —
+        same transition the keep-alive reaper performs) or, with no live
+        backup, re-elects the claimer. With ``FAABRIC_STATE_REPLICAS=0``
+        backups stay empty and the epoch stays 0 — seed-era semantics.
+        The registry-emptiness guard keeps planner-only unit setups (no
+        registered hosts at all) on plain first-claimer semantics."""
         full = f"{user}/{key}"
+        replicas = get_system_config().state_replicas
+        promoted: list[tuple[str, str, str, int]] = []
         with self._lock:
             master = self._state_masters.get(full)
-            # Satellite fix: never resolve to a corpse. A recorded
-            # master that fell out of the host registry (died, was
-            # removed, or predates a planner restart and never
-            # re-registered) is re-elected to the live claimer. The
-            # registry-emptiness guard keeps planner-only unit setups
-            # (no registered hosts at all) on the old first-claimer
-            # semantics.
             stale = (master is not None and self._hosts
                      and master not in self._hosts)
             if master is None or stale:
-                if stale:
+                backup = self._state_backups.get(full, "")
+                epoch = (self._state_epochs.get(full, 0) + 1
+                         if replicas > 0 else self._state_epochs.get(full, 0))
+                if stale and backup and backup in self._hosts:
+                    # The dead master's replica holds every acked write:
+                    # promote it rather than electing the claimer over
+                    # an empty image
+                    master = backup
+                    new_backup = self._elect_backup_locked(full, {master})
                     logger.warning(
-                        "State master %s for %s is not registered; "
-                        "re-electing %s", master, full, claiming_host)
-                master = claiming_host
-                self._state_masters[full] = master
-                if self._journal.enabled:
-                    self._journal_append("state_claim", key=full,
-                                         host=master)
-            return master
+                        "State master for %s is not registered; promoting "
+                        "backup %s (epoch %d)", full, master, epoch)
+                    self._state_masters[full] = master
+                    self._state_backups[full] = new_backup
+                    self._state_epochs[full] = epoch
+                    if self._journal.enabled:
+                        self._journal_append("state_failover", key=full,
+                                             host=master, backup=new_backup,
+                                             epoch=epoch)
+                    promoted.append((full, master, new_backup, epoch))
+                else:
+                    if stale:
+                        logger.warning(
+                            "State master %s for %s is not registered; "
+                            "re-electing %s", master, full, claiming_host)
+                    master = claiming_host
+                    self._state_masters[full] = master
+                    self._state_backups[full] = self._elect_backup_locked(
+                        full, {master})
+                    if replicas > 0:
+                        self._state_epochs[full] = epoch
+                    if self._journal.enabled:
+                        self._journal_append(
+                            "state_claim", key=full, host=master,
+                            backup=self._state_backups[full], epoch=epoch)
+            elif replicas > 0 and self._hosts:
+                # Live master: lazily heal a dead/absent backup (no epoch
+                # bump — ownership did not change)
+                backup = self._state_backups.get(full, "")
+                if not backup or backup not in self._hosts:
+                    new_backup = self._elect_backup_locked(full, {master})
+                    if new_backup != backup:
+                        self._state_backups[full] = new_backup
+                        if self._journal.enabled:
+                            self._journal_append("state_backup", key=full,
+                                                 backup=new_backup)
+            placement = (master, self._state_backups.get(full, ""),
+                         self._state_epochs.get(full, 0))
+        if promoted:
+            self._dispatch_state_promotions(promoted)
+        return placement
 
     def drop_state_master(self, user: str, key: str) -> None:
         with self._lock:
             dropped = self._state_masters.pop(f"{user}/{key}", None)
+            self._state_backups.pop(f"{user}/{key}", None)
+            # The epoch survives the drop: the next claim must fence out
+            # any process still holding the old mastership
             if dropped is not None and self._journal.enabled:
                 self._journal_append("state_drop", key=f"{user}/{key}")
+
+    def state_placement(self) -> dict[str, dict]:
+        """Authoritative per-key placement for /statemap: full key →
+        {master, backup, epoch}."""
+        with self._lock:
+            return {
+                full: {"master": master,
+                       "backup": self._state_backups.get(full, ""),
+                       "epoch": self._state_epochs.get(full, 0)}
+                for full, master in self._state_masters.items()}
 
     # ------------------------------------------------------------------
     # Crash safety: write-ahead journal + restart replay + reconcile
@@ -1776,6 +1944,8 @@ class Planner:
             "requeue_attempts": {
                 str(a): n for a, n in self._requeue_attempts.items()},
             "state_masters": dict(self._state_masters),
+            "state_backups": dict(self._state_backups),
+            "state_epochs": dict(self._state_epochs),
             "evicted": {str(a): req.to_dict()
                         for a, req in self._evicted.items()},
             "group_hosts": {str(a): [sorted(g), sorted(h)]
@@ -1804,6 +1974,9 @@ class Planner:
             int(a): int(n) for a, n in
             (state.get("requeue_attempts") or {}).items()}
         self._state_masters = dict(state.get("state_masters") or {})
+        self._state_backups = dict(state.get("state_backups") or {})
+        self._state_epochs = {k: int(v) for k, v in
+                              (state.get("state_epochs") or {}).items()}
         self._evicted = {int(a): BatchExecuteRequest.from_dict(r)
                          for a, r in (state.get("evicted") or {}).items()}
         self._group_hosts = {
@@ -1867,8 +2040,20 @@ class Planner:
                 rec["req"])
         elif kind == "state_claim":
             self._state_masters[rec["key"]] = rec["host"]
+            if "backup" in rec:
+                self._state_backups[rec["key"]] = rec["backup"]
+            if rec.get("epoch"):
+                self._state_epochs[rec["key"]] = int(rec["epoch"])
+        elif kind == "state_failover":
+            self._state_masters[rec["key"]] = rec["host"]
+            self._state_backups[rec["key"]] = rec.get("backup", "")
+            self._state_epochs[rec["key"]] = int(rec["epoch"])
+        elif kind == "state_backup":
+            self._state_backups[rec["key"]] = rec.get("backup", "")
         elif kind == "state_drop":
             self._state_masters.pop(rec["key"], None)
+            self._state_backups.pop(rec["key"], None)
+            # epoch intentionally retained: fences a revived ex-master
         elif kind == "group":
             # Group commit (ISSUE 8): one tick's scheduling-class
             # records coalesced into one on-disk record. Atomic by the
@@ -2367,6 +2552,8 @@ class Planner:
             self._next_evicted_ips.clear()
             self._group_hosts.clear()
             self._state_masters.clear()
+            self._state_backups.clear()
+            self._state_epochs.clear()
             self._device_plane = {"roster": [], "size": 0, "port": 0}
             self._num_migrations = 0
             self._clients.close_all()

@@ -344,9 +344,11 @@ class PlannerServer(MessageEndpointServer):
                                             "decision": decision.to_dict()})
 
         if code == int(PlannerCalls.CLAIM_STATE_MASTER):
-            master = self.planner.claim_state_master(
+            master, backup, epoch = self.planner.claim_state_master(
                 h["user"], h["key"], h["host"])
-            return handler_response(header={"master": master})
+            return handler_response(header={"master": master,
+                                            "backup": backup,
+                                            "epoch": epoch})
 
         if code == int(PlannerCalls.DROP_STATE_MASTER):
             self.planner.drop_state_master(h["user"], h["key"])

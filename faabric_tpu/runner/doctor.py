@@ -90,6 +90,9 @@ PULL_AMP_RATIO = 3.0          # total chunk pulls / first-time pulls
 MIN_PULL_CHUNKS = 32          # pulls below this are not a pattern
 MIN_LOCK_STALLS = 2           # one slow acquire is not a convoy
 
+# State-replication analyzer (ISSUE 19)
+REPLICATION_LAG_BYTES = 1 << 20  # acked-but-unforwarded bytes to flag
+
 # CPU-profile analyzers (ISSUE 18)
 CPU_HOTSPOT_SHARE = 0.35      # one stack's share of its host's CPU
 MIN_HOTSPOT_CPU_MS = 500.0    # noise floor: below this, no hotspot call
@@ -664,6 +667,43 @@ def check_lock_convoy(statemap: dict | None) -> list[dict]:
     return findings
 
 
+def check_state_unreplicated(statemap: dict | None) -> list[dict]:
+    """A fenced key (epoch > 0 means the replication plane placed it)
+    running without a live backup, or with a backup lagging the acked
+    bytes (ISSUE 19): one more crash loses acknowledged writes. Epoch-0
+    keys are exempt — FAABRIC_STATE_REPLICAS=0 opted them out."""
+    findings = []
+    for r in _statemap_keys(statemap):
+        epoch = r.get("epoch") or 0
+        if epoch <= 0:
+            continue
+        backup = r.get("backup") or ""
+        lag = r.get("replication_lag") or 0
+        if not backup:
+            findings.append({
+                "kind": "state_unreplicated",
+                "severity": 78.0,
+                "subject": f"state key {r.get('key')}",
+                "detail": (f"fenced at epoch {epoch} on master "
+                           f"{r.get('master') or '?'} with NO backup "
+                           "host — acked writes have a single copy; "
+                           "one more crash loses them (add hosts or "
+                           "check the planner's backup election)"),
+            })
+        elif lag >= REPLICATION_LAG_BYTES:
+            findings.append({
+                "kind": "state_unreplicated",
+                "severity": 60.0,
+                "subject": f"state key {r.get('key')}",
+                "detail": (f"backup {backup} lags the master "
+                           f"{r.get('master') or '?'} by {lag >> 20} "
+                           "MiB of acked bytes (anti-entropy still "
+                           "streaming, or forwards failing) — the key "
+                           "is not crash-safe until the lag drains"),
+            })
+    return findings
+
+
 def check_cpu_hotspot(profile: dict | None) -> list[dict]:
     """One collapsed stack burning an outsized share of its host's
     sampled CPU (ISSUE 18): the direct evidence the planner-shard /
@@ -786,6 +826,7 @@ def diagnose(sources: dict) -> list[dict]:
     findings += check_master_hotspot(sources.get("statemap"))
     findings += check_pull_amplification(sources.get("statemap"))
     findings += check_lock_convoy(sources.get("statemap"))
+    findings += check_state_unreplicated(sources.get("statemap"))
     findings += check_cpu_hotspot(sources.get("profile"))
     findings += check_gil_saturation(sources.get("profile"),
                                      sources.get("metrics"))
@@ -946,10 +987,17 @@ def selftest_sources() -> dict:
         return {"statestats": {"keys": list(rows), "snapshots": {},
                                "registry_bytes": 0, "max_keys": 256}}
 
+    # ISSUE 19 plants: demo/fragile is fenced (epoch 3) but has no
+    # backup host (state_unreplicated); demo/hot is fenced AND backed
+    # up with zero lag and must NOT be flagged.
     state_tel = {
         "hA": block(
             krow("demo/hot", is_master=True, size=64 << 20,
-                 ops_total=5000, bytes_total=1 << 30, local_reads=5000),
+                 ops_total=5000, bytes_total=1 << 30, local_reads=5000,
+                 backup="hB", epoch=1, replication_lag=0),
+            krow("demo/fragile", is_master=True, size=4 << 20,
+                 ops_total=40, bytes_total=8 << 20, local_reads=40,
+                 backup="", epoch=3, replication_lag=4 << 20),
             krow("demo/amplified", is_master=True, size=8 << 20,
                  ops_total=50, bytes_total=32 << 20, local_reads=50)),
         "hB": block(
@@ -1084,6 +1132,15 @@ def run_selftest() -> int:
     convoy = [f for f in findings if f["kind"] == "lock_convoy"]
     if not convoy or "demo/locky" not in convoy[0]["subject"]:
         problems.append("planted lock convoy demo/locky not found")
+    # ISSUE 19 analyzer: the fenced-but-backupless key must be found;
+    # the fenced-and-replicated key must not produce a false positive
+    unrep = [f for f in findings if f["kind"] == "state_unreplicated"]
+    if not unrep or "demo/fragile" not in unrep[0]["subject"]:
+        problems.append("planted unreplicated key demo/fragile "
+                        "not found")
+    if any("demo/hot" in f["subject"] for f in unrep):
+        problems.append("replicated key demo/hot wrongly flagged "
+                        "as unreplicated")
     # ISSUE 18 analyzers: the hA tick hotspot, hA's GIL saturation and
     # hC's starved sampler must be found; idle hB must stay clean
     hotspots = [f for f in findings if f["kind"] == "cpu_hotspot"]

@@ -114,6 +114,9 @@ class Scheduler:
         for e in executors:
             e.shutdown()
         self._snapshot_clients.close_all()
+        # Outbound state connections (remote KVs, replicate forwards)
+        # are pooled per host and would outlive the runtime otherwise
+        self.state.close_clients()
         self._started = False
 
     def reset(self) -> None:

@@ -5,6 +5,7 @@ from faabric_tpu.state.backend import (
     RedisAuthority,
     RemoteAuthority,
     SharedFileAuthority,
+    StaleStateEpoch,
     StateAuthority,
 )
 from faabric_tpu.state.device_handle import (
@@ -16,6 +17,8 @@ from faabric_tpu.state.device_handle import (
     reset_device_handles,
 )
 from faabric_tpu.state.kv import STATE_CHUNK_SIZE, StateKeyValue
+from faabric_tpu.state.placement import place_backup, ring_order
+from faabric_tpu.state.replica import StateReplica
 from faabric_tpu.state.state import State
 from faabric_tpu.state.remote import (
     StateCalls,
@@ -37,12 +40,16 @@ __all__ = [
     "RemoteAuthority",
     "STATE_CHUNK_SIZE",
     "SharedFileAuthority",
+    "StaleStateEpoch",
     "State",
     "StateAuthority",
     "StateCalls",
     "StateClient",
     "StateServer",
     "StateKeyValue",
+    "StateReplica",
     "clear_mock_state_requests",
     "get_mock_state_pushes",
+    "place_backup",
+    "ring_order",
 ]

@@ -40,6 +40,15 @@ logger = get_logger(__name__)
 _APPEND_REC = struct.Struct("<I")
 
 
+class StaleStateEpoch(RuntimeError):
+    """A state RPC carried an epoch older than the receiver's — the
+    sender's placement is stale (a failover happened). Clients re-resolve
+    through the planner and retry; a fenced-out ex-master stops acking
+    (ISSUE 19). Raised with the class name in the message so it survives
+    the transport error channel (clients detect it by substring on the
+    re-raised RpcError)."""
+
+
 class StateAuthority:
     """Authoritative-store accessor for one user/key."""
 
@@ -113,6 +122,16 @@ class MasterMemoryAuthority(StateAuthority):
         with self._lock:
             self._appended.append(bytes(data))
 
+    def all_appended(self) -> list[bytes]:
+        """Every appended value — the anti-entropy full-sync source."""
+        with self._lock:
+            return list(self._appended)
+
+    def seed_appended(self, values: list[bytes]) -> None:
+        """Replace the append log wholesale (replica promotion)."""
+        with self._lock:
+            self._appended[:] = [bytes(v) for v in values]
+
     def get_appended(self, n_values: int) -> list[bytes]:
         with self._lock:
             if len(self._appended) < n_values:
@@ -138,11 +157,15 @@ class RemoteAuthority(StateAuthority):
     side): every op is an RPC to its StateServer."""
 
     def __init__(self, user: str, key: str, master_host: str,
-                 client_factory) -> None:
+                 client_factory, epoch: int = 0) -> None:
         self.user = user
         self.key = key
         self.master_host = master_host
         self._client_factory = client_factory
+        # Fencing epoch stamped on every RPC (ISSUE 19); 0 = unfenced
+        # (replication off / pre-failover-era key). The owning
+        # StateKeyValue bumps it when it re-resolves after a failover.
+        self.epoch = epoch
 
     def _client(self):
         if self._client_factory is None:
@@ -152,19 +175,23 @@ class RemoteAuthority(StateAuthority):
         return self._client_factory(self.master_host)
 
     def pull_chunk(self, offset: int, length: int) -> bytes:
-        return self._client().pull_chunk(self.user, self.key, offset, length)
+        return self._client().pull_chunk(self.user, self.key, offset,
+                                         length, epoch=self.epoch)
 
     def push_chunk(self, offset: int, data: bytes) -> None:
-        self._client().push_chunk(self.user, self.key, offset, data)
+        self._client().push_chunk(self.user, self.key, offset, data,
+                                  epoch=self.epoch)
 
     def append(self, data: bytes) -> None:
-        self._client().append(self.user, self.key, data)
+        self._client().append(self.user, self.key, data, epoch=self.epoch)
 
     def get_appended(self, n_values: int) -> list[bytes]:
-        return self._client().pull_appended(self.user, self.key, n_values)
+        return self._client().pull_appended(self.user, self.key, n_values,
+                                            epoch=self.epoch)
 
     def clear_appended(self) -> None:
-        self._client().clear_appended(self.user, self.key)
+        self._client().clear_appended(self.user, self.key,
+                                      epoch=self.epoch)
 
     # Lock/unlock use one-shot connections: the shared cached client
     # serialises its sync socket, so a blocked lock request would block
@@ -180,7 +207,7 @@ class RemoteAuthority(StateAuthority):
 
         client = StateClient(self.master_host)
         try:
-            getattr(client, op)(self.user, self.key)
+            getattr(client, op)(self.user, self.key, epoch=self.epoch)
         finally:
             client.close()
 

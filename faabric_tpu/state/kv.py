@@ -32,9 +32,16 @@ from typing import Optional
 
 import numpy as np
 
+from faabric_tpu.faults.registry import (
+    DROP,
+    FaultConnectionError,
+    fault_point,
+    faults_enabled,
+)
 from faabric_tpu.state.backend import (
     MasterMemoryAuthority,
     RemoteAuthority,
+    StaleStateEpoch,
     StateAuthority,
 )
 from faabric_tpu.telemetry.commmatrix import get_comm_matrix
@@ -46,10 +53,36 @@ from faabric_tpu.telemetry.statestats import (
 )
 from faabric_tpu.telemetry.tracer import span
 from faabric_tpu.util.logging import get_logger
+from faabric_tpu.util.retry import RetryPolicy
 
 logger = get_logger(__name__)
 
 STATE_CHUNK_SIZE = 4096
+
+# Fault points at the state wire (ISSUE 19 satellite): chaos tests
+# inject delays/drops/conn-kills where state bytes travel instead of
+# only at the transport layer. Same boot-time capture idiom as the
+# transport call sites — FAABRIC_FAULTS unset keeps these at one
+# module-global bool check.
+_FAULTS = faults_enabled()
+_FP_PULL = fault_point("state.pull")
+_FP_PUSH = fault_point("state.push")
+_FP_REPLICATE = fault_point("state.replicate")
+
+# Bounded client-side retry after a failover: one re-resolve through
+# the planner per attempt (the long wait for keep-alive expiry is the
+# CALLER's loop — this only bridges already-promoted placements)
+_PLACEMENT_RETRY = RetryPolicy(max_attempts=3, backoff=0.05)
+
+
+def _fire_fault(point, **ctx) -> None:
+    """A DROP verdict at the state wire surfaces as a peer failure (a
+    dropped state RPC and a dead peer are indistinguishable here), so
+    the retry/no-ack machinery engages instead of bytes silently
+    vanishing."""
+    if point.fire(**ctx) is DROP:
+        raise FaultConnectionError(
+            f"fault rule dropped {point.name} for {ctx.get('key')}")
 
 
 def n_chunks(size: int) -> int:
@@ -60,7 +93,11 @@ class StateKeyValue:
     # Concurrency contract (tools/concheck.py): the image and every
     # mask/cache derived from it mutate under the one RLock. Telemetry
     # handles (_stats, _comm) are write-once in __init__ and internally
-    # locked — plain attribute reads thereafter.
+    # locked — plain attribute reads thereafter. NOT listed: epoch
+    # (monotone int; writes serialized by the server's fencing path and
+    # the single-threaded resolver retry, GIL-atomic reads),
+    # backup_host (whole-str swap, GIL-atomic), _stale (one-way bool
+    # latch — a late reader just fences one op later).
     GUARDS = {
         "_data": "_lock",
         "_pulled": "_lock",
@@ -75,18 +112,29 @@ class StateKeyValue:
                  is_master: bool, master_host: str,
                  client_factory=None,
                  authority: Optional[StateAuthority] = None,
-                 local_host: str = "") -> None:
+                 local_host: str = "", backup_host: str = "",
+                 epoch: int = 0, resolver=None) -> None:
         self.user = user
         self.key = key
         self.size = size
         self.master_host = master_host
         self.full_key = f"{user}/{key}"
         self.local_host = local_host or "local"
+        # Replication + fencing (ISSUE 19). backup_host is where a
+        # MASTER forwards acked writes; epoch fences ops after a
+        # failover; resolver re-resolves (master, backup, epoch) through
+        # the planner. All optional: direct constructions (benches,
+        # tests, file/redis modes) run exactly as before.
+        self.backup_host = backup_host
+        self.epoch = epoch
+        self._resolver = resolver
+        self._stale = False
+        self._client_factory = client_factory
 
         if authority is None:
             authority = (MasterMemoryAuthority(user, key) if is_master
                          else RemoteAuthority(user, key, master_host,
-                                              client_factory))
+                                              client_factory, epoch=epoch))
         self.authority = authority
         # "Master" now means: the authoritative bytes are THIS process's
         # image (the StateServer serves them from here)
@@ -111,13 +159,291 @@ class StateKeyValue:
         self._stats = get_state_stats()
         self._comm = get_comm_matrix()
         self._stats.note_key(self.full_key, master=master_host,
-                             size=size, is_master=self.is_master)
+                             size=size, is_master=self.is_master,
+                             backup=backup_host, epoch=epoch)
 
     # ------------------------------------------------------------------
     def _chunk_range(self, offset: int, length: int) -> tuple[int, int]:
         first = offset // STATE_CHUNK_SIZE
         last = (offset + max(1, length) - 1) // STATE_CHUNK_SIZE
         return first, last + 1
+
+    # ------------------------------------------------------------------
+    # Epoch fencing + replication (ISSUE 19)
+    # ------------------------------------------------------------------
+    def check_epoch(self, req_epoch: int) -> None:
+        """Master-side fence, called by the StateServer on every op:
+        reject requests older than our epoch, adopt newer ones (the
+        planner re-blessed this host), reject EVERYTHING once this
+        master learned it was fenced out — only the journaled epoch
+        owner acks."""
+        if self._stale:
+            raise StaleStateEpoch(
+                f"StaleStateEpoch: {self.full_key} master at "
+                f"{self.local_host} has been fenced out (a failover "
+                "promoted its backup)")
+        if not req_epoch:
+            return
+        if req_epoch < self.epoch:
+            raise StaleStateEpoch(
+                f"StaleStateEpoch: op at epoch {req_epoch} rejected by "
+                f"{self.full_key} master (epoch {self.epoch})")
+        if req_epoch > self.epoch:
+            self.epoch = req_epoch
+
+    def mark_stale(self) -> None:
+        """One-way latch: this process's mastership of the key has been
+        superseded (demotion observed a higher-epoch replicate)."""
+        self._stale = True
+
+    def adopt_placement(self, backup: str, epoch: int) -> None:
+        """Master-side placement refresh (promotion anti-entropy thread
+        learned the backup from the planner)."""
+        self.backup_host = backup
+        if epoch > self.epoch:
+            self.epoch = epoch
+        self._stats.note_key(self.full_key, master=self.master_host,
+                             backup=backup, epoch=self.epoch)
+
+    def load_image(self, data: bytes, appended: list[bytes]) -> None:
+        """Seed a freshly-promoted master from its replica snapshot:
+        the image IS the set of acknowledged writes."""
+        with self._lock:
+            self._data[:len(data)] = np.frombuffer(data, np.uint8)
+            self._pulled[:] = True
+            self._ever_pulled[:] = True
+            self._dirty[:] = False
+            self._n_dirty = 0
+            self._bump_version_locked()
+        if hasattr(self.authority, "seed_appended"):
+            self.authority.seed_appended(appended)
+
+    def _has_backup(self) -> bool:
+        return bool(self.is_master and self.backup_host
+                    and self._client_factory is not None)
+
+    def _remote_retry(self, fn):
+        """Run one remote-authority op, re-resolving placement through
+        the planner and retrying (bounded) when it fails: covers a
+        client whose cached master died after the planner already
+        promoted the backup. StaleStateEpoch surfaces through the
+        transport as an RpcError whose text carries the class name, so
+        a plain re-resolve-on-any-failure is both necessary (connection
+        errors during failover) and sufficient."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — rethrown unless rebound
+                attempt += 1
+                if (attempt >= _PLACEMENT_RETRY.max_attempts
+                        or not self._reresolve_placement()):
+                    raise
+                _PLACEMENT_RETRY.sleep(attempt - 1)
+
+    def _reresolve_placement(self) -> bool:
+        """Non-master side: re-claim through the planner; True when the
+        placement actually changed (worth retrying the op)."""
+        if self.is_master or self._resolver is None:
+            return False
+        try:
+            master, backup, epoch = self._resolver()
+        except Exception:  # noqa: BLE001 — planner unreachable
+            return False
+        auth = self.authority
+        changed = (master != self.master_host
+                   or epoch > getattr(auth, "epoch", 0))
+        if not changed:
+            return False
+        if master == self.local_host:
+            # Total-loss re-election landed mastership on US, but this
+            # object is a remote-image KV and cannot convert in place —
+            # surface the original failure to the caller
+            return False
+        flight_record("state_reresolve", key=self.full_key,
+                      old_master=self.master_host, master=master,
+                      epoch=epoch)
+        self.master_host = master
+        self.backup_host = backup
+        if epoch > self.epoch:
+            self.epoch = epoch
+        if isinstance(auth, RemoteAuthority):
+            auth.master_host = master
+            auth.epoch = epoch
+        self._stats.note_key(self.full_key, master=master, backup=backup,
+                             epoch=epoch)
+        return True
+
+    def _replicate_writes(self, writes: list[tuple[int, bytes]]) -> None:
+        """Synchronously forward chunk writes to the backup BEFORE the
+        mutation is acked — the invariant the whole design rests on: an
+        acked write exists on two hosts (or the ack never happened)."""
+        if not writes or not self._has_backup():
+            return
+        if _FAULTS:
+            _fire_fault(_FP_REPLICATE, key=self.full_key,
+                        host=self.backup_host)
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        nbytes = sum(len(d) for _o, d in writes)
+        with span("state", "replicate", key=self.full_key,
+                  chunks=len(writes)):
+            try:
+                self._client_factory(self.backup_host).replicate_chunks(
+                    self.user, self.key, self.epoch, self.size, writes)
+            except Exception as e:  # noqa: BLE001
+                self._replication_failed(e)
+        if recording:
+            dt_ns = time.monotonic_ns() - t0
+            charge_state_time(dt_ns)
+            self._stats.record(self.full_key, "replicate", nbytes=nbytes,
+                               chunks=len(writes), seconds=dt_ns / 1e9,
+                               remote=True)
+            self._comm.record(self.local_host, self.backup_host, "state",
+                              nbytes, seconds=dt_ns / 1e9,
+                              raw_bytes=nbytes)
+
+    def _replicate_append(self, values: list[bytes],
+                          replace: bool = False) -> None:
+        if (not values and not replace) or not self._has_backup():
+            return
+        if _FAULTS:
+            _fire_fault(_FP_REPLICATE, key=self.full_key,
+                        host=self.backup_host)
+        recording = self._stats.enabled
+        t0 = time.monotonic_ns() if recording else 0
+        nbytes = sum(len(v) for v in values)
+        with span("state", "replicate_append", key=self.full_key,
+                  nbytes=nbytes):
+            try:
+                self._client_factory(self.backup_host).replicate_append(
+                    self.user, self.key, self.epoch, self.size, values,
+                    replace=replace)
+            except Exception as e:  # noqa: BLE001
+                self._replication_failed(e)
+        if recording:
+            dt_ns = time.monotonic_ns() - t0
+            charge_state_time(dt_ns)
+            self._stats.record(self.full_key, "replicate", nbytes=nbytes,
+                               seconds=dt_ns / 1e9, remote=True)
+            self._comm.record(self.local_host, self.backup_host, "state",
+                              nbytes, seconds=dt_ns / 1e9,
+                              raw_bytes=nbytes)
+
+    def _replication_failed(self, err: Exception) -> None:
+        """A backup forward failed. StaleStateEpoch (possibly re-raised
+        through the transport error channel) means WE were fenced out —
+        a failover already promoted our backup — so this master must
+        never ack again. Anything else: re-resolve placement; a newly
+        elected backup gets a full anti-entropy sync (which covers the
+        failed bytes — the local image already holds them); the same
+        unreachable backup propagates the failure (an acked write is
+        never silently unreplicated while a backup is assigned); no
+        eligible backup left runs unreplicated, loudly."""
+        if (isinstance(err, StaleStateEpoch)
+                or "StaleStateEpoch" in str(err)):
+            self._stale = True
+            flight_record("state_fenced", key=self.full_key,
+                          host=self.local_host, epoch=self.epoch)
+            raise StaleStateEpoch(
+                f"StaleStateEpoch: {self.full_key} master at "
+                f"{self.local_host} was fenced out during failover"
+            ) from err
+        flight_record("state_replicate_fail", key=self.full_key,
+                      backup=self.backup_host, error=repr(err))
+        old_backup = self.backup_host
+        if not self._reresolve_master_placement():
+            if self._stale:
+                raise StaleStateEpoch(
+                    f"StaleStateEpoch: {self.full_key} master at "
+                    f"{self.local_host} was fenced out during failover"
+                ) from err
+            raise err
+        if self.backup_host and self.backup_host != old_backup:
+            self.full_sync_backup()
+        elif self.backup_host:
+            raise err
+        else:
+            flight_record("state_unreplicated", key=self.full_key,
+                          host=self.local_host)
+            self._stats.set_replication_lag(self.full_key, self.size)
+
+    def _reresolve_master_placement(self) -> bool:
+        """Master side: re-claim through the planner after a failed
+        forward; False when unresolvable or when the planner says we
+        are no longer the master (→ fenced)."""
+        if self._resolver is None:
+            return False
+        try:
+            master, backup, epoch = self._resolver()
+        except Exception:  # noqa: BLE001 — planner unreachable
+            return False
+        if master != self.local_host:
+            self._stale = True
+            flight_record("state_fenced", key=self.full_key,
+                          host=self.local_host, epoch=epoch)
+            return False
+        self.backup_host = backup
+        if epoch > self.epoch:
+            self.epoch = epoch
+        self._stats.note_key(self.full_key, master=master, backup=backup,
+                             epoch=self.epoch)
+        return True
+
+    def full_sync_backup(self) -> None:
+        """Anti-entropy: stream the whole image + append log to the
+        current backup (fresh backup after a failover or a replicate-
+        failure re-election). Replication lag — bytes the backup is
+        still missing — is visible in statestats until the stream
+        completes; byte-exact including the append log (replace, not
+        additive)."""
+        backup = self.backup_host
+        if not self._has_backup():
+            return
+        client = self._client_factory(backup)
+        self._stats.set_replication_lag(self.full_key, self.size)
+        group_bytes = max(1, (4 << 20) // STATE_CHUNK_SIZE) \
+            * STATE_CHUNK_SIZE
+        sent = 0
+        with span("state", "anti_entropy", key=self.full_key,
+                  nbytes=self.size):
+            for lo in range(0, self.size, group_bytes):
+                hi = min(self.size, lo + group_bytes)
+                with self._lock:
+                    data = self._data[lo:hi].tobytes()
+                client.replicate_chunks(self.user, self.key, self.epoch,
+                                        self.size, [(lo, data)])
+                sent += hi - lo
+                self._stats.set_replication_lag(
+                    self.full_key, max(0, self.size - sent))
+            appended = (self.authority.all_appended()
+                        if hasattr(self.authority, "all_appended") else [])
+            client.replicate_append(self.user, self.key, self.epoch,
+                                    self.size, appended, replace=True)
+        self._stats.set_replication_lag(self.full_key, 0)
+        flight_record("state_anti_entropy", key=self.full_key,
+                      backup=backup, nbytes=self.size)
+
+    def _flush_replication(self) -> None:
+        """Master-local write path (set/set_chunk staged dirty chunks,
+        then push_full/push_partial): forward the dirty chunks to the
+        backup before they are acked/cleared."""
+        if not self._has_backup():
+            return
+        with self._lock:
+            dirty = [int(c) for c in np.where(self._dirty)[0]]
+        if not dirty:
+            return
+        group_chunks = max(1, (4 << 20) // STATE_CHUNK_SIZE)
+        for g in range(0, len(dirty), group_chunks):
+            group = dirty[g:g + group_chunks]
+            with self._lock:
+                writes = []
+                for c in group:
+                    lo = c * STATE_CHUNK_SIZE
+                    hi = min(self.size, lo + STATE_CHUNK_SIZE)
+                    writes.append((lo, self._data[lo:hi].tobytes()))
+            self._replicate_writes(writes)
 
     def _ensure_pulled(self, offset: int, length: int) -> int:
         """Pull any not-yet-pulled chunks covering the range from the
@@ -137,11 +463,16 @@ class StateKeyValue:
         nbytes = 0
         with span("state", "pull", key=self.full_key,
                   chunks=len(missing)):
+            if _FAULTS:
+                _fire_fault(_FP_PULL, key=self.full_key,
+                            host=self.master_host)
             for c in missing:
                 lo = c * STATE_CHUNK_SIZE
                 hi = min(self.size, lo + STATE_CHUNK_SIZE)
                 try:
-                    data = self.authority.pull_chunk(lo, hi - lo)
+                    data = self._remote_retry(
+                        lambda lo=lo, hi=hi:
+                        self.authority.pull_chunk(lo, hi - lo))
                 except Exception as e:  # noqa: BLE001 — record, re-raise
                     flight_record("state_pull_fail", key=self.full_key,
                                   master=self.master_host, offset=lo,
@@ -233,6 +564,10 @@ class StateKeyValue:
     # ------------------------------------------------------------------
     def push_full(self) -> None:
         if self.is_master:
+            # Replicated write path (ISSUE 19): forward the dirty chunks
+            # to the backup BEFORE clearing them — returning from here
+            # is the master-local ack
+            self._flush_replication()
             with self._lock:
                 self._dirty[:] = False
                 self._n_dirty = 0
@@ -245,8 +580,12 @@ class StateKeyValue:
         payload = self.get()
         with span("state", "push_full", key=self.full_key,
                   nbytes=len(payload)):
+            if _FAULTS:
+                _fire_fault(_FP_PUSH, key=self.full_key,
+                            host=self.master_host)
             try:
-                self.authority.push_chunk(0, payload)
+                self._remote_retry(
+                    lambda: self.authority.push_chunk(0, payload))
             except Exception as e:  # noqa: BLE001 — record, re-raise
                 flight_record("state_push_fail", key=self.full_key,
                               master=self.master_host, op="push_full",
@@ -272,6 +611,9 @@ class StateKeyValue:
     def push_partial(self) -> None:
         """Push only the dirty chunks (reference pushPartial)."""
         if self.is_master:
+            # Replicated write path (ISSUE 19): dirty chunks reach the
+            # backup before the master-local ack clears them
+            self._flush_replication()
             with self._lock:
                 self._dirty[:] = False
                 self._n_dirty = 0
@@ -291,6 +633,9 @@ class StateKeyValue:
         group_chunks = max(1, (4 << 20) // STATE_CHUNK_SIZE)
         with span("state", "push_partial", key=self.full_key,
                   chunks=len(dirty)):
+            if _FAULTS:
+                _fire_fault(_FP_PUSH, key=self.full_key,
+                            host=self.master_host)
             for g in range(0, len(dirty), group_chunks):
                 group = dirty[g:g + group_chunks]
                 with self._lock:
@@ -300,7 +645,8 @@ class StateKeyValue:
                         hi = min(self.size, lo + STATE_CHUNK_SIZE)
                         writes.append((lo, self._data[lo:hi].tobytes()))
                 try:
-                    self.authority.push_chunks(writes)
+                    self._remote_retry(
+                        lambda w=writes: self.authority.push_chunks(w))
                 except Exception as e:  # noqa: BLE001 — record, re-raise
                     flight_record("state_push_fail", key=self.full_key,
                                   master=self.master_host,
@@ -346,7 +692,12 @@ class StateKeyValue:
         t0 = time.monotonic_ns() if recording else 0
         with span("state", "append", key=self.full_key,
                   nbytes=len(data)):
-            self.authority.append(data)
+            if self.is_master:
+                self.authority.append(data)
+                # Forward before returning: returning IS the ack
+                self._replicate_append([bytes(data)])
+            else:
+                self._remote_retry(lambda: self.authority.append(data))
         if recording:
             dt_ns = time.monotonic_ns() - t0
             charge_state_time(dt_ns)
@@ -359,6 +710,10 @@ class StateKeyValue:
 
     def clear_appended(self) -> None:
         self.authority.clear_appended()
+        if self.is_master:
+            # Keep the replica's append log byte-exact (replace with
+            # the now-empty log)
+            self._replicate_append([], replace=True)
 
     # ------------------------------------------------------------------
     # Locks (authority-hosted)
@@ -446,6 +801,10 @@ class StateKeyValue:
                                                                   np.uint8)
             self._pulled[first:last] = True
             self._bump_version_locked()
+        # Synchronous backup forward BEFORE the RPC response (the ack):
+        # raising here means the client never sees success (ISSUE 19)
+        self._replicate_writes([(offset, bytes(data))])
 
     def server_append(self, data: bytes) -> None:
         self.authority.append(data)
+        self._replicate_append([bytes(data)])

@@ -3,6 +3,16 @@
 Reference analog: src/state/StateServer.cpp (191 lines) with ops
 Pull/Push/Size/Append/PullAppended/ClearAppended/Delete/Lock/Unlock
 (include/faabric/state/State.h:11-21). Chunk bytes ride the binary tail.
+
+ISSUE 19 additions: every op carries the key's fencing ``epoch`` (0 =
+unfenced, the FAABRIC_STATE_REPLICAS=0 / legacy wire shape), and three
+replication ops — REPLICATE / REPLICATE_APPEND (master → backup dirty
+forwards, applied into the host's passive :class:`StateReplica`) and
+PROMOTE (planner → new master after failover: convert the replica into
+the authoritative copy). A master op whose epoch is older than the
+receiver's raises :class:`StaleStateEpoch`; the message text crosses the
+transport error channel so clients detect it by substring, re-resolve
+placement through the planner, and retry.
 """
 
 from __future__ import annotations
@@ -40,6 +50,11 @@ class StateCalls(enum.IntEnum):
     DELETE = 7
     LOCK = 8
     UNLOCK = 9
+    # Replication plane (ISSUE 19): master → backup synchronous forwards
+    # and the planner's post-failover promotion nudge
+    REPLICATE = 10
+    REPLICATE_APPEND = 11
+    PROMOTE = 12
 
 
 _OP_NAMES = {int(c): c.name.lower() for c in StateCalls}
@@ -59,42 +74,51 @@ def clear_mock_state_requests() -> None:
         _mock_pushes.clear()
 
 
+def _with_epoch(header: dict, epoch: int) -> dict:
+    # Epoch 0 stays OFF the wire: the REPLICAS=0 path keeps the exact
+    # legacy header shape
+    if epoch:
+        header["epoch"] = epoch
+    return header
+
+
 class StateClient(MessageEndpointClient):
     def __init__(self, host: str) -> None:
         super().__init__(host, STATE_ASYNC_PORT, STATE_SYNC_PORT)
 
     def pull_chunk(self, user: str, key: str, offset: int,
-                   length: int) -> bytes:
-        resp = self.sync_send(int(StateCalls.PULL), {
+                   length: int, epoch: int = 0) -> bytes:
+        resp = self.sync_send(int(StateCalls.PULL), _with_epoch({
             "user": user, "key": key, "offset": offset, "length": length,
-        }, idempotent=True)
+        }, epoch), idempotent=True)
         return resp.payload
 
     def push_chunk(self, user: str, key: str, offset: int,
-                   data: bytes) -> None:
+                   data: bytes, epoch: int = 0) -> None:
         if is_mock_mode():
             with _mock_lock:
                 _mock_pushes.append((self.host, user, key, offset, data))
             return
         # Idempotent: pushing the same chunk bytes twice converges
-        self.sync_send(int(StateCalls.PUSH),
-                       {"user": user, "key": key, "offset": offset}, data,
-                       idempotent=True)
+        self.sync_send(int(StateCalls.PUSH), _with_epoch(
+            {"user": user, "key": key, "offset": offset}, epoch), data,
+            idempotent=True)
 
-    def state_size(self, user: str, key: str) -> int:
-        resp = self.sync_send(int(StateCalls.SIZE),
-                              {"user": user, "key": key}, idempotent=True)
+    def state_size(self, user: str, key: str, epoch: int = 0) -> int:
+        resp = self.sync_send(int(StateCalls.SIZE), _with_epoch(
+            {"user": user, "key": key}, epoch), idempotent=True)
         return int(resp.header["size"])
 
-    def append(self, user: str, key: str, data: bytes) -> None:
-        self.sync_send(int(StateCalls.APPEND),
-                       {"user": user, "key": key}, data)
+    def append(self, user: str, key: str, data: bytes,
+               epoch: int = 0) -> None:
+        self.sync_send(int(StateCalls.APPEND), _with_epoch(
+            {"user": user, "key": key}, epoch), data)
 
     def pull_appended(self, user: str, key: str,
-                      n_values: int) -> list[bytes]:
-        resp = self.sync_send(int(StateCalls.PULL_APPENDED), {
+                      n_values: int, epoch: int = 0) -> list[bytes]:
+        resp = self.sync_send(int(StateCalls.PULL_APPENDED), _with_epoch({
             "user": user, "key": key, "n_values": n_values,
-        }, idempotent=True)
+        }, epoch), idempotent=True)
         lengths = resp.header.get("lengths", [])
         out, off = [], 0
         for n in lengths:
@@ -102,19 +126,59 @@ class StateClient(MessageEndpointClient):
             off += n
         return out
 
-    def clear_appended(self, user: str, key: str) -> None:
-        self.sync_send(int(StateCalls.CLEAR_APPENDED),
-                       {"user": user, "key": key}, idempotent=True)
+    def clear_appended(self, user: str, key: str, epoch: int = 0) -> None:
+        self.sync_send(int(StateCalls.CLEAR_APPENDED), _with_epoch(
+            {"user": user, "key": key}, epoch), idempotent=True)
 
     def delete(self, user: str, key: str) -> None:
         self.sync_send(int(StateCalls.DELETE),
                        {"user": user, "key": key}, idempotent=True)
 
-    def lock(self, user: str, key: str) -> None:
-        self.sync_send(int(StateCalls.LOCK), {"user": user, "key": key})
+    def lock(self, user: str, key: str, epoch: int = 0) -> None:
+        self.sync_send(int(StateCalls.LOCK), _with_epoch(
+            {"user": user, "key": key}, epoch))
 
-    def unlock(self, user: str, key: str) -> None:
-        self.sync_send(int(StateCalls.UNLOCK), {"user": user, "key": key})
+    def unlock(self, user: str, key: str, epoch: int = 0) -> None:
+        self.sync_send(int(StateCalls.UNLOCK), _with_epoch(
+            {"user": user, "key": key}, epoch))
+
+    # -- replication plane (master/planner side, ISSUE 19) --------------
+    def replicate_chunks(self, user: str, key: str, epoch: int,
+                         size: int, writes: list[tuple[int, bytes]]) -> None:
+        """Forward dirty chunks to the backup. Idempotent: re-applying
+        the same bytes at the same epoch converges."""
+        if is_mock_mode():
+            return
+        offsets = [int(o) for o, _d in writes]
+        lengths = [len(d) for _o, d in writes]
+        self.sync_send(int(StateCalls.REPLICATE), {
+            "user": user, "key": key, "epoch": epoch, "size": size,
+            "offsets": offsets, "lengths": lengths,
+        }, b"".join(d for _o, d in writes), idempotent=True)
+
+    def replicate_append(self, user: str, key: str, epoch: int, size: int,
+                         values: list[bytes], replace: bool = False) -> None:
+        """Forward appended values; ``replace`` swaps the whole log
+        (anti-entropy full sync) and is therefore idempotent — the
+        additive form is not."""
+        if is_mock_mode():
+            return
+        self.sync_send(int(StateCalls.REPLICATE_APPEND), {
+            "user": user, "key": key, "epoch": epoch, "size": size,
+            "lengths": [len(v) for v in values], "replace": bool(replace),
+        }, b"".join(values), idempotent=bool(replace))
+
+    def promote(self, user: str, key: str, epoch: int,
+                backup: str) -> bool:
+        """Planner → new master after failover: convert the local
+        replica into the authoritative copy at ``epoch`` and start
+        anti-entropy towards ``backup``. False = no replica here."""
+        if is_mock_mode():
+            return True
+        resp = self.sync_send(int(StateCalls.PROMOTE), {
+            "user": user, "key": key, "epoch": epoch, "backup": backup,
+        }, idempotent=True)
+        return bool(resp.header.get("ok"))
 
 
 class StateServer(MessageEndpointServer):
@@ -138,7 +202,48 @@ class StateServer(MessageEndpointServer):
         user, key = h["user"], h["key"]
         op = _OP_NAMES.get(code, str(code))
 
+        # Replication-plane ops target the BACKUP side (no master KV
+        # here by design) — dispatch before the master guard
+        if code == int(StateCalls.REPLICATE):
+            with span("state", "serve_replicate", key=f"{user}/{key}"):
+                writes, off = [], 0
+                for offset, length in zip(h["offsets"], h["lengths"]):
+                    writes.append(
+                        (int(offset), msg.payload[off:off + length]))
+                    off += length
+                self.state.apply_replica_chunks(
+                    user, key, int(h["epoch"]), int(h["size"]), writes)
+            return handler_response()
+
+        if code == int(StateCalls.REPLICATE_APPEND):
+            with span("state", "serve_replicate_append",
+                      key=f"{user}/{key}"):
+                values, off = [], 0
+                for length in h["lengths"]:
+                    values.append(msg.payload[off:off + length])
+                    off += length
+                self.state.apply_replica_append(
+                    user, key, int(h["epoch"]), int(h["size"]), values,
+                    replace=bool(h.get("replace")))
+            return handler_response()
+
+        if code == int(StateCalls.PROMOTE):
+            with span("state", "serve_promote", key=f"{user}/{key}"):
+                ok = self.state.promote_replica(
+                    user, key, int(h["epoch"]), h.get("backup", ""))
+            return handler_response(header={"ok": ok})
+
+        req_epoch = int(h.get("epoch", 0))
         kv = self.state.try_get_kv(user, key)
+        if kv is None or not kv.is_master:
+            # A fenced client op can land here right after a failover,
+            # before (or instead of — the notify is best-effort) the
+            # planner's PROMOTE arrives: a replica at epoch < req_epoch
+            # is the journaled owner's data, so promote it now
+            if req_epoch:
+                kv = self.state.maybe_self_promote(user, key, req_epoch)
+            else:
+                kv = None
         if kv is None or not kv.is_master:
             # A replica asked the wrong host: stale master routing. Worth a
             # black-box record — a burst of these means the planner's master
@@ -146,6 +251,11 @@ class StateServer(MessageEndpointServer):
             flight_record("state_not_master", key=f"{user}/{key}",
                           host=self.state.host, op=op)
             raise KeyError(f"Host is not master for state {user}/{key}")
+
+        # Epoch fence (ISSUE 19): reject ops older than our epoch, adopt
+        # newer ones (the planner re-blessed us), reject everything once
+        # this master knows it has been fenced out
+        kv.check_epoch(req_epoch)
 
         with span("state", f"serve_{op}", key=f"{user}/{key}"):
             if code == int(StateCalls.PULL):

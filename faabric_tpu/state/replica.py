@@ -1,0 +1,102 @@
+"""Passive per-key replicas — the backup side of the replicated write
+path (ISSUE 19).
+
+A master forwards every acked mutation (dirty chunks, appends) to its
+planner-placed backup host BEFORE acking the client; the backup applies
+them into a :class:`StateReplica` — a byte image plus append log plus
+the epoch the bytes were forwarded under. No reads are ever served from
+a replica: it exists only to be promoted. On failover the planner bumps
+the epoch and tells the backup to promote (or the first fenced client op
+triggers self-promotion); promotion converts the replica into a real
+master :class:`~faabric_tpu.state.kv.StateKeyValue` seeded with the
+replica's image, which is exactly the set of acknowledged writes.
+
+Epoch fencing lives here too: a forward carrying an epoch older than the
+replica's is a write from a fenced-out ex-master and raises
+:class:`~faabric_tpu.state.backend.StaleStateEpoch` — the rejection that
+makes it impossible for a stale master to ack (its ack path requires
+this forward to succeed).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from faabric_tpu.state.backend import StaleStateEpoch
+
+
+class StateReplica:
+    # Concurrency contract (tools/concheck.py): image, append log, size
+    # and epoch all mutate together under one lock (a forward must be
+    # applied atomically against the fence check).
+    GUARDS = {
+        "_data": "_lock",
+        "_appended": "_lock",
+        "_epoch": "_lock",
+        "_size": "_lock",
+    }
+
+    def __init__(self, user: str, key: str, size: int,
+                 epoch: int = 0) -> None:
+        self.user = user
+        self.key = key
+        self.full_key = f"{user}/{key}"
+        self._lock = threading.Lock()
+        self._size = size
+        self._data = np.zeros(size, dtype=np.uint8)
+        self._appended: list[bytes] = []
+        self._epoch = epoch
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return self._size
+
+    def _fence_locked(self, epoch: int, size: int) -> None:
+        if epoch < self._epoch:
+            raise StaleStateEpoch(
+                f"StaleStateEpoch: replicate of {self.full_key} at epoch "
+                f"{epoch} rejected (replica at epoch {self._epoch})")
+        self._epoch = epoch
+        if size > self._size:
+            grown = np.zeros(size, dtype=np.uint8)
+            grown[:self._size] = self._data
+            self._data = grown
+            self._size = size
+
+    def apply_chunks(self, epoch: int, size: int,
+                     writes: list[tuple[int, bytes]]) -> None:
+        with self._lock:
+            self._fence_locked(epoch, size)
+            for offset, data in writes:
+                if offset + len(data) > self._size:
+                    raise ValueError(
+                        f"Replicated chunk [{offset}, "
+                        f"{offset + len(data)}) out of bounds "
+                        f"(size {self._size})")
+                self._data[offset:offset + len(data)] = np.frombuffer(
+                    data, np.uint8)
+
+    def apply_append(self, epoch: int, size: int, values: list[bytes],
+                     replace: bool = False) -> None:
+        """Forwarded appends; ``replace=True`` swaps the whole log
+        (anti-entropy full sync — byte-exact, not additive)."""
+        with self._lock:
+            self._fence_locked(epoch, size)
+            if replace:
+                self._appended[:] = [bytes(v) for v in values]
+            else:
+                self._appended.extend(bytes(v) for v in values)
+
+    def snapshot(self) -> tuple[bytes, list[bytes], int]:
+        """(image, appended values, epoch) — the promotion payload."""
+        with self._lock:
+            return (self._data.tobytes(), list(self._appended),
+                    self._epoch)

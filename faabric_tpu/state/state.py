@@ -3,6 +3,12 @@
 Reference analog: include/faabric/state/State.h:23-59 and
 src/state/State.cpp:100-160. ``get_kv`` resolves the key's master through
 the planner (first caller claims mastership) and caches the KV locally.
+
+ISSUE 19: this object also hosts the BACKUP side of the replicated
+write path — passive :class:`~faabric_tpu.state.replica.StateReplica`
+images that masters forward acked writes into, and the promotion paths
+(planner PROMOTE RPC or fenced-op self-promotion) that convert a replica
+into a real master KV after failover.
 """
 
 from __future__ import annotations
@@ -10,7 +16,9 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from faabric_tpu.state.backend import StaleStateEpoch
 from faabric_tpu.state.kv import StateKeyValue
+from faabric_tpu.state.replica import StateReplica
 from faabric_tpu.util.logging import get_logger
 
 logger = get_logger(__name__)
@@ -20,6 +28,7 @@ class State:
     # Concurrency contract (tools/concheck.py)
     GUARDS = {
         "_kvs": "_lock",
+        "_replicas": "_lock",
     }
 
     def __init__(self, host: str, planner_client=None) -> None:
@@ -27,6 +36,9 @@ class State:
         self.planner_client = planner_client
         self._lock = threading.Lock()
         self._kvs: dict[str, StateKeyValue] = {}
+        # Passive replicas this host backs for OTHER hosts' masters
+        # (ISSUE 19) — never read from, only promoted
+        self._replicas: dict[str, StateReplica] = {}
 
         from faabric_tpu.state.remote import StateClient
         from faabric_tpu.transport.client_pool import ClientPool
@@ -36,6 +48,12 @@ class State:
     # ------------------------------------------------------------------
     def _client_factory(self, master_host: str):
         return self._state_clients.get(master_host)
+
+    def close_clients(self) -> None:
+        """Close every pooled outbound state connection (runtime
+        teardown). Safe mid-life: the pool re-dials lazily on the next
+        remote op."""
+        self._state_clients.close_all()
 
     def get_kv(self, user: str, key: str, size: int = 0) -> StateKeyValue:
         full = f"{user}/{key}"
@@ -86,19 +104,31 @@ class State:
         return StateKeyValue(user, key, authority.size, False, "<file>",
                              authority=authority, local_host=self.host)
 
+    def _resolver_for(self, user: str, key: str):
+        """Placement re-resolution closure handed to each in-memory KV:
+        one planner claim returning (master, backup, epoch)."""
+        if self.planner_client is None:
+            return None
+
+        def resolve() -> tuple[str, str, int]:
+            return self.planner_client.claim_state_master(user, key)
+
+        return resolve
+
     def _make_inmemory_kv(self, user: str, key: str,
                           size: int) -> StateKeyValue:
         from faabric_tpu.telemetry import flight_record
 
         full = f"{user}/{key}"
         if self.planner_client is not None:
-            master = self.planner_client.claim_state_master(user, key)
+            master, backup, epoch = \
+                self.planner_client.claim_state_master(user, key)
         else:
-            master = self.host
+            master, backup, epoch = self.host, "", 0
         is_master = master == self.host
         if is_master:
             flight_record("state_master_claim", key=full, host=self.host,
-                          size=max(size, 0))
+                          size=max(size, 0), backup=backup, epoch=epoch)
 
         if size <= 0:
             if is_master:
@@ -114,11 +144,14 @@ class State:
                         logger.warning("Could not release claim on %s", full)
                 raise ValueError(
                     f"Master creation of {full} needs an explicit size")
-            size = self._client_factory(master).state_size(user, key)
+            size = self._client_factory(master).state_size(user, key,
+                                                           epoch=epoch)
 
         return StateKeyValue(user, key, size, is_master, master,
                              client_factory=self._client_factory,
-                             local_host=self.host)
+                             local_host=self.host, backup_host=backup,
+                             epoch=epoch,
+                             resolver=self._resolver_for(user, key))
 
     def try_get_kv(self, user: str, key: str) -> Optional[StateKeyValue]:
         with self._lock:
@@ -127,6 +160,7 @@ class State:
     def delete_kv(self, user: str, key: str) -> None:
         with self._lock:
             kv = self._kvs.pop(f"{user}/{key}", None)
+            self._replicas.pop(f"{user}/{key}", None)
         if kv is not None and kv.is_master \
                 and self.planner_client is not None:
             try:
@@ -145,4 +179,149 @@ class State:
     def clear(self) -> None:
         with self._lock:
             self._kvs.clear()
+            self._replicas.clear()
         self._state_clients.close_all()
+
+    # ------------------------------------------------------------------
+    # Backup side of the replicated write path (ISSUE 19): masters
+    # forward acked writes here; the planner (or a fenced client op)
+    # promotes the replica after the master dies.
+    # ------------------------------------------------------------------
+    def _get_replica(self, full: str, size: int, epoch: int) -> StateReplica:
+        with self._lock:
+            rep = self._replicas.get(full)
+            if rep is None:
+                user, _, key = full.partition("/")
+                rep = StateReplica(user, key, size, epoch=epoch)
+                self._replicas[full] = rep
+            return rep
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def apply_replica_chunks(self, user: str, key: str, epoch: int,
+                             size: int,
+                             writes: list[tuple[int, bytes]]) -> None:
+        full = f"{user}/{key}"
+        self._fence_or_demote_master(full, epoch)
+        self._get_replica(full, size, epoch).apply_chunks(
+            epoch, size, writes)
+
+    def apply_replica_append(self, user: str, key: str, epoch: int,
+                             size: int, values: list[bytes],
+                             replace: bool = False) -> None:
+        full = f"{user}/{key}"
+        self._fence_or_demote_master(full, epoch)
+        self._get_replica(full, size, epoch).apply_append(
+            epoch, size, values, replace=replace)
+
+    def _fence_or_demote_master(self, full: str, epoch: int) -> None:
+        """A replicate forward arrived for a key THIS host masters.
+        Older-or-equal epoch: the sender is a fenced-out ex-master still
+        trying to ack — reject (this rejection is what makes a stale
+        ack structurally impossible). Newer epoch: WE are the stale
+        ex-master and a legitimately promoted master is replicating to
+        us — demote our KV into a replica seeded with its image."""
+        user, _, key = full.partition("/")
+        kv = self.try_get_kv(user, key)
+        if kv is None or not kv.is_master:
+            return
+        if epoch <= kv.epoch:
+            raise StaleStateEpoch(
+                f"StaleStateEpoch: replicate of {full} at epoch {epoch} "
+                f"rejected by its master at {self.host} "
+                f"(epoch {kv.epoch})")
+        from faabric_tpu.telemetry import flight_record
+
+        logger.warning(
+            "Demoting stale master %s at %s: epoch %d replicate arrived "
+            "(local epoch %d)", full, self.host, epoch, kv.epoch)
+        kv.mark_stale()
+        image = kv.get()
+        appended = (kv.authority.all_appended()
+                    if hasattr(kv.authority, "all_appended") else [])
+        rep = self._get_replica(full, kv.size, kv.epoch)
+        rep.apply_chunks(kv.epoch, kv.size, [(0, image)])
+        rep.apply_append(kv.epoch, kv.size, appended, replace=True)
+        with self._lock:
+            self._kvs.pop(full, None)
+        flight_record("state_demoted", key=full, host=self.host,
+                      old_epoch=kv.epoch, new_epoch=epoch)
+
+    def maybe_self_promote(self, user: str, key: str,
+                           req_epoch: int) -> Optional[StateKeyValue]:
+        """A fenced client op landed here but no master KV exists: if we
+        back a replica at an older epoch, the planner's journal made us
+        the owner (clients only learn epochs from planner claims) and
+        the PROMOTE notify was lost or has not arrived yet — promote
+        now. Returns the new master KV, or None."""
+        full = f"{user}/{key}"
+        with self._lock:
+            rep = self._replicas.get(full)
+        if rep is None or req_epoch <= rep.epoch:
+            return None
+        if self.promote_replica(user, key, req_epoch, ""):
+            return self.try_get_kv(user, key)
+        return None
+
+    def promote_replica(self, user: str, key: str, epoch: int,
+                        backup: str) -> bool:
+        """Convert this host's replica into the authoritative master
+        copy at ``epoch`` (failover). Idempotent: a duplicate PROMOTE
+        for an already-promoted key just returns True. False = no
+        replica here (the planner drops the mastership so the next
+        claim re-elects). The new backup anti-entropy-syncs from the
+        promoted image on a background thread."""
+        full = f"{user}/{key}"
+        from faabric_tpu.telemetry import flight_record
+
+        with self._lock:
+            existing = self._kvs.get(full)
+            if (existing is not None and existing.is_master
+                    and existing.epoch >= epoch):
+                return True
+            rep = self._replicas.get(full)
+        if rep is None:
+            return False
+        image, appended, _rep_epoch = rep.snapshot()
+        kv = StateKeyValue(user, key, len(image), True, self.host,
+                           client_factory=self._client_factory,
+                           local_host=self.host, backup_host=backup,
+                           epoch=epoch,
+                           resolver=self._resolver_for(user, key))
+        kv.load_image(image, appended)
+        with self._lock:
+            # Replace any stale non-master KV for the key (a demoted
+            # ex-master was already removed by _fence_or_demote_master)
+            self._kvs[full] = kv
+            self._replicas.pop(full, None)
+        logger.warning("Promoted replica %s to master at %s (epoch %d, "
+                       "new backup %r)", full, self.host, epoch, backup)
+        flight_record("state_promoted", key=full, host=self.host,
+                      epoch=epoch, backup=backup, size=kv.size)
+        self._start_anti_entropy(kv)
+        return True
+
+    def _start_anti_entropy(self, kv: StateKeyValue) -> None:
+        """Post-promotion: learn the new backup from the planner if the
+        PROMOTE carried none, then stream the full image to it. Off the
+        server thread — promotion must ack fast; the replication-lag
+        gauge stays honest (== size) until the sync lands."""
+        def run() -> None:
+            try:
+                if not kv.backup_host and self.planner_client is not None:
+                    master, backup, epoch = \
+                        self.planner_client.claim_state_master(kv.user,
+                                                               kv.key)
+                    if master != self.host:
+                        return  # superseded by a newer failover
+                    kv.adopt_placement(backup, epoch)
+                kv.full_sync_backup()
+            except Exception as e:  # noqa: BLE001 — retried by the next
+                # replicate-failure re-resolve; the lag gauge stays loud
+                logger.warning("Anti-entropy sync of %s to %r failed: %s",
+                               kv.full_key, kv.backup_host, e)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"state/anti-entropy@{kv.full_key}").start()

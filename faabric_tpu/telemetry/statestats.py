@@ -55,7 +55,7 @@ DEFAULT_LOCK_STALL_MS = 100.0
 # Every op the ledger accounts; fixed upfront so the Prometheus
 # counter handles are pre-built (record() is on the state hot path)
 STATE_OPS = ("get", "set", "get_chunk", "set_chunk", "pull", "push_full",
-             "push_partial", "append", "lock_global")
+             "push_partial", "append", "lock_global", "replicate")
 
 # Snapshot lifecycle events folded into store-level estimators
 SNAPSHOT_EVENTS = ("diff", "device_diff", "apply", "restore", "push")
@@ -75,6 +75,7 @@ class _KeyEntry:
                  "pull_chunks_total", "pull_chunks_fresh",
                  "lock_waits", "lock_stalls", "lock_wait",
                  "master", "size", "is_master", "dirty_outstanding",
+                 "backup", "epoch", "replication_lag",
                  "_lock")
 
     def __init__(self, half_life: float) -> None:
@@ -94,6 +95,11 @@ class _KeyEntry:
         self.size = 0
         self.is_master = False
         self.dirty_outstanding = 0
+        # Replication plane (ISSUE 19): the key's backup host, its
+        # fencing epoch, and bytes acked-but-not-yet-on-the-backup
+        self.backup = ""
+        self.epoch = 0
+        self.replication_lag = 0
         self._lock = threading.Lock()
 
     def add(self, op: str, nbytes: int, chunks: int, dirty_chunks: int,
@@ -132,6 +138,9 @@ class _KeyEntry:
             return {
                 "key": key,
                 "master": self.master,
+                "backup": self.backup,
+                "epoch": self.epoch,
+                "replication_lag": self.replication_lag,
                 "size": self.size,
                 "is_master": self.is_master,
                 "ops": dict(self.ops),
@@ -164,7 +173,7 @@ class _NullStateStats:
     enabled = False
 
     def note_key(self, full_key, master="", size=0,
-                 is_master=False) -> None:
+                 is_master=False, backup=None, epoch=None) -> None:
         pass
 
     def record(self, full_key, op, nbytes=0, chunks=0, dirty_chunks=0,
@@ -175,6 +184,9 @@ class _NullStateStats:
         pass
 
     def set_dirty_outstanding(self, full_key, n) -> None:
+        pass
+
+    def set_replication_lag(self, full_key, nbytes) -> None:
         pass
 
     def snapshot_event(self, kind, nbytes=0, pages=0, regions=0,
@@ -285,9 +297,12 @@ class StateStatsStore:
         return entry
 
     def note_key(self, full_key: str, master: str = "", size: int = 0,
-                 is_master: bool = False) -> None:
+                 is_master: bool = False, backup: str | None = None,
+                 epoch: int | None = None) -> None:
         """Identity facts stamped at KV creation (master host, declared
-        size) — the statemap's placement columns."""
+        size) — the statemap's placement columns. ``backup``/``epoch``
+        use None as "unchanged": "" and 0 are real values (no backup,
+        unfenced) a failover re-resolve must be able to write."""
         entry = self._entry(full_key)
         with entry._lock:
             if master:
@@ -295,6 +310,10 @@ class StateStatsStore:
             if size:
                 entry.size = int(size)
             entry.is_master = entry.is_master or is_master
+            if backup is not None:
+                entry.backup = backup
+            if epoch is not None:
+                entry.epoch = max(entry.epoch, int(epoch))
 
     def record(self, full_key: str, op: str, nbytes: int = 0,
                chunks: int = 0, dirty_chunks: int = 0,
@@ -324,6 +343,14 @@ class StateStatsStore:
         entry = self._entry(full_key)
         with entry._lock:
             entry.dirty_outstanding = int(n)
+
+    def set_replication_lag(self, full_key: str, nbytes: int) -> None:
+        """Bytes acked to clients but not yet applied on the backup
+        (0 in steady state; == size right after a promotion until
+        anti-entropy lands; == size permanently while unreplicated)."""
+        entry = self._entry(full_key)
+        with entry._lock:
+            entry.replication_lag = int(nbytes)
 
     # -- snapshot lifecycle ---------------------------------------------
     def snapshot_event(self, kind: str, nbytes: int = 0, pages: int = 0,
@@ -444,7 +471,8 @@ def aggregate_statemap(tel: dict) -> dict:
         for row in block.get("keys") or []:
             key = row.get("key") or OTHER
             agg = keys.setdefault(key, {
-                "key": key, "master": "", "size": 0,
+                "key": key, "master": "", "backup": "", "epoch": 0,
+                "replication_lag": 0, "size": 0,
                 "ops_total": 0, "bytes_total": 0,
                 "local_reads": 0, "remote_reads": 0,
                 "pull_chunks_total": 0, "pull_chunks_fresh": 0,
@@ -453,8 +481,17 @@ def aggregate_statemap(tel: dict) -> dict:
             })
             if row.get("is_master") and host != OTHER:
                 agg["master"] = host
+                # Backup/lag are master-authored facts: only the master
+                # forwards, so only its row can say where and how far
+                # behind (other hosts' rows carry stale claim-time data)
+                if row.get("backup") is not None:
+                    agg["backup"] = row["backup"]
+                agg["replication_lag"] = row.get("replication_lag") or 0
             elif not agg["master"] and row.get("master"):
                 agg["master"] = row["master"]
+                if not agg["backup"]:
+                    agg["backup"] = row.get("backup") or ""
+            agg["epoch"] = max(agg["epoch"], row.get("epoch") or 0)
             agg["size"] = max(agg["size"], row.get("size") or 0)
             agg["ops_total"] += row.get("ops_total") or 0
             agg["bytes_total"] += row.get("bytes_total") or 0
@@ -499,21 +536,57 @@ def aggregate_statemap(tel: dict) -> dict:
     }
 
 
+def merge_placement(doc: dict, placement: dict) -> dict:
+    """Overlay the planner's authoritative (master, backup, epoch) table
+    onto an aggregated statemap. Host ledgers only know placements as of
+    their last claim; the planner's journal is the source of truth right
+    after a failover, so its values win. Keys the planner tracks but no
+    ledger reported yet (e.g. promoted before any post-failover access)
+    gain a zero-traffic row rather than being dropped."""
+    if not placement:
+        return doc
+    by_key = {r["key"]: r for r in (doc.get("keys") or [])}
+    for full, p in placement.items():
+        row = by_key.get(full)
+        if row is None:
+            row = {
+                "key": full, "master": "", "backup": "", "epoch": 0,
+                "replication_lag": 0, "size": 0,
+                "ops_total": 0, "bytes_total": 0,
+                "local_reads": 0, "remote_reads": 0,
+                "pull_chunks_total": 0, "pull_chunks_fresh": 0,
+                "lock_waits": 0, "lock_stalls": 0,
+                "by_origin": {}, "pull_amplification": None,
+                "locality": None, "rank": len(by_key) + 1,
+            }
+            by_key[full] = row
+            doc.setdefault("keys", []).append(row)
+        row["master"] = p.get("master") or row["master"]
+        row["backup"] = p.get("backup", row["backup"])
+        row["epoch"] = max(row.get("epoch") or 0,
+                           int(p.get("epoch") or 0))
+    return doc
+
+
 def render_statemap(doc: dict, top: int = 20) -> str:
     """Terminal table of a :func:`aggregate_statemap` document — the
     ``python -m faabric_tpu.runner.statemap`` surface."""
     keys = (doc or {}).get("keys") or []
     hosts = (doc or {}).get("hosts") or {}
-    lines = [f"{'#':>3} {'key':<28} {'master':<12} {'size':>10} "
+    lines = [f"{'#':>3} {'key':<28} {'master':<12} {'backup':<12} "
+             f"{'ep':>3} {'lag':>9} {'size':>10} "
              f"{'ops':>8} {'bytes':>12} {'local%':>7} {'pull amp':>8} "
              f"{'lock waits':>10}",
-             "-" * 104]
+             "-" * 126]
     for r in keys[:top]:
         loc = r.get("locality")
         amp = r.get("pull_amplification")
         lines.append(
             f"{r.get('rank', 0):>3} {r.get('key', '')[:28]:<28} "
             f"{(r.get('master') or '?')[:12]:<12} "
+            f"{(r.get('backup') or '-')[:12]:<12} "
+            f"{r.get('epoch', 0):>3} "
+            f"{r.get('replication_lag', 0):>9} "
             f"{r.get('size', 0):>10} {r.get('ops_total', 0):>8} "
             f"{r.get('bytes_total', 0):>12} "
             f"{(f'{loc * 100:.0f}%' if loc is not None else '-'):>7} "

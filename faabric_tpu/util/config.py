@@ -40,6 +40,11 @@ class SystemConfig:
     # State
     state_mode: str = "inmemory"  # inmemory | file (shm) | redis
     state_dir: str = "/dev/shm/faabric_tpu_state"
+    # Synchronous backups per in-memory state key (ISSUE 19). 1 = every
+    # key gets a planner-placed backup host and masters forward dirty
+    # chunks before acking; 0 = seed-era single-master semantics (no
+    # backups, no epochs on the wire, no fencing).
+    state_replicas: int = 1
     # THREADS batches whose snapshots declare merge regions promise their
     # writes stay inside them: trackers then baseline/compare only those
     # pages (writes outside the hints go undetected — opt-in)
@@ -146,6 +151,7 @@ class SystemConfig:
 
         self.state_mode = _env("STATE_MODE", "inmemory")
         self.state_dir = _env("STATE_DIR", "/dev/shm/faabric_tpu_state")
+        self.state_replicas = _env_int("FAABRIC_STATE_REPLICAS", 1)
         self.redis_state_host = _env("REDIS_STATE_HOST", "redis")
         self.redis_queue_host = _env("REDIS_QUEUE_HOST", "redis")
         self.redis_port = _env_int("REDIS_PORT", 6379)

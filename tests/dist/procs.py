@@ -1095,6 +1095,45 @@ class DistExecutor(Executor):
         msg.output_data = f"wire={wire}".encode()
         return int(ReturnValue.SUCCESS)
 
+    def fn_state_claim(self, msg, req):
+        """ISSUE 19 chaos helper: claim mastership of the key named in
+        input_data on THIS host (first writer = master) and seed a
+        recognizable image, so the failover test controls exactly which
+        worker process masters which key before the SIGKILL."""
+        from faabric_tpu.state import STATE_CHUNK_SIZE
+
+        key = msg.input_data.decode()
+        state = self.scheduler.state
+        kv = state.get_kv("chaos", key, 4 * STATE_CHUNK_SIZE)
+        kv.set_chunk(0, bytes([7]) * STATE_CHUNK_SIZE)
+        kv.push_partial()
+        msg.output_data = f"{key}@{state.host}".encode()
+        return int(ReturnValue.SUCCESS)
+
+    def fn_state_stale_probe(self, msg, req):
+        """ISSUE 19 fencing probe: attempt an acked write through a
+        master KV this (revived) host still holds from BEFORE a
+        failover promoted its backup. The epoch fence must reject the
+        ack — the output reports what actually happened so the chaos
+        test can assert split-brain is structurally impossible."""
+        from faabric_tpu.state import STATE_CHUNK_SIZE, StaleStateEpoch
+
+        key = msg.input_data.decode()
+        state = self.scheduler.state
+        kv = state.try_get_kv("chaos", key)
+        if kv is None or not kv.is_master:
+            msg.output_data = b"no-master-kv"
+            return int(ReturnValue.SUCCESS)
+        kv.set_chunk(0, b"\xee" * STATE_CHUNK_SIZE)
+        try:
+            kv.push_partial()
+        except StaleStateEpoch:
+            msg.output_data = b"fenced:StaleStateEpoch"
+        except Exception as e:  # noqa: BLE001 — report, never ack
+            msg.output_data = f"error:{type(e).__name__}".encode()
+        else:
+            msg.output_data = b"ACKED"
+        return int(ReturnValue.SUCCESS)
 
     def fn_profile_spin(self, msg, req):
         """ISSUE 18 profiling acceptance: burn this executor-pool

@@ -1,0 +1,291 @@
+"""Chaos proof for the replicated state plane (ISSUE 19 tentpole §3).
+
+Acceptance: with sustained multi-key write traffic through planner +
+3 real worker processes, SIGKILLing the hottest state master loses
+ZERO acknowledged writes — every payload whose push returned is
+readable byte-exact after the backup is promoted — and the failover
+is bounded (``master_failover_s`` reported). Epoch fencing is proven
+the only way it honestly can be in a distributed setting: SIGSTOP a
+master (its memory — including the master KV — survives), let the
+planner expire + promote past it, SIGCONT it, and show its revived
+master KV CANNOT ack a write (the promoted ex-backup rejects the
+replicate forward with StaleStateEpoch) and its poisoned bytes never
+reach the authoritative copy.
+
+Keys are pre-placed so that (a) each worker masters a known subset
+(the claim runs on a pinned host via a preloaded decision — first
+writer is master) and (b) the consistent-hash backup of every key is
+a WORKER, not this test process's 0-slot client host (the client
+registers like any host and is hash-eligible; a promotion landing in
+the test process would prove nothing about surviving a process kill).
+
+Kill tests are chaos+slow — tier-1 covers the in-process failover
+mechanics in tests/unit/test_state_replication.py.
+"""
+
+import signal
+import time
+
+import pytest
+
+from faabric_tpu.batch_scheduler.decision import SchedulingDecision
+from faabric_tpu.proto import ReturnValue, batch_exec_factory
+from faabric_tpu.state import STATE_CHUNK_SIZE, place_backup
+from tests.dist.test_chaos import ChaosCluster, wait_finished
+
+pytestmark = pytest.mark.chaos
+
+CHUNK = STATE_CHUNK_SIZE
+SIZE = 4 * CHUNK  # must match fn_state_claim's get_kv size
+
+
+def _payload(key: str, seq: int) -> bytes:
+    pat = f"{key}:{seq}|".encode()
+    return (pat * (CHUNK // len(pat) + 1))[:CHUNK]
+
+
+def _pick_keys(n: int, masters: list[str], workers: list[str],
+               all_hosts: list[str], prefix: str = "k") -> dict[str, str]:
+    """key -> designated master, round-robin over ``masters``, keeping
+    only keys whose consistent-hash backup lands on a (non-master)
+    worker process."""
+    chosen: dict[str, str] = {}
+    j = 0
+    while len(chosen) < n:
+        key, j = f"{prefix}{j}", j + 1
+        master = masters[len(chosen) % len(masters)]
+        others = [h for h in all_hosts if h != master]
+        if place_backup(f"chaos/{key}", others) in workers:
+            chosen[key] = master
+    return chosen
+
+
+def _claim_on(me, owner: dict[str, str]) -> None:
+    """Run fn_state_claim for each key on its designated master via a
+    preloaded decision (first writer = master)."""
+    req = batch_exec_factory("dist", "state_claim", len(owner))
+    pre = SchedulingDecision(app_id=req.app_id, group_id=0)
+    for i, (key, host) in enumerate(owner.items()):
+        req.messages[i].input_data = key.encode()
+        pre.add_message(host, 0, req.messages[i].app_idx, i)
+    me.planner_client.preload_scheduling_decision(pre)
+    decision = me.planner_client.call_functions(req)
+    assert list(decision.hosts) == list(owner.values()), \
+        f"preload not honored: {decision.hosts}"
+    status = wait_finished(me, req.app_id, timeout=30)
+    got = {m.output_data.decode() for m in status.message_results}
+    assert got == {f"{k}@{h}" for k, h in owner.items()}, got
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_state_master_loses_zero_acked_writes():
+    """SIGKILL the hottest master mid-stream: every acked write stays
+    readable byte-exact through the promoted backup; failover bounded."""
+    cluster = ChaosCluster(
+        "ckS", n_workers=3, slots=(4, 4, 4),
+        extra_env={"PLANNER_HOST_TIMEOUT": "3"}).start()
+    try:
+        me = cluster.me
+        workers = cluster.workers
+        all_hosts = workers + [f"{cluster.tag}cli"]
+        owner = _pick_keys(6, workers, workers, all_hosts)
+        keys = list(owner)
+        _claim_on(me, owner)
+
+        # The planner's election must agree with the pure function the
+        # test used to pre-pick worker-resident backups
+        placed0 = {k: me.planner_client.claim_state_master("chaos", k)
+                   for k in keys}
+        for k, (m, b, e) in placed0.items():
+            assert m == owner[k], (k, m)
+            assert b == place_backup(
+                f"chaos/{k}", [h for h in all_hosts if h != m]), (k, b)
+            assert e >= 1, (k, e)
+
+        # Sustained acked write stream, weighted so workers[0] is the
+        # hottest master by a clear margin
+        kvs = {k: me.state.get_kv("chaos", k, SIZE) for k in keys}
+        acked: dict[str, bytes] = {}
+        by_master: dict[str, int] = {}
+        seq = 0
+
+        def write(k: str) -> None:
+            nonlocal seq
+            p = _payload(k, seq)
+            seq += 1
+            kvs[k].set_chunk(CHUNK, p)
+            kvs[k].push_partial()  # returning IS the ack
+            acked[k] = p
+            by_master[owner[k]] = by_master.get(owner[k], 0) + 1
+
+        for _ in range(4):
+            for k in keys:
+                write(k)
+        hot = [k for k in keys if owner[k] == workers[0]]
+        for _ in range(4):
+            for k in hot:
+                write(k)
+        victim = max(by_master, key=by_master.get)
+        assert victim == workers[0], by_master
+
+        # Mid-stream: dirty (in-flight, NOT acked) chunks exist on the
+        # victim's keys at the moment it dies
+        for k in hot:
+            kvs[k].set_chunk(CHUNK, b"\x00" * CHUNK)
+        t_kill = cluster.kill(victim)
+
+        # Writes to the victim's keys resume once expiry reaps it and
+        # the backup is promoted: the caller's loop bridges the
+        # detection window (kv-internal retry only bridges an
+        # already-promoted placement)
+        failover_s = None
+        deadline = time.time() + 60
+        for k in hot:
+            while True:
+                try:
+                    write(k)
+                    break
+                except Exception:
+                    assert time.time() < deadline, \
+                        f"no failover for {k} within budget"
+                    try:  # tick keep-alive expiry on the planner
+                        me.planner_client.get_available_hosts()
+                    except Exception:
+                        pass
+                    time.sleep(0.25)
+            if failover_s is None:
+                failover_s = time.monotonic() - t_kill
+
+        # Post-failover steady state across ALL keys (survivors never
+        # stopped acking; promoted keys ack through the new master)
+        for _ in range(2):
+            for k in keys:
+                write(k)
+
+        # Placement: the backup was promoted (not a fresh re-election
+        # over an empty image), the epoch is fenced forward, and the
+        # dead host is nowhere in the new placement
+        for k in hot:
+            m0, b0, e0 = placed0[k]
+            m1, b1, e1 = me.planner_client.claim_state_master("chaos", k)
+            assert m1 == b0, (k, m1, b0)
+            assert e1 == e0 + 1, (k, e0, e1)
+            assert victim not in (m1, b1), (k, m1, b1)
+
+        # THE acceptance: zero lost acknowledged writes, byte-exact
+        for k in keys:
+            kvs[k].pull()
+            got = kvs[k].get_chunk(CHUNK, CHUNK)
+            assert got == acked[k], \
+                f"acked write to {k} lost/corrupt after failover"
+
+        assert failover_s is not None and failover_s < 30.0, failover_s
+        print(f"\nmaster_failover_s={failover_s:.2f} "
+              f"(acked_writes={seq}, keys={len(keys)})")
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.slow
+def test_chaos_revived_stale_master_cannot_ack():
+    """SIGSTOP a master past keep-alive expiry (so its memory — and
+    its master KV — survives), fail over, SIGCONT it: the revived
+    ex-master's ack path MUST die on the epoch fence (the promoted
+    ex-backup rejects the replicate forward) and its poisoned bytes
+    never reach the authoritative copy."""
+    cluster = ChaosCluster(
+        "ckT", n_workers=3, slots=(2, 2, 2),
+        extra_env={"PLANNER_HOST_TIMEOUT": "2"}).start()
+    stopped = None
+    try:
+        me = cluster.me
+        w0 = cluster.workers[0]
+        all_hosts = cluster.workers + [f"{cluster.tag}cli"]
+        owner = _pick_keys(1, [w0], cluster.workers, all_hosts,
+                           prefix="fence")
+        (key,) = owner
+        _claim_on(me, owner)
+        m0, b0, e0 = me.planner_client.claim_state_master("chaos", key)
+        assert m0 == w0 and b0 in cluster.workers, (m0, b0)
+
+        # Acked baseline through the doomed master, then drop the
+        # client-side cache: no later client op may target a process
+        # that will be stopped (a send into a SIGSTOPped peer hangs to
+        # the socket timeout instead of failing fast)
+        kv = me.state.get_kv("chaos", key, SIZE)
+        base = _payload(key, 0)
+        kv.set_chunk(CHUNK, base)
+        kv.push_partial()
+        me.state.delete_kv("chaos", key)
+
+        stopped = cluster.procs[w0]
+        stopped.send_signal(signal.SIGSTOP)
+
+        # Expiry reaps the silent master; the claim path (same
+        # transition the reaper runs) promotes the live backup
+        deadline = time.time() + 30
+        while True:
+            try:
+                me.planner_client.get_available_hosts()
+                m1, b1, e1 = me.planner_client.claim_state_master(
+                    "chaos", key)
+                if m1 != w0 and e1 > e0:
+                    break
+            except Exception:
+                pass
+            assert time.time() < deadline, "failover never happened"
+            time.sleep(0.25)
+        assert m1 == b0 and e1 == e0 + 1, (m1, b0, e0, e1)
+
+        # An acked write through the NEW master (retry bridges the
+        # promotion landing on the ex-backup)
+        kv2 = me.state.get_kv("chaos", key, SIZE)
+        post = _payload(key, 1)
+        deadline = time.time() + 30
+        while True:
+            try:
+                kv2.set_chunk(CHUNK, post)
+                kv2.push_partial()
+                break
+            except Exception:
+                assert time.time() < deadline, "new master never acked"
+                time.sleep(0.25)
+
+        # Revive the corpse: it rejoins via the known:False keep-alive
+        # overwrite path, still holding its old master KV in memory
+        stopped.send_signal(signal.SIGCONT)
+        stopped = None
+        deadline = time.time() + 30
+        while True:
+            hosts = {h["ip"]
+                     for h in me.planner_client.get_available_hosts()}
+            if w0 in hosts:
+                break
+            assert time.time() < deadline, f"{w0} never rejoined: {hosts}"
+            time.sleep(0.25)
+
+        # The fencing probe runs ON the revived host (pinned): a write
+        # through its stale master KV must raise StaleStateEpoch — the
+        # promoted ex-backup refuses the epoch-stamped forward, so the
+        # ack structurally cannot happen
+        req = batch_exec_factory("dist", "state_stale_probe", 1)
+        req.messages[0].input_data = key.encode()
+        pre = SchedulingDecision(app_id=req.app_id, group_id=0)
+        pre.add_message(w0, 0, req.messages[0].app_idx, 0)
+        me.planner_client.preload_scheduling_decision(pre)
+        me.planner_client.call_functions(req)
+        status = wait_finished(me, req.app_id, timeout=30)
+        (probe,) = status.message_results
+        assert probe.return_value == int(ReturnValue.SUCCESS), probe
+        assert probe.output_data == b"fenced:StaleStateEpoch", \
+            probe.output_data
+
+        # The authoritative copy never saw the poison: the fenced
+        # write's 0xEE bytes are absent, the last acked write intact
+        kv2.pull()
+        assert kv2.get_chunk(0, CHUNK) == bytes([7]) * CHUNK
+        assert kv2.get_chunk(CHUNK, CHUNK) == post
+    finally:
+        if stopped is not None:
+            stopped.send_signal(signal.SIGCONT)
+        cluster.stop()

@@ -189,6 +189,28 @@ def test_gate_state_plane_keys_promoted_to_gated(tmp_path, capsys):
     assert "reported-only" in out
 
 
+def test_gate_replicated_state_keys_reported_only_first_round(
+        tmp_path, capsys):
+    """ISSUE 19 first-round keys: the replicated push rate and the
+    measured loopback failover are tracked but not gated until a round
+    of spread exists (promote next round, the standard ratchet) — with
+    DIRECTIONS pinned here so the eventual promotion inherits the
+    right polarity: _gibs higher-better, _s lower-better."""
+    for key in ("state_replicated_push_gibs", "master_failover_s"):
+        assert key in bench_gate.REPORTED_ONLY
+    assert bench_gate.direction("state_replicated_push_gibs") == 1
+    assert bench_gate.direction("master_failover_s") == -1
+    _write_round(tmp_path, "BENCH_r01.json", 0.05,
+                 {"state_replicated_push_gibs": 0.05,
+                  "master_failover_s": 0.003})
+    _write_round(tmp_path, "BENCH_r02.json", 0.05,
+                 {"state_replicated_push_gibs": 0.01,  # -80%: reported
+                  "master_failover_s": 0.5})           # +166x: reported
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "state_replicated_push_gibs" in out and "reported-only" in out
+
+
 def test_gate_profiler_keys_reported_only_first_round(tmp_path, capsys):
     """ISSUE 18 first-round keys: the stack-sampler figures (per-pass
     cost, measured firehose overhead, idle GIL pressure) are tracked

@@ -279,16 +279,16 @@ def test_expire_hosts_drops_dead_state_masters(monkeypatch):
     p = Planner()
     p.register_host("alive", 2, 0)
     p.register_host("doomed", 2, 0)
-    assert p.claim_state_master("u", "k", "doomed") == "doomed"
-    assert p.claim_state_master("u", "k2", "alive") == "alive"
+    assert p.claim_state_master("u", "k", "doomed")[0] == "doomed"
+    assert p.claim_state_master("u", "k2", "alive")[0] == "alive"
     time.sleep(0.3)
     p.register_host("alive", 2, 0)  # keep-alive refresh
     p.expire_hosts()
     assert p.num_registered_hosts() == 1
     # The dead master's key re-elects the next claimer; the live one
     # stays put
-    assert p.claim_state_master("u", "k", "alive") == "alive"
-    assert p.claim_state_master("u", "k2", "alive") == "alive"
+    assert p.claim_state_master("u", "k", "alive")[0] == "alive"
+    assert p.claim_state_master("u", "k2", "alive")[0] == "alive"
 
 
 def test_remove_host_drops_masters_and_claim_reelects():
@@ -298,15 +298,15 @@ def test_remove_host_drops_masters_and_claim_reelects():
     p = Planner()
     p.register_host("h1", 2, 0)
     p.register_host("h2", 2, 0)
-    assert p.claim_state_master("u", "k", "h1") == "h1"
+    assert p.claim_state_master("u", "k", "h1")[0] == "h1"
     p.remove_host("h1")
     # Re-claim from a live host wins; the corpse is gone
-    assert p.claim_state_master("u", "k", "h2") == "h2"
+    assert p.claim_state_master("u", "k", "h2")[0] == "h2"
     # A stale master lingering in the map (no registered hosts at all →
     # planner-only unit setups) keeps first-claimer semantics
     p2 = Planner()
-    assert p2.claim_state_master("u", "k", "x") == "x"
-    assert p2.claim_state_master("u", "k", "y") == "x"
+    assert p2.claim_state_master("u", "k", "x")[0] == "x"
+    assert p2.claim_state_master("u", "k", "y")[0] == "x"
 
 
 # ---------------------------------------------------------------------------

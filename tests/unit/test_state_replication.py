@@ -1,0 +1,308 @@
+"""Crash-tolerant state plane tests (ISSUE 19): consistent-hash backup
+placement, planner claim triples + epoch-fenced failover + journal
+replay, master→backup synchronous forwards, replica promotion, stale-
+master fencing, and anti-entropy byte-exactness. The full-process
+SIGKILL chaos proof lives in tests/dist/test_state_failover.py."""
+
+import time
+
+import numpy as np
+import pytest
+
+from faabric_tpu.state import (
+    STATE_CHUNK_SIZE,
+    StaleStateEpoch,
+    State,
+    StateReplica,
+    place_backup,
+    ring_order,
+)
+from faabric_tpu.util.config import get_system_config
+from faabric_tpu.util.testing import set_mock_mode
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash placement (pure functions)
+# ---------------------------------------------------------------------------
+
+def test_ring_order_deterministic_and_covers_hosts():
+    hosts = [f"h{i}" for i in range(5)]
+    order = ring_order("u/k", hosts)
+    assert sorted(order) == sorted(hosts)
+    # Host-list order and duplicates must not matter
+    assert order == ring_order("u/k", list(reversed(hosts)))
+    assert order == ring_order("u/k", hosts + hosts[:2])
+
+
+def test_place_backup_excludes_and_spreads():
+    hosts = [f"h{i}" for i in range(4)]
+    seen = set()
+    for i in range(64):
+        b = place_backup(f"u/key{i}", hosts, exclude=("h0",))
+        assert b in hosts and b != "h0"
+        seen.add(b)
+    # 64 keys across 3 eligible hosts: a constant placement would be a
+    # hashing bug
+    assert len(seen) == 3
+    assert place_backup("u/k", ["only"], exclude=("only",)) == ""
+    assert place_backup("u/k", []) == ""
+
+
+def test_minimal_reshuffle_on_host_loss():
+    hosts = [f"h{i}" for i in range(6)]
+    keys = [f"u/key{i}" for i in range(200)]
+    before = {k: place_backup(k, hosts) for k in keys}
+    removed = "h3"
+    survivors = [h for h in hosts if h != removed]
+    after = {k: place_backup(k, survivors) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # The consistent-hash property: ONLY keys placed on the dead host
+    # move; everyone else keeps their backup (no reshuffle storm)
+    assert moved, "expected some keys on the removed host"
+    assert all(before[k] == removed for k in moved)
+    assert all(after[k] in survivors for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# Planner placement: claim triples, failover, epochs, journal replay
+# ---------------------------------------------------------------------------
+
+def _planner_with_hosts(*hosts):
+    from faabric_tpu.planner.planner import Planner
+
+    p = Planner()
+    for h in hosts:
+        p.register_host(h, 2, 0)
+    return p
+
+
+def test_claim_triple_elects_consistent_hash_backup():
+    set_mock_mode(True)
+    p = _planner_with_hosts("h1", "h2", "h3")
+    master, backup, epoch = p.claim_state_master("u", "k", "h1")
+    assert master == "h1"
+    assert backup == place_backup("u/k", ["h2", "h3"])
+    assert epoch == 1
+    # Idempotent: a second claim (from anyone) returns the same triple
+    assert p.claim_state_master("u", "k", "h2") == (master, backup, epoch)
+    assert p.state_placement()["u/k"] == {
+        "master": master, "backup": backup, "epoch": epoch}
+
+
+def test_replicas_zero_keeps_legacy_semantics(monkeypatch):
+    monkeypatch.setenv("FAABRIC_STATE_REPLICAS", "0")
+    get_system_config().reset()
+    set_mock_mode(True)
+    p = _planner_with_hosts("h1", "h2")
+    # No backup, epoch pinned to 0 — and the wire helper keeps epoch 0
+    # entirely off the header (bitwise-legacy RPC shape)
+    assert p.claim_state_master("u", "k", "h1") == ("h1", "", 0)
+    from faabric_tpu.state.remote import _with_epoch
+
+    assert _with_epoch({"user": "u"}, 0) == {"user": "u"}
+    assert _with_epoch({"user": "u"}, 3) == {"user": "u", "epoch": 3}
+
+
+def test_failover_promotes_backup_bumps_epoch_and_fences_corpse():
+    set_mock_mode(True)
+    p = _planner_with_hosts("h1", "h2", "h3")
+    master, backup, epoch = p.claim_state_master("u", "k", "h1")
+    p.remove_host(master)
+    m2, b2, e2 = p.claim_state_master("u", "k", "h3")
+    assert m2 == backup, "the backup holds every acked write"
+    assert e2 == epoch + 1, "ownership changed: the epoch must bump"
+    assert b2 and b2 != m2, "a replacement backup is elected"
+    # The revived ex-master rejoins but does NOT get the key back: its
+    # image is missing every write acked after the failover
+    p.register_host(master, 2, 0)
+    assert p.claim_state_master("u", "k", master)[:1] == (m2,)
+    assert p.state_placement()["u/k"]["epoch"] == e2
+
+
+def test_dead_backup_is_replaced_without_epoch_bump():
+    set_mock_mode(True)
+    p = _planner_with_hosts("h1", "h2", "h3")
+    master, backup, epoch = p.claim_state_master("u", "k", "h1")
+    p.remove_host(backup)
+    m2, b2, e2 = p.claim_state_master("u", "k", "h1")
+    assert (m2, e2) == (master, epoch), "ownership did not change"
+    assert b2 not in ("", backup), "a live replacement is elected"
+
+
+def test_journal_replays_failover_placement(monkeypatch, tmp_path):
+    set_mock_mode(True)
+    monkeypatch.setenv("FAABRIC_PLANNER_JOURNAL_DIR", str(tmp_path))
+    monkeypatch.setenv("FAABRIC_PLANNER_RECONCILE_GRACE", "30")
+    get_system_config().reset()
+    p = _planner_with_hosts("h1", "h2", "h3")
+    p.claim_state_master("u", "k", "h1")
+    p.remove_host("h1")
+    placement = p.state_placement()
+    assert placement["u/k"]["epoch"] == 2
+    p.flush_journal()
+
+    from faabric_tpu.planner.planner import Planner
+
+    p2 = Planner()
+    # The restarted planner knows the promoted owner AND the fencing
+    # epoch — a revived ex-master can never win an ack race against a
+    # journal that outlives the crash
+    assert p2.state_placement() == placement
+
+
+# ---------------------------------------------------------------------------
+# StateReplica + promotion mechanics (single process, no RPC)
+# ---------------------------------------------------------------------------
+
+def test_replica_applies_fences_and_replaces():
+    rep = StateReplica("u", "k", 2 * STATE_CHUNK_SIZE, epoch=2)
+    rep.apply_chunks(2, 2 * STATE_CHUNK_SIZE, [(0, b"\x07" * 16)])
+    rep.apply_append(2, 2 * STATE_CHUNK_SIZE, [b"a", b"b"])
+    with pytest.raises(StaleStateEpoch):
+        rep.apply_chunks(1, 2 * STATE_CHUNK_SIZE, [(0, b"\xff" * 4)])
+    with pytest.raises(ValueError):
+        rep.apply_chunks(2, 2 * STATE_CHUNK_SIZE,
+                         [(2 * STATE_CHUNK_SIZE - 2, b"1234")])
+    # Anti-entropy replace is byte-exact, not additive
+    rep.apply_append(3, 2 * STATE_CHUNK_SIZE, [b"only"], replace=True)
+    image, appended, epoch = rep.snapshot()
+    assert image[:16] == b"\x07" * 16 and len(image) == 2 * STATE_CHUNK_SIZE
+    assert appended == [b"only"]
+    assert epoch == 3
+
+
+def test_self_promotion_converts_replica_to_master():
+    state = State("hostX")
+    data = bytes(range(256)) * 16
+    state.apply_replica_chunks("u", "rk", 1, len(data), [(0, data)])
+    state.apply_replica_append("u", "rk", 1, len(data), [b"v1"])
+    assert state.replica_count() == 1
+    # Equal epoch: the planner never re-blessed us — no promotion
+    assert state.maybe_self_promote("u", "rk", 1) is None
+    kv = state.maybe_self_promote("u", "rk", 2)
+    assert kv is not None and kv.is_master and kv.epoch == 2
+    assert kv.get() == data, "the promoted image IS the acked writes"
+    assert kv.get_appended(1) == [b"v1"]
+    assert state.replica_count() == 0
+    # Duplicate PROMOTE is idempotent; promoting a key with no replica
+    # reports failure so the planner can drop the mastership
+    assert state.promote_replica("u", "rk", 2, "") is True
+    assert state.promote_replica("u", "ghost", 5, "") is False
+
+
+def test_higher_epoch_replicate_demotes_stale_master():
+    state = State("hostX")
+    kv = state.get_kv("u", "dk", 128)
+    kv.set(b"\x01" * 128)
+    # An equal-epoch forward into a serving master is a fenced-out
+    # ex-master's ack attempt: reject it
+    with pytest.raises(StaleStateEpoch):
+        state.apply_replica_chunks("u", "dk", 0, 128, [(0, b"\x02" * 8)])
+    # A HIGHER epoch means we are the stale one: demote into a replica
+    state.apply_replica_chunks("u", "dk", 1, 128, [(0, b"\x03" * 8)])
+    assert state.try_get_kv("u", "dk") is None
+    assert state.replica_count() == 1
+    assert kv._stale, "the demoted master must never ack again"
+    with pytest.raises(StaleStateEpoch):
+        kv.check_epoch(1)
+
+
+# ---------------------------------------------------------------------------
+# Two-host cluster over real RPC: forwards, failover, fencing
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cluster():
+    """PlannerServer + two worker runtimes; yields (planner, workers)."""
+    from faabric_tpu.planner import PlannerServer, get_planner
+    from faabric_tpu.runner import WorkerRuntime
+    from faabric_tpu.transport.common import register_host_alias
+    from tests.conftest import next_port_base
+
+    base = next_port_base()
+    register_host_alias("planner", "127.0.0.1", base)
+    register_host_alias("stateA", "127.0.0.1", base + 1000)
+    register_host_alias("stateB", "127.0.0.1", base + 2000)
+
+    get_planner().reset()
+    planner_server = PlannerServer(port_offset=base)
+    planner_server.start()
+    workers = [WorkerRuntime(host=h, slots=1, planner_host="planner")
+               for h in ("stateA", "stateB")]
+    for w in workers:
+        w.start()
+    yield get_planner(), workers
+    for w in workers:
+        w.shutdown()
+    planner_server.stop()
+    get_planner().reset()
+
+
+def test_master_forwards_acked_writes_to_backup(cluster):
+    _planner, (wa, wb) = cluster
+    size = STATE_CHUNK_SIZE * 2
+    kv = wa.state.get_kv("demo", "rep", size)
+    assert kv.is_master and kv.backup_host == "stateB" and kv.epoch == 1
+
+    data = np.arange(size, dtype=np.uint8).tobytes()
+    kv.set(data)
+    kv.push_partial()  # master-local ack: forwards dirty chunks first
+    kv.append(b"journal-rec")
+
+    rep = wb.state._replicas.get("demo/rep")
+    assert rep is not None, "the backup must hold a replica after the ack"
+    image, appended, epoch = rep.snapshot()
+    assert image == data
+    assert appended == [b"journal-rec"]
+    assert epoch == 1
+
+
+def test_failover_zero_loss_and_stale_master_cannot_ack(cluster):
+    planner, (wa, wb) = cluster
+    size = STATE_CHUNK_SIZE * 3
+    kv_a = wa.state.get_kv("demo", "fo", size)
+    data = bytes([i % 251 for i in range(size)])
+    kv_a.set(data)
+    kv_a.push_partial()  # every byte below is ACKED once this returns
+
+    # The master "dies": the planner reaps it and promotes the backup
+    planner.remove_host("stateA")
+    deadline = time.time() + 10
+    kv_b = None
+    while time.time() < deadline:
+        kv_b = wb.state.try_get_kv("demo", "fo")
+        if kv_b is not None and kv_b.is_master:
+            break
+        time.sleep(0.05)
+    assert kv_b is not None and kv_b.is_master, "backup never promoted"
+    assert kv_b.epoch == 2
+    # Zero lost acknowledged writes: the promoted image is byte-exact
+    assert kv_b.get() == data
+
+    # The stale ex-master's ack path runs through its backup — which is
+    # now the epoch-2 owner and rejects the epoch-1 forward. The write
+    # is never acked, and the latch fences every later op too.
+    kv_a.set_chunk(0, b"\xee" * 8)
+    with pytest.raises(StaleStateEpoch):
+        kv_a.push_partial()
+    assert kv_a._stale
+    assert kv_b.get_chunk(0, 8) != b"\xee" * 8, \
+        "the fenced write must not reach the promoted master"
+
+
+def test_anti_entropy_full_sync_is_byte_exact(cluster):
+    _planner, (wa, wb) = cluster
+    size = STATE_CHUNK_SIZE * 5 + 37  # odd tail: exercise the last group
+    kv = wa.state.get_kv("demo", "ae", size)
+    data = np.random.default_rng(7).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+    kv.set(data)
+    kv.append(b"a1")
+    kv.append(b"a2")
+    # Wipe the backup's view, then resync from scratch — the path a
+    # newly-elected backup takes after a failover
+    wb.state._replicas.pop("demo/ae", None)
+    kv.full_sync_backup()
+    image, appended, _ = wb.state._replicas["demo/ae"].snapshot()
+    assert image == data
+    assert appended == [b"a1", b"a2"]

@@ -107,6 +107,12 @@ REQUIRED_KEYS = ("host_allreduce_procs_gibs", "host_sendrecv_gibs",
 # measured drag on the invocation firehose (acceptance: ≤ 2); and
 # gil_pressure_idle the drift gauge on an idle cluster (contract: ~0 —
 # direction pinned via LOWER_BETTER_KEYS).
+# ISSUE 19 replicated-state keys (first recorded round, promote next):
+# state_replicated_push_gibs is the dirty-chunk push rate WITH the
+# synchronous backup forward before each ack (compare against
+# state_push_partial_gibs for the replication tax), and
+# master_failover_s the measured loopback failover — planner
+# remove_host to first acked write through the promoted backup.
 REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "lifecycle_stamp_ns", "invocation_p99_ms",
                  "host_allreduce_device_gibs",
@@ -123,7 +129,8 @@ REPORTED_ONLY = ("invocations_per_s_serial", "invocation_p50_ms",
                  "doctor_selftest_ms",
                  "statestats_record_ns",
                  "profile_sample_ns", "profile_overhead_pct",
-                 "gil_pressure_idle")
+                 "gil_pressure_idle",
+                 "state_replicated_push_gibs", "master_failover_s")
 
 # Round-5 container drift (see ROADMAP "Recent"): ptp dispatch p50 (the
 # headline "value") and delta_apply_reuse_ms read worse in ANY tree on

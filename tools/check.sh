@@ -3,12 +3,14 @@
 # installed) + the concurrency conformance suite + the tier-1 failure
 # gate, each against its committed baseline.
 #
-#   tools/check.sh [--with-tests]
+#   tools/check.sh [--with-tests] [--with-chaos]
 #
 # Without --with-tests the failure gate re-reads the last tier-1 log at
 # /tmp/_t1.log (written by the canonical tier-1 command in ROADMAP.md);
-# with it, the tier-1 suite runs first. Exit nonzero on the first
-# failing gate.
+# with it, the tier-1 suite runs first. --with-chaos additionally runs
+# the chaos-marked state-failover proof (real worker processes
+# SIGKILLed/SIGSTOPped mid-write, ISSUE 19) — slow, opt-in. Exit
+# nonzero on the first failing gate.
 set -u -o pipefail
 
 repo="$(cd "$(dirname "$0")/.." && pwd)"
@@ -52,6 +54,20 @@ if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
         python -m faabric_tpu.device_plane.pallas_ring --selftest; then
     rc=1
 fi
+
+for arg in "$@"; do
+    if [ "$arg" = "--with-chaos" ]; then
+        echo "== chaos: replicated state failover (ISSUE 19) =="
+        # Zero lost acked writes across a SIGKILLed master + the
+        # revived-stale-master fencing proof, against real processes
+        if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+                python -m pytest tests/dist/test_state_failover.py \
+                -q -m chaos -p no:cacheprovider -p no:xdist \
+                -p no:randomly; then
+            rc=1
+        fi
+    fi
+done
 
 if [ "${1:-}" = "--with-tests" ]; then
     echo "== tier-1 suite =="
